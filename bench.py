@@ -1,0 +1,52 @@
+"""Headline benchmark — BASELINE config #5.
+
+`protocols/demers_rumor_mongering.erl` at 10^6 simulated nodes with 1%/round
+churn.  Target (BASELINE.json): >= 10^6 nodes at >= 1000 gossip rounds/sec on
+TPU v5e-8; this harness runs on whatever jax.devices() offers (the driver
+gives one v5e chip) and reports rounds/sec, with vs_baseline = value / 1000.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from partisan_tpu.models.demers import rumor_init, rumor_run
+
+
+def main() -> None:
+    n = 1_000_000
+    churn = 0.01
+    fanout = 2
+    rounds = 1000
+
+    w = rumor_init(n)
+    # warmup / compile
+    w1 = rumor_run(w, 10, n, fanout, 1, churn)
+    jax.block_until_ready(w1)
+
+    t0 = time.perf_counter()
+    out = rumor_run(w, rounds, n, fanout, 1, churn)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    rps = rounds / dt
+    infected = float(jnp.mean(out.infected))
+    result = {
+        "metric": f"rumor_mongering rounds/sec @ N=1e6, churn={churn}",
+        "value": round(rps, 1),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rps / 1000.0, 3),
+    }
+    print(json.dumps(result))
+    print(f"# infected fraction after {rounds} rounds: {infected:.3f}; "
+          f"device={jax.devices()[0].platform}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""partisan_tpu — a TPU-native cluster-membership & gossip simulation framework.
+
+A ground-up rebuild of the capabilities of ServiceFoundation/partisan (an
+Erlang cluster-membership/messaging layer) as batched, jittable JAX programs:
+N virtual nodes are rows of sharded arrays, one gossip round is one fused
+sort-route-deliver-tick step, and protocols (full-membership CRDT gossip,
+HyParView, SCAMP v1/v2, Plumtree, the Demers epidemic family) are vectorized
+per-node handler tables.  See SURVEY.md at the repo root for the layer map.
+"""
+
+from .config import Config, DEFAULT, from_mapping
+from .engine import ProtocolBase, World, init_world, make_step, make_run_scan, run
+
+__version__ = "0.1.0"

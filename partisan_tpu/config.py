@@ -1,0 +1,109 @@
+"""Static configuration for the TPU-native partisan rebuild.
+
+Mirrors the reference's config system (``src/partisan_config.erl:37-151`` and
+``include/partisan.hrl``) as a frozen dataclass: reads are attribute lookups on
+an immutable object that is closed over by jitted step functions, which is the
+JAX-idiomatic analog of the reference's compiled-module globals
+(``src/partisan_mochiglobal.erl`` — deliberately NOT ported, see SURVEY §7.4).
+
+Timer cadences in the reference are wall-clock milliseconds
+(``include/partisan.hrl:28,58-59``); the simulator is round-synchronous, so we
+express every cadence in *rounds*.  With the default mapping of 1 round = 1 s:
+periodic gossip 10 s -> 10 rounds, connection retry / retransmit / plumtree
+lazy tick 1 s -> 1 round, shuffle + exchange 10 s -> 10 rounds, random
+promotion 5 s -> 5 rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Frozen simulation config.
+
+    Field defaults follow ``partisan_config:init/0``
+    (``src/partisan_config.erl:37-151``) where a corresponding key exists, and
+    ``include/partisan.hrl`` constants otherwise.  ARWL/PRWL follow the config
+    init values (5/30), not the module fallbacks (6/6) — ``partisan_sup``
+    always runs ``partisan_config:init`` first (see SURVEY §7.3).
+    """
+
+    # --- cluster shape -----------------------------------------------------
+    n_nodes: int = 64                  # N virtual nodes (rows of the state arrays)
+
+    # --- HyParView (partisan_hyparview_peer_service_manager.erl:310-312) ---
+    max_active_size: int = 6
+    min_active_size: int = 3
+    max_passive_size: int = 30
+    arwl: int = 5                      # active random-walk length  (partisan_config.erl:103)
+    prwl: int = 30                     # passive random-walk length (partisan_config.erl:104)
+    shuffle_k_active: int = 3          # k_active()  (hyparview :1559-1562)
+    shuffle_k_passive: int = 4         # k_passive() (hyparview :1563-1565)
+    shuffle_interval: int = 10         # passive_view_maintenance, 10 s (hyparview :27)
+    random_promotion_interval: int = 5  # 5 s (hyparview :28)
+
+    # --- gossip / membership strategies ------------------------------------
+    fanout: int = 5                    # ?FANOUT (partisan.hrl:5)
+    periodic_interval: int = 10        # ?PERIODIC_INTERVAL 10000 ms (partisan.hrl:28)
+    scamp_c: int = 5                   # ?SCAMP_C_VALUE (partisan.hrl:31)
+    scamp_message_window: int = 10     # ?SCAMP_MESSAGE_WINDOW (partisan.hrl:32)
+    scamp_exact_keep_probability: bool = True
+    # ^ the reference quantizes SCAMP's keep probability to a fair coin
+    #   (scamp_v2 :292-296, 352-360); True uses the paper's 1/(1+|view|),
+    #   False reproduces the reference's coin flip for behavioural parity.
+
+    # --- plumtree (partisan.hrl:58-59, plumtree_broadcast.erl) --------------
+    lazy_tick_period: int = 1          # 1 s
+    exchange_tick_period: int = 10     # 10 s
+    broadcast_start_exchange_limit: int = 1
+    broadcast_heartbeat_interval: int = 10  # plumtree_backend heartbeats, 10 s
+
+    # --- messaging QoS ------------------------------------------------------
+    parallelism: int = 1               # ?PARALLELISM (partisan.hrl:16): k lanes per edge
+    channels: Tuple[str, ...] = ("undefined",)  # ?CHANNELS (partisan.hrl:19)
+    monotonic_channels: Tuple[str, ...] = ()    # {monotonic, C} channels keep-latest
+    retransmit_interval: int = 1       # retransmit timer 1 s (pluggable :1299-1301)
+    connection_retry_interval: int = 1  # reconnect tick 1 s (pluggable :1304-1306)
+    relay_ttl: int = 5                 # ?RELAY_TTL (partisan.hrl:9)
+    keepalive_interval: int = 2        # rounds between active-view keepalives
+    keepalive_ttl: int = 8             # rounds without keepalive => link dead
+    # ^ the failure-detection analog of the reference's TCP keepalive +
+    #   linked-process EXIT pruning (partisan_socket.erl:17-19, SURVEY §5.3):
+    #   the simulator's transport can drop messages (inbox overflow), so
+    #   dead/one-sided active edges are detected by keepalive expiry instead
+    #   of socket death.
+    broadcast: bool = False            # tree-based transitive relay when disconnected
+    distance_interval: int = 10        # ping/pong distance metrics (pluggable :852-873)
+
+    # --- simulator capacities (fixed shapes; SURVEY §7.3 "dynamic sparsity")
+    # (per-handler emission caps live on each protocol class, which alone
+    # knows its fan-out; only the shared routing cap lives here)
+    inbox_cap: int = 16                # max messages a node processes per round
+
+    # --- determinism --------------------------------------------------------
+    seed: int = 1                      # per-node keys derive from this (support :163-166)
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def n_channels(self) -> int:
+        return len(self.channels)
+
+    def channel_index(self, name: str) -> int:
+        """Channel name -> lane index (names live host-side only, SURVEY §5.6)."""
+        return self.channels.index(name)
+
+
+DEFAULT = Config()
+
+
+def from_mapping(m: Optional[Mapping[str, Any]] = None, **kw: Any) -> Config:
+    """Build a Config from a dict of overrides (the `partisan_config:set`
+    analog used by the test harness, cf. test/partisan_support.erl:109-330)."""
+    merged = dict(m or {})
+    merged.update(kw)
+    return dataclasses.replace(DEFAULT, **merged)

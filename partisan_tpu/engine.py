@@ -1,0 +1,298 @@
+"""The round-synchronous simulation engine.
+
+One **round** is the TPU-native unit of progress: it stands for one network
+hop plus one tick of every node's local timers.  The reference is asynchronous
+(gen_server timers at 1 s / 5 s / 10 s cadences, messages delivered whenever
+TCP does), but its own verification machinery already treats executions as
+reorderable message sequences (src/partisan_trace_orchestrator.erl:160-202),
+so a synchronous round with randomized intra-round delivery order is a
+faithful abstraction — see SURVEY §7.3 "Asynchrony vs. rounds".
+
+    step(state, msgs, rnd) ->
+        route    msgs into per-node inboxes           (ops/msg.build_inbox)
+        deliver  vmap over nodes: sequentially apply each inbox slot through
+                 the protocol's per-type handler (lax.switch) — this preserves
+                 Erlang per-process mailbox semantics batched across N
+        tick     vmap over nodes: timer phase (periodic gossip, shuffle, ...)
+        collect  flatten emitted messages + held (delayed) messages into the
+                 next round's flat buffer
+        faults / interposition applied between emit and route — drop = mask
+                 to invalid, delay = bump the delay field (SURVEY §4.2)
+
+Everything is jit-compatible: fixed shapes, `lax`-only control flow.  The node
+axis is the sharding axis (see parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from . import prng
+from .config import Config
+from .ops import msg as msgops
+from .ops.msg import Msgs
+
+
+@struct.dataclass
+class World:
+    """Full simulator state carried between rounds."""
+    state: Any                 # protocol pytree, every leaf [N, ...]
+    msgs: Msgs                 # in-flight flat message buffer
+    keys: jax.Array            # [N, 2] per-node PRNG keys
+    rnd: jax.Array             # scalar int32 round counter
+    alive: jax.Array           # [N] bool crash mask (faults, SURVEY §5.3)
+    partition: jax.Array       # [N] int32 partition ids (0 = no partition)
+
+
+def default_out_cap(cfg: Config, proto: "ProtocolBase") -> int:
+    """Shared default for the flat in-flight buffer capacity (must agree
+    between init_world and make_step or the scan carry changes shape)."""
+    return cfg.n_nodes * (cfg.inbox_cap * proto.emit_cap
+                          + proto.tick_emit_cap) // 4
+
+
+class ProtocolBase:
+    """Duck-typed protocol contract (the membership-strategy behaviour of
+    src/partisan_membership_strategy.erl:27-36 generalized to every manager).
+
+    Subclasses define:
+      msg_types: tuple[str, ...]          — tag names; index = wire `typ`
+      data_spec: dict[name, (shape, dt)]  — payload fields
+      emit_cap / tick_emit_cap: int       — per-call emission bounds
+      init(cfg, key) -> state pytree      — leaves [N, ...]
+      tick(cfg, node_id, row, rnd, key) -> (row, Msgs[tick_emit_cap])
+      handle_<type>(cfg, node_id, row, m, key) -> (row, Msgs[emit_cap])
+                                            — m is a single-message view
+    Handlers are pure; `row` is this node's slice of the state pytree.
+    """
+
+    msg_types: Tuple[str, ...] = ()
+    data_spec: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
+    emit_cap: int = 4
+    tick_emit_cap: int = 4
+    ctl_peer_field: str = "peer"  # payload field carrying ctl_join/leave target
+
+    def typ(self, name: str) -> int:
+        # _typ_offset is set by models/stack.Stacked so a stacked upper
+        # protocol's tags index into the combined handler table
+        return self.msg_types.index(name) + getattr(self, "_typ_offset", 0)
+
+    def handlers(self) -> Tuple[Callable, ...]:
+        return tuple(getattr(self, "handle_" + t) for t in self.msg_types)
+
+    def init(self, cfg: Config, key: jax.Array):
+        raise NotImplementedError
+
+    def tick(self, cfg, node_id, row, rnd, key):
+        return row, self.no_emit(self.tick_emit_cap)
+
+    # --- emission helpers (used inside handlers) ---------------------------
+
+    def no_emit(self, cap: Optional[int] = None) -> Msgs:
+        return msgops.empty(cap or self.emit_cap, self.data_spec)
+
+    def emit(self, dst, typ, *, cap: Optional[int] = None, channel=None,
+             delay=None, valid=None, **data) -> Msgs:
+        """Build an emission buffer from [k]-shaped dst/typ (k static <= cap).
+        Slots with dst < 0 are invalid, so 'send to every member of a padded
+        view' is just emit(view, TYP)."""
+        cap = cap or self.emit_cap
+        dst = jnp.atleast_1d(jnp.asarray(dst, jnp.int32))
+        k = dst.shape[0]
+        assert k <= cap, f"emit of {k} > cap {cap}"
+        typ = jnp.broadcast_to(jnp.asarray(typ, jnp.int32), (k,))
+        v = dst >= 0
+        if valid is not None:
+            v = v & jnp.broadcast_to(jnp.asarray(valid, bool), (k,))
+        out = msgops.empty(cap, self.data_spec)
+        sl = slice(0, k)
+        out = out.replace(
+            valid=out.valid.at[sl].set(v),
+            dst=out.dst.at[sl].set(jnp.maximum(dst, 0)),
+            typ=out.typ.at[sl].set(typ),
+        )
+        if channel is not None:
+            out = out.replace(channel=out.channel.at[sl].set(
+                jnp.broadcast_to(jnp.asarray(channel, jnp.int32), (k,))))
+        if delay is not None:
+            out = out.replace(delay=out.delay.at[sl].set(
+                jnp.broadcast_to(jnp.asarray(delay, jnp.int32), (k,))))
+        for name, val in data.items():
+            tgt = out.data[name]
+            val = jnp.broadcast_to(jnp.asarray(val, tgt.dtype), (k,) + tgt.shape[1:])
+            out.data[name] = tgt.at[sl].set(val)
+        return out
+
+    def merge(self, *emits: Msgs, cap: Optional[int] = None) -> Msgs:
+        """Concatenate several emission buffers, compacting valid slots to the
+        front and truncating to cap (choose caps generously; engine counts any
+        flat-level drops)."""
+        cap = cap or self.emit_cap
+        cat = msgops.concat(*emits)
+        out, _ = msgops.compact(cat, cap)
+        return out
+
+
+def make_step(
+    cfg: Config,
+    proto: ProtocolBase,
+    out_cap: Optional[int] = None,
+    interpose_send: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
+    interpose_recv: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
+    randomize_delivery: bool = True,
+    donate: bool = True,
+) -> Callable[[World], Tuple[World, Dict[str, jax.Array]]]:
+    """Compile one simulation round for `proto`.
+
+    interpose_send/recv are the TPU analog of the reference's interposition
+    funs (partisan_pluggable_peer_service_manager.erl:51-58, 640-667): pure
+    functions over the flat message buffer that may invalidate (drop), rewrite
+    fields, or bump `delay` ('$delay'), keyed off the round number.
+    """
+    N = cfg.n_nodes
+    K = cfg.inbox_cap
+    E = proto.emit_cap
+    T = proto.tick_emit_cap
+    n_types = len(proto.msg_types)
+    handlers = proto.handlers()
+    out_cap = out_cap or default_out_cap(cfg, proto)
+
+    def noop_handler(node_id, row, m, key):
+        return row, proto.no_emit()
+
+    def node_deliver(node_id, row, inbox_row, key):
+        embuf = msgops.empty(K * E, proto.data_spec)
+
+        def body(k, carry):
+            row, embuf = carry
+            m = jax.tree_util.tree_map(lambda x: x[k], inbox_row)
+            hkey = prng.decision_key(key, 1000 + k)
+            branches = tuple(
+                (lambda h: lambda r: h(cfg, node_id, r, m, hkey))(h)
+                for h in handlers
+            ) + ((lambda r: noop_handler(node_id, r, m, hkey)),)
+            idx = jnp.where(m.valid, jnp.clip(m.typ, 0, n_types - 1), n_types)
+            row, em = jax.lax.switch(idx, branches, row)
+            embuf = jax.tree_util.tree_map(
+                lambda b, e: jax.lax.dynamic_update_slice_in_dim(b, e, k * E, 0),
+                embuf, em)
+            return row, embuf
+
+        row, embuf = jax.lax.fori_loop(0, K, body, (row, embuf))
+        return row, embuf
+
+    def step(world: World) -> Tuple[World, Dict[str, jax.Array]]:
+        state, msgs, rnd = world.state, world.msgs, world.rnd
+        rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys, rnd)
+        node_ids = jnp.arange(N, dtype=jnp.int32)
+
+        # -- split delayed messages out first so interposition and fault
+        #    masks apply exactly once, at delivery time (not per held round)
+        held = msgs.replace(valid=msgs.valid & (msgs.delay > 0),
+                            delay=jnp.maximum(msgs.delay - 1, 0))
+        now = msgs.replace(valid=msgs.valid & (msgs.delay <= 0))
+
+        # -- fault plane: crashed nodes neither send nor receive; messages
+        #    crossing a partition boundary are dropped (hyparview partition
+        #    semantics, :1731-1797).
+        now = now.replace(valid=now.valid
+                          & world.alive[jnp.clip(now.src, 0, N - 1)]
+                          & world.alive[jnp.clip(now.dst, 0, N - 1)])
+        same_part = (world.partition[jnp.clip(now.src, 0, N - 1)]
+                     == world.partition[jnp.clip(now.dst, 0, N - 1)])
+        now = now.replace(valid=now.valid & same_part)
+        if interpose_recv is not None:
+            now = interpose_recv(now, rnd)
+
+        # -- route
+        route_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed ^ 0x5EED), rnd) \
+            if randomize_delivery else None
+        inbox, _, overflow = msgops.build_inbox(now, N, K, key=route_key)
+
+        # -- deliver (per-node sequential, batched over N)
+        dkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 1)
+        state, demits = jax.vmap(node_deliver)(node_ids, state, inbox, dkeys)
+
+        # -- tick (timer phase)
+        tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 2)
+        tick = lambda i, r, k: proto.tick(cfg, i, r, rnd, k)
+        state, temits = jax.vmap(tick, in_axes=(0, 0, 0))(node_ids, state, tkeys)
+
+        # -- collect: flatten [N, K*E] and [N, T] emissions, stamp src ids
+        def flat(em: Msgs, per: int) -> Msgs:
+            out = jax.tree_util.tree_map(
+                lambda x: x.reshape((N * per,) + x.shape[2:]), em)
+            src = jnp.repeat(node_ids, per)
+            return out.replace(src=src)
+
+        new = msgops.concat(flat(demits, K * E), flat(temits, T))
+        alive_src = world.alive[jnp.clip(new.src, 0, N - 1)]
+        new = new.replace(valid=new.valid & alive_src)
+        if interpose_send is not None:
+            new = interpose_send(new, rnd)  # once, at send time only
+        out = msgops.concat(new, held)
+        out, dropped = msgops.compact(out, out_cap)
+
+        metrics = {
+            "round": rnd,
+            "delivered": jnp.sum(inbox.valid).astype(jnp.int32),
+            "sent": out.count(),
+            "inbox_overflow": overflow,
+            "out_dropped": dropped,
+        }
+        new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
+        return new_world, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def init_world(cfg: Config, proto: ProtocolBase,
+               out_cap: Optional[int] = None) -> World:
+    N = cfg.n_nodes
+    key = jax.random.PRNGKey(cfg.seed)
+    state = proto.init(cfg, key)
+    out_cap = out_cap or default_out_cap(cfg, proto)
+    return World(
+        state=state,
+        msgs=msgops.empty(out_cap, proto.data_spec),
+        keys=prng.node_keys(cfg.seed, N),
+        rnd=jnp.int32(0),
+        alive=jnp.ones((N,), dtype=bool),
+        partition=jnp.zeros((N,), dtype=jnp.int32),
+    )
+
+
+def run(cfg: Config, proto: ProtocolBase, n_rounds: int,
+        world: Optional[World] = None,
+        step: Optional[Callable] = None,
+        collect: Optional[Callable[[World], Any]] = None):
+    """Host-side convenience loop (tests / small N).  For benchmarks use
+    `run_scan` which keeps the whole loop on device."""
+    world = world if world is not None else init_world(cfg, proto)
+    step = step or make_step(cfg, proto)
+    history = []
+    for _ in range(n_rounds):
+        world, metrics = step(world)
+        if collect is not None:
+            history.append(collect(world))
+    return world, history
+
+
+def make_run_scan(cfg: Config, proto: ProtocolBase, n_rounds: int, **kw):
+    """Whole-run-on-device: lax.scan over rounds, returns stacked metrics.
+    This is the benchmark path — zero host round-trips per round."""
+    step = make_step(cfg, proto, donate=False, **kw)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_scan(world: World):
+        def body(w, _):
+            w2, m = step(w)
+            return w2, m
+        return jax.lax.scan(body, world, None, length=n_rounds)
+
+    return run_scan

@@ -1,0 +1,5 @@
+from .demers import AntiEntropy, DirectMail, DirectMailAcked, rumor_init, rumor_run
+from .full_membership import FullMembership
+from .hyparview import HyParView
+from .plumtree import Plumtree
+from .stack import Stacked, StackState, UpperProtocol

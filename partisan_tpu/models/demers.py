@@ -1,0 +1,244 @@
+"""The Demers epidemic-protocol family — the reference's example workloads
+(``protocols/demers_*.erl``, SURVEY §2.10) rebuilt as batched TPU programs.
+
+Two tiers:
+
+1. **Engine protocols** (`DirectMail`, `DirectMailAcked`, `AntiEntropy`) —
+   run through the generic round engine for full interposition / trace /
+   fault support at test scale, mirroring how the reference model-checks
+   these modules.
+
+2. **`RumorMongering` fast path** — the BASELINE #5 workload
+   (protocols/demers_rumor_mongering.erl at 10^6 nodes, 1% churn/round).
+   Rumor delivery is a commutative merge (infected |= any rumor arrived), so
+   it uses the dense reduce path (ops/msg.reduce_to_nodes rationale): no
+   sort, no per-slot loop — each round is two gathers + one scatter + PRNG,
+   which is what makes >=1000 rounds/s at N=10^6 feasible.  Semantics follow
+   demers_rumor_mongering.erl:39,89-145: FANOUT 2 (partisan.hrl:?FANOUT is 5
+   for membership gossip; the rumor protocol uses its own fanout 2), dedup by
+   message id (infected-once), re-forward to a random subset, and
+   feedback-based loss of interest (a push to an already-infected peer kills
+   the sender's interest with probability 1/stop_k).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import bitset
+from ..ops.msg import Msgs
+from .. import prng
+
+
+# =========================================================================
+# 1. Direct mail (demers_direct_mail.erl): broadcast = send to every member.
+# =========================================================================
+
+@struct.dataclass
+class MailState:
+    member: jax.Array    # [N, W] membership bitset (static full mesh here;
+                         # composition with a live membership layer comes via
+                         # the stack combinator, models/stack.py)
+    seen: jax.Array      # [N, R] bool — rumor r delivered at node
+    acked: jax.Array     # [N, R] int32 — acks received by the origin (acked
+                         # variant, demers_direct_mail_acked.erl)
+
+
+class DirectMail(ProtocolBase):
+    """demers_direct_mail.erl:1-147 — reliable broadcast by sending the
+    payload to every known member, used by `gossip_test`
+    (test/partisan_SUITE.erl:1138)."""
+
+    msg_types = ("mail", "ctl_broadcast")
+    acked = False
+
+    def __init__(self, cfg: Config, n_rumors: int = 4):
+        self.cfg = cfg
+        self.R = n_rumors
+        self.data_spec: Dict = {"rumor": ((), jnp.int32),
+                                "peer": ((), jnp.int32)}
+        self.emit_cap = cfg.n_nodes
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> MailState:
+        n, w = cfg.n_nodes, bitset.n_words(cfg.n_nodes)
+        full = jnp.tile((~jnp.zeros((w,), jnp.uint32))[None], (n, 1))
+        return MailState(
+            member=full,
+            seen=jnp.zeros((n, self.R), bool),
+            acked=jnp.zeros((n, self.R), jnp.int32),
+        )
+
+    def _everyone_else(self, row: MailState, me) -> jax.Array:
+        mask = bitset.to_mask(row.member, self.cfg.n_nodes)
+        mask = mask & (jnp.arange(self.cfg.n_nodes) != me)
+        idx, = jnp.nonzero(mask, size=self.emit_cap, fill_value=-1)
+        return idx.astype(jnp.int32)
+
+    def handle_ctl_broadcast(self, cfg, me, row: MailState, m: Msgs, key):
+        r = m.data["rumor"]
+        row = row.replace(seen=row.seen.at[r].set(True))
+        return row, self.emit(self._everyone_else(row, me), self.typ("mail"),
+                              rumor=r)
+
+    def handle_mail(self, cfg, me, row: MailState, m: Msgs, key):
+        r = m.data["rumor"]
+        row = row.replace(seen=row.seen.at[r].set(True))
+        return row, self.no_emit()
+
+
+class DirectMailAcked(DirectMail):
+    """demers_direct_mail_acked.erl — + per-recipient acks back to origin."""
+
+    msg_types = ("mail", "ctl_broadcast", "ack")
+    acked = True
+
+    def handle_mail(self, cfg, me, row: MailState, m: Msgs, key):
+        r = m.data["rumor"]
+        row = row.replace(seen=row.seen.at[r].set(True))
+        return row, self.emit(m.src[None], self.typ("ack"), rumor=r)
+
+    def handle_ack(self, cfg, me, row: MailState, m: Msgs, key):
+        r = m.data["rumor"]
+        return row.replace(acked=row.acked.at[r].add(1)), self.no_emit()
+
+
+# =========================================================================
+# 2. Anti-entropy (demers_anti_entropy.erl:115-184): periodic push-pull
+#    digest exchange with one random partner.
+# =========================================================================
+
+@struct.dataclass
+class AeState:
+    seen: jax.Array      # [N, R] bool
+
+
+class AntiEntropy(ProtocolBase):
+    """Push-pull: each periodic tick, pick a uniform random peer and push my
+    digest; the peer merges and pushes back what it has (pull half)."""
+
+    msg_types = ("push", "pull_reply", "ctl_broadcast")
+
+    def __init__(self, cfg: Config, n_rumors: int = 4):
+        self.cfg = cfg
+        self.R = n_rumors
+        self.data_spec: Dict = {"digest": ((n_rumors,), jnp.int32),
+                                "rumor": ((), jnp.int32),
+                                "peer": ((), jnp.int32)}
+        self.emit_cap = 2
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> AeState:
+        return AeState(seen=jnp.zeros((cfg.n_nodes, self.R), bool))
+
+    def handle_ctl_broadcast(self, cfg, me, row, m, key):
+        return row.replace(seen=row.seen.at[m.data["rumor"]].set(True)), \
+            self.no_emit()
+
+    def handle_push(self, cfg, me, row: AeState, m: Msgs, key):
+        theirs = m.data["digest"] > 0
+        merged = row.seen | theirs
+        # pull half: reply with what I have (they merge symmetrically)
+        rep = self.emit(m.src[None], self.typ("pull_reply"),
+                        digest=merged.astype(jnp.int32))
+        return row.replace(seen=merged), rep
+
+    def handle_pull_reply(self, cfg, me, row: AeState, m: Msgs, key):
+        return row.replace(seen=row.seen | (m.data["digest"] > 0)), \
+            self.no_emit()
+
+    def tick(self, cfg, me, row: AeState, rnd, key):
+        due = ((rnd + me) % cfg.periodic_interval) == 0
+        peer = jax.random.randint(key, (), 0, cfg.n_nodes)
+        peer = jnp.where(peer == me, (peer + 1) % cfg.n_nodes, peer)
+        em = self.emit(jnp.where(due, peer, -1)[None], self.typ("push"),
+                       cap=self.tick_emit_cap,
+                       digest=row.seen.astype(jnp.int32))
+        return row, em
+
+
+# =========================================================================
+# 3. Rumor mongering fast path (BASELINE #5, 10^6 nodes, 1%/round churn).
+# =========================================================================
+
+class RumorWorld(NamedTuple):
+    infected: jax.Array   # [N] bool — has the rumor (dedup by id == once)
+    hot: jax.Array        # [N] bool — still actively spreading
+    alive: jax.Array      # [N] bool — churn: dead rows lose state
+    rnd: jax.Array        # scalar int32
+
+
+def rumor_init(n: int, patient_zero: int = 0) -> RumorWorld:
+    infected = jnp.zeros((n,), bool).at[patient_zero].set(True)
+    return RumorWorld(
+        infected=infected,
+        hot=infected,
+        alive=jnp.ones((n,), bool),
+        rnd=jnp.int32(0),
+    )
+
+
+def make_rumor_step(n: int, fanout: int = 2, stop_k: int = 1,
+                    churn: float = 0.0, seed: int = 1):
+    """One fused rumor-mongering round.
+
+    emit:    every hot & alive node picks `fanout` uniform random targets
+    route:   dense scatter-or onto the infected mask (commutative delivery —
+             the reduce fast path; no sort needed)
+    feedback: a sender whose (first) target was already infected loses
+             interest with probability 1/stop_k
+             (the Demers feedback/coin-death variant)
+    churn:   each round, `churn` fraction of rows are replaced by fresh
+             (uninfected, susceptible) nodes — re-randomizing rows is the
+             TPU-native churn model (SURVEY §5.3)
+    """
+    base = jax.random.PRNGKey(seed)
+
+    def step(w: RumorWorld, _):
+        k = jax.random.fold_in(base, w.rnd)
+        k_tgt, k_coin, k_churn = jax.random.split(k, 3)
+
+        send = w.hot & w.alive
+        targets = jax.random.randint(k_tgt, (n, fanout), 0, n)  # [N, F]
+
+        # -- deliver: scatter-or of infection onto live targets
+        tflat = targets.reshape(-1)
+        sflat = jnp.repeat(send, fanout)
+        hit = sflat & w.alive[tflat]
+        new_infected = w.infected.at[tflat].max(hit)
+        newly = new_infected & ~w.infected
+        new_hot = w.hot | newly
+
+        # -- feedback: pushing to an already-infected peer kills interest
+        #    w.p. 1/stop_k (evaluated on the first lane, as one push-ack)
+        dup = w.infected[targets[:, 0]] & send
+        coin = jax.random.uniform(k_coin, (n,)) < (1.0 / stop_k)
+        new_hot = new_hot & ~(dup & coin)
+
+        # -- churn: replace a fraction of rows with fresh susceptible nodes
+        if churn > 0.0:
+            reborn = jax.random.uniform(k_churn, (n,)) < churn
+            new_infected = new_infected & ~reborn
+            new_hot = new_hot & ~reborn
+
+        w2 = RumorWorld(infected=new_infected, hot=new_hot,
+                        alive=w.alive, rnd=w.rnd + 1)
+        return w2, None
+
+    return step
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def rumor_run(w: RumorWorld, n_rounds: int, n: int, fanout: int = 2,
+              stop_k: int = 1, churn: float = 0.0) -> RumorWorld:
+    """n_rounds of rumor mongering fully on device (lax.scan)."""
+    step = make_rumor_step(n, fanout, stop_k, churn)
+    out, _ = jax.lax.scan(step, w, None, length=n_rounds)
+    return out

@@ -1,0 +1,106 @@
+"""Full-membership strategy: CRDT gossip over the complete member set.
+
+TPU-native rebuild of ``src/partisan_full_membership_strategy.erl``:
+  * membership is a ``state_orset`` CRDT (:33) — here encoded for the fixed
+    node-id universe as two packed bitsets per node (adds, rems); the member
+    set is ``adds & ~rems`` (2P-set cover of the orset for a universe where a
+    node id re-joins under a fresh id, which is how the simulator's churn
+    generator works).
+  * join = CRDT merge + re-gossip to all          (:49-55)
+  * leave = rmv mutation, gossiped                (:58-89)
+  * periodic = full state to every peer           (:92-96, 127-144)
+  * handle_message: equal -> converged, stop; else merge + re-gossip (:99-116)
+
+This strategy is O(N) state per node and is intentionally used only for small
+clusters (SURVEY §7.3); the big-N configs use HyParView / SCAMP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import bitset
+from ..ops.msg import Msgs
+
+
+@struct.dataclass
+class FullState:
+    adds: jax.Array   # [N, W] uint32 — grow-only add set
+    rems: jax.Array   # [N, W] uint32 — grow-only remove set
+
+
+class FullMembership(ProtocolBase):
+    msg_types = ("gossip", "ctl_join", "ctl_leave")
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.W = bitset.n_words(cfg.n_nodes)
+        self.data_spec: Dict = {
+            "adds": ((self.W,), jnp.uint32),
+            "rems": ((self.W,), jnp.uint32),
+            "peer": ((), jnp.int32),
+        }
+        # gossip fan-out is "to every member" — cap at N (small-N strategy)
+        self.emit_cap = cfg.n_nodes
+        self.tick_emit_cap = cfg.n_nodes
+
+    # -- helpers ------------------------------------------------------------
+
+    def member_mask(self, row: FullState) -> jax.Array:
+        n = self.cfg.n_nodes
+        return bitset.to_mask(row.adds, n) & ~bitset.to_mask(row.rems, n)
+
+    def _peers(self, row: FullState, me: jax.Array) -> jax.Array:
+        """Padded list of members excluding self (gossip targets,
+        full :127-144)."""
+        mask = self.member_mask(row)
+        mask = mask & (jnp.arange(self.cfg.n_nodes) != me)
+        (idx,) = jnp.nonzero(mask, size=self.emit_cap, fill_value=-1)
+        return idx.astype(jnp.int32)
+
+    def _gossip_all(self, row: FullState, me: jax.Array) -> Msgs:
+        return self.emit(self._peers(row, me), self.typ("gossip"),
+                         adds=row.adds, rems=row.rems)
+
+    # -- behaviour callbacks ------------------------------------------------
+
+    def init(self, cfg: Config, key: jax.Array) -> FullState:
+        n, w = cfg.n_nodes, self.W
+        me = jnp.arange(n)
+        adds = jax.vmap(lambda i: bitset.add(jnp.zeros((w,), jnp.uint32), i))(me)
+        return FullState(adds=adds, rems=jnp.zeros((n, w), jnp.uint32))
+
+    def tick(self, cfg, node_id, row, rnd, key):
+        do = (rnd % cfg.periodic_interval) == 0
+        em = self._gossip_all(row, node_id)
+        return row, em.replace(valid=em.valid & do)
+
+    def handle_gossip(self, cfg, node_id, row, m, key):
+        adds = row.adds | m.data["adds"]
+        rems = row.rems | m.data["rems"]
+        changed = jnp.any((adds != row.adds) | (rems != row.rems))
+        row = row.replace(adds=adds, rems=rems)
+        em = self._gossip_all(row, node_id)
+        # equal state -> convergence, stop re-gossiping (full :99-116)
+        return row, em.replace(valid=em.valid & changed)
+
+    def handle_ctl_join(self, cfg, node_id, row, m, key):
+        """Control-plane join(peer): merge peer into my view and push my full
+        state at it — the {connected, ...} handshake collapsed to one message
+        (pluggable :986-1044 -> full :49-55)."""
+        peer = m.data["peer"]
+        row = row.replace(adds=bitset.add(row.adds, peer))
+        return row, self.emit(peer[None], self.typ("gossip"),
+                              adds=row.adds, rems=row.rems)
+
+    def handle_ctl_leave(self, cfg, node_id, row, m, key):
+        """leave(target): rmv mutation gossiped to everyone (full :58-89)."""
+        target = m.data["peer"]
+        row = row.replace(rems=bitset.add(row.rems, target))
+        return row, self._gossip_all(row, node_id)

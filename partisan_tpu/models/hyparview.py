@@ -1,0 +1,429 @@
+"""HyParView partial-view membership — the TPU-native rebuild of
+``src/partisan_hyparview_peer_service_manager.erl``.
+
+Per-node state mirrors hyparview :88-101: a small *active* view (symmetric,
+used for dissemination; cap ``max_active_size`` 6), a larger *passive* view
+(backup peers; cap ``max_passive_size`` 30), an epoch counter, and
+epoch-scoped disconnect-id maps used to reject stale view operations after
+churn (:1622-1676 — "load-bearing for churn correctness", SURVEY §7.3).
+
+Protocol messages, one handler per wire tag (reference handler sites cited):
+  join              :703-771   add joiner to active (evict + disconnect when
+                               full), reply neighbor, fan forward_join walks
+  forward_join      :808-923   ARWL-TTL random walk; accept at TTL 0 or when
+                               nearly isolated; passive-add at TTL == PRWL
+                               (inert under the 5/30 config defaults, exactly
+                               as in the reference — ARWL < PRWL means the
+                               check never fires; passive fills via shuffle)
+  neighbor          :774-805   symmetric active add
+  disconnect        :926-972   id-validated removal, demote to passive
+  neighbor_request  :975-1089  promotion handshake with priority + shuffle
+  neighbor_accepted            exchange piggyback
+  neighbor_rejected
+  shuffle           :1091-1136 TTL walk carrying a mixed active/passive sample
+  shuffle_reply                equal-size passive sample back to the origin
+  (+ ctl_join / ctl_leave control verbs)
+
+Timers (reference: per-node erlang timers; here: staggered round ticks):
+  shuffle every ``shuffle_interval`` (:27, 572-607), random passive->active
+  promotion every ``random_promotion_interval`` while under min_active
+  (:28, 542-561).  The reactive on-EXIT promotion (:609-654) has no analog —
+  links cannot fail independently in the simulator; the promotion timer plus
+  the churn generator's epoch bumps cover the same repair behavior.
+
+Random walks are one network hop per round: a walk message re-emits itself
+with TTL-1, matching the reference's actual message behavior rather than its
+code shape (SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import padded_set as ps
+from ..ops.msg import Msgs
+from .. import prng
+
+HIGH, LOW = 1, 0
+_DC_SLOTS = 16      # direct-mapped disconnect-id map size (peer % slots)
+_EPOCH_SHIFT = 12   # disconnect id = epoch << 12 | counter
+
+
+@struct.dataclass
+class HvState:
+    active: jax.Array        # [N, A] padded peer set
+    active_ttl: jax.Array    # [N, A] keepalive countdown per active slot
+    passive: jax.Array       # [N, P] padded peer set
+    epoch: jax.Array         # [N] int32, bumped on (re)start / churn
+    dc_cnt: jax.Array        # [N] int32, per-node disconnect counter
+    contact: jax.Array       # [N] int32 join contact, re-tried while isolated
+    left: jax.Array          # [N] bool — gracefully departed, inert until rejoin
+    sent_dc_peer: jax.Array  # [N, D] who we last disconnected (map keys)
+    sent_dc_id: jax.Array    # [N, D] with which id (map values)
+    recv_dc_peer: jax.Array  # [N, D]
+    recv_dc_id: jax.Array    # [N, D]
+
+
+# ---- direct-mapped (peer -> id) maps; collisions overwrite, degrading to
+# ---- the permissive "no record" default — an explicit approximation of the
+# ---- reference's unbounded per-peer maps (hyparview :81-101).
+
+def _dc_get(peers: jax.Array, ids: jax.Array, p: jax.Array) -> jax.Array:
+    slot = jnp.where(p >= 0, p % _DC_SLOTS, 0)
+    hit = (peers[slot] == p) & (p >= 0)
+    return jnp.where(hit, ids[slot], -1)
+
+
+def _dc_put(peers: jax.Array, ids: jax.Array, p: jax.Array, i: jax.Array):
+    slot = jnp.where(p >= 0, p % _DC_SLOTS, 0)
+    do = p >= 0
+    return (peers.at[slot].set(jnp.where(do, p, peers[slot])),
+            ids.at[slot].set(jnp.where(do, i, ids[slot])))
+
+
+class HyParView(ProtocolBase):
+    msg_types = ("join", "forward_join", "neighbor", "disconnect",
+                 "neighbor_request", "neighbor_accepted", "neighbor_rejected",
+                 "shuffle", "shuffle_reply", "keepalive",
+                 "ctl_join", "ctl_leave")
+    ctl_peer_field = "joiner"
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.S = 1 + cfg.shuffle_k_active + cfg.shuffle_k_passive
+        self.data_spec: Dict = {
+            "joiner": ((), jnp.int32),
+            "ttl": ((), jnp.int32),
+            "id": ((), jnp.int32),      # disconnect id
+            "prio": ((), jnp.int32),
+            "dcid": ((), jnp.int32),    # sender's last-received dc id for dst
+            "origin": ((), jnp.int32),  # shuffle originator
+            "sample": ((self.S,), jnp.int32),
+        }
+        # join: 1 neighbor + (A-1) forward_joins + 1 eviction disconnect
+        self.emit_cap = max(cfg.max_active_size + 2, 8)
+        # shuffle + promotion + join-retry + keepalives to all active
+        self.tick_emit_cap = cfg.max_active_size + 3
+
+    # ------------------------------------------------------------------ state
+
+    def init(self, cfg: Config, key: jax.Array) -> HvState:
+        n = cfg.n_nodes
+        d = _DC_SLOTS
+        return HvState(
+            active=jnp.full((n, cfg.max_active_size), -1, jnp.int32),
+            active_ttl=jnp.zeros((n, cfg.max_active_size), jnp.int32),
+            passive=jnp.full((n, cfg.max_passive_size), -1, jnp.int32),
+            epoch=jnp.ones((n,), jnp.int32),
+            dc_cnt=jnp.zeros((n,), jnp.int32),
+            contact=jnp.full((n,), -1, jnp.int32),
+            left=jnp.zeros((n,), bool),
+            sent_dc_peer=jnp.full((n, d), -1, jnp.int32),
+            sent_dc_id=jnp.full((n, d), -1, jnp.int32),
+            recv_dc_peer=jnp.full((n, d), -1, jnp.int32),
+            recv_dc_id=jnp.full((n, d), -1, jnp.int32),
+        )
+
+    def member_mask(self, row: HvState) -> jax.Array:
+        """Active-view one-hot (the manager's members/0 = active view)."""
+        n = self.cfg.n_nodes
+        m = jnp.zeros((n,), bool)
+        return m.at[jnp.clip(row.active, 0, n - 1)].max(row.active >= 0)
+
+    # ------------------------------------------------------------- primitives
+
+    def _is_addable(self, row: HvState, peer: jax.Array,
+                    msg_dcid: jax.Array) -> jax.Array:
+        """Refuse to re-add a peer that has not yet seen our latest
+        disconnect to it (the is_addable epoch/id gate, hyparview
+        :1656-1676): addable iff we never disconnected it, or the peer's
+        message echoes an id >= our last sent one."""
+        mine = _dc_get(row.sent_dc_peer, row.sent_dc_id, peer)
+        return (peer >= 0) & ((mine < 0) | (msg_dcid >= mine))
+
+    def _my_dcid_for(self, row: HvState, peer: jax.Array) -> jax.Array:
+        """What we echo in join/neighbor messages: the last disconnect id we
+        received FROM ``peer`` (proof we have seen it)."""
+        return _dc_get(row.recv_dc_peer, row.recv_dc_id, peer)
+
+    def _reset_ttl(self, cfg, row: HvState, peer: jax.Array) -> HvState:
+        """Refresh the keepalive countdown on peer's active slot."""
+        hit = (row.active == peer) & (peer >= 0)
+        return row.replace(active_ttl=jnp.where(
+            hit, cfg.keepalive_ttl, row.active_ttl))
+
+    def _add_active(self, cfg, me, row: HvState, peer: jax.Array,
+                    key: jax.Array):
+        """add_to_active_view (:1371-1420 + eviction :1466-1512): insert
+        peer; when full, evict a uniformly random victim, demote it to the
+        passive view and emit a ``disconnect`` with a fresh epoch-scoped id.
+
+        Returns (row, dc_dst, dc_id): dc_dst = -1 when nothing was evicted.
+        """
+        ok = (peer >= 0) & (peer != me) & ~row.left
+        peer = jnp.where(ok, peer, -1)
+        row = row.replace(passive=ps.remove(row.passive, peer))
+        new_active, evicted, _ = ps.insert_evict(row.active, peer, key)
+        row = row.replace(active=new_active)
+        row = self._reset_ttl(cfg, row, peer)
+        # demote the victim (disconnected peers land in passive, :926-972)
+        k2 = prng.decision_key(key, 1)
+        row = self._add_passive(cfg, me, row, evicted, k2)
+        new_id = (row.epoch << _EPOCH_SHIFT) | (row.dc_cnt & ((1 << _EPOCH_SHIFT) - 1))
+        did_evict = evicted >= 0
+        sp, si = _dc_put(row.sent_dc_peer, row.sent_dc_id,
+                         jnp.where(did_evict, evicted, -1), new_id)
+        row = row.replace(
+            sent_dc_peer=sp, sent_dc_id=si,
+            dc_cnt=row.dc_cnt + did_evict.astype(jnp.int32),
+        )
+        return row, jnp.where(did_evict, evicted, -1), new_id
+
+    def _add_passive(self, cfg, me, row: HvState, peer: jax.Array,
+                     key: jax.Array) -> HvState:
+        """add_to_passive_view (:1422-1448): only if not myself and not in
+        either view; evict a random passive member when full."""
+        ok = ((peer >= 0) & (peer != me)
+              & ~ps.contains(row.active, peer)
+              & ~ps.contains(row.passive, peer))
+        peer = jnp.where(ok, peer, -1)
+        new_passive, _, _ = ps.insert_evict(row.passive, peer, key)
+        return row.replace(passive=new_passive)
+
+    def _merge_exchange(self, cfg, me, row: HvState, sample: jax.Array,
+                        key: jax.Array) -> HvState:
+        """merge_exchange (:1589-1595): fold a received sample into the
+        passive view."""
+        for j in range(sample.shape[0]):  # static unroll, S is tiny
+            row = self._add_passive(cfg, me, row, sample[j],
+                                    prng.decision_key(key, 10 + j))
+        return row
+
+    def _shuffle_sample(self, cfg, me, row: HvState, key: jax.Array) -> jax.Array:
+        """self ++ k_active of active ++ k_passive of passive (:572-607)."""
+        ka = ps.random_k(row.active, prng.decision_key(key, 20),
+                         cfg.shuffle_k_active)
+        kp = ps.random_k(row.passive, prng.decision_key(key, 21),
+                         cfg.shuffle_k_passive)
+        return jnp.concatenate([me[None].astype(jnp.int32), ka, kp])
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_join(self, cfg, me, row: HvState, m: Msgs, key: jax.Array):
+        peer = m.src
+        addable = self._is_addable(row, peer, m.data["dcid"])
+        row2, dc_dst, dc_id = self._add_active(
+            cfg, me, row, jnp.where(addable, peer, -1), key)
+        # fan the walk to every *other* active member (:738-753)
+        others = jnp.where(row2.active == peer, -1, row2.active)
+        fj = self.emit(others, self.typ("forward_join"),
+                       valid=jnp.broadcast_to(addable, others.shape),
+                       joiner=peer, ttl=cfg.arwl)
+        nb = self.emit(jnp.where(addable, peer, -1)[None], self.typ("neighbor"),
+                       dcid=self._my_dcid_for(row2, peer))
+        dc = self.emit(dc_dst[None], self.typ("disconnect"), id=dc_id)
+        return row2, self.merge(nb, dc, fj)
+
+    def handle_forward_join(self, cfg, me, row: HvState, m: Msgs, key):
+        joiner, ttl, sender = m.data["joiner"], m.data["ttl"], m.src
+        not_me = joiner != me
+        accept = ((ttl <= 0) | (ps.size(row.active) <= 1)) & not_me
+        addable = joiner >= 0  # walks carry no dcid echo; permissive add
+        do_add = accept & addable
+        row2, dc_dst, dc_id = self._add_active(
+            cfg, me, row, jnp.where(do_add, joiner, -1), key)
+        nb = self.emit(jnp.where(do_add, joiner, -1)[None],
+                       self.typ("neighbor"),
+                       dcid=self._my_dcid_for(row2, joiner))
+        dc = self.emit(dc_dst[None], self.typ("disconnect"), id=dc_id)
+        # passive add at TTL == PRWL (:859-866; inert when ARWL < PRWL)
+        at_prwl = (~accept) & (ttl == cfg.prwl) & not_me
+        row3 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(at_prwl, a, b),
+            self._add_passive(cfg, me, row2, joiner,
+                              prng.decision_key(key, 3)), row2)
+        # else: keep walking to a random active peer != sender/joiner/me
+        nxt = ps.random_member(row3.active, prng.decision_key(key, 4),
+                               exclude=jnp.stack([sender, joiner, me]))
+        walk_on = (~accept) & not_me & (nxt >= 0)
+        fj = self.emit(jnp.where(walk_on, nxt, -1)[None],
+                       self.typ("forward_join"),
+                       joiner=joiner, ttl=jnp.maximum(ttl - 1, 0))
+        # dead-end walk (no eligible next hop): accept locally (:819-854)
+        dead_end = (~accept) & not_me & (nxt < 0)
+        row4, dc_dst2, dc_id2 = self._add_active(
+            cfg, me, row3, jnp.where(dead_end, joiner, -1),
+            prng.decision_key(key, 5))
+        nb2 = self.emit(jnp.where(dead_end, joiner, -1)[None],
+                        self.typ("neighbor"),
+                        dcid=self._my_dcid_for(row4, joiner))
+        dc2 = self.emit(dc_dst2[None], self.typ("disconnect"), id=dc_id2)
+        return row4, self.merge(nb, dc, fj, nb2, dc2)
+
+    def handle_neighbor(self, cfg, me, row: HvState, m: Msgs, key):
+        peer = m.src
+        addable = self._is_addable(row, peer, m.data["dcid"])
+        row2, dc_dst, dc_id = self._add_active(
+            cfg, me, row, jnp.where(addable, peer, -1), key)
+        dc = self.emit(dc_dst[None], self.typ("disconnect"), id=dc_id)
+        return row2, dc
+
+    def handle_disconnect(self, cfg, me, row: HvState, m: Msgs, key):
+        peer, mid = m.src, m.data["id"]
+        last = _dc_get(row.recv_dc_peer, row.recv_dc_id, peer)
+        valid = mid > last  # monotone id gate (is_valid_disconnect, :1622-1655)
+        rp, ri = _dc_put(row.recv_dc_peer, row.recv_dc_id,
+                         jnp.where(valid, peer, -1), mid)
+        row = row.replace(recv_dc_peer=rp, recv_dc_id=ri)
+        row = row.replace(active=jnp.where(
+            valid & (row.active == peer), -1, row.active))
+        row = self._add_passive(cfg, me, row, jnp.where(valid, peer, -1), key)
+        return row, self.no_emit()
+
+    def handle_neighbor_request(self, cfg, me, row: HvState, m: Msgs, key):
+        peer, prio = m.src, m.data["prio"]
+        row = self._merge_exchange(cfg, me, row, m.data["sample"],
+                                   prng.decision_key(key, 6))
+        addable = self._is_addable(row, peer, m.data["dcid"])
+        room = ps.size(row.active) < cfg.max_active_size
+        accept = addable & ~row.left & ((prio == HIGH) | room)
+        row2, dc_dst, dc_id = self._add_active(
+            cfg, me, row, jnp.where(accept, peer, -1), key)
+        reply_t = jnp.where(accept, self.typ("neighbor_accepted"),
+                            self.typ("neighbor_rejected"))
+        sample = self._shuffle_sample(cfg, me, row2, prng.decision_key(key, 7))
+        rep = self.emit(peer[None], reply_t, sample=sample,
+                        dcid=self._my_dcid_for(row2, peer))
+        dc = self.emit(dc_dst[None], self.typ("disconnect"), id=dc_id)
+        return row2, self.merge(rep, dc)
+
+    def handle_neighbor_accepted(self, cfg, me, row: HvState, m: Msgs, key):
+        peer = m.src
+        addable = self._is_addable(row, peer, m.data["dcid"])
+        row = self._merge_exchange(cfg, me, row, m.data["sample"],
+                                   prng.decision_key(key, 8))
+        row2, dc_dst, dc_id = self._add_active(
+            cfg, me, row, jnp.where(addable, peer, -1), key)
+        dc = self.emit(dc_dst[None], self.typ("disconnect"), id=dc_id)
+        return row2, dc
+
+    def handle_neighbor_rejected(self, cfg, me, row: HvState, m: Msgs, key):
+        # the promotion timer will try another candidate (:1015-1046)
+        return row, self.no_emit()
+
+    def handle_shuffle(self, cfg, me, row: HvState, m: Msgs, key):
+        origin, ttl, sender = m.data["origin"], m.data["ttl"], m.src
+        nxt = ps.random_member(row.active, prng.decision_key(key, 9),
+                               exclude=jnp.stack([origin, sender, me]))
+        walk = (ttl > 0) & (nxt >= 0) & (origin != me)
+        fwd = self.emit(jnp.where(walk, nxt, -1)[None], self.typ("shuffle"),
+                        origin=origin, ttl=ttl - 1, sample=m.data["sample"])
+        # accept: reply an equal-size passive sample to origin, merge theirs
+        acc = ~walk & (origin != me)
+        reply_sample = ps.random_k(row.passive, prng.decision_key(key, 10),
+                                   self.S)
+        rep = self.emit(jnp.where(acc, origin, -1)[None],
+                        self.typ("shuffle_reply"), sample=reply_sample)
+        row2 = self._merge_exchange(cfg, me, row, jnp.where(
+            acc, m.data["sample"], -1), prng.decision_key(key, 11))
+        return row2, self.merge(fwd, rep)
+
+    def handle_shuffle_reply(self, cfg, me, row: HvState, m: Msgs, key):
+        row = self._merge_exchange(cfg, me, row, m.data["sample"], key)
+        return row, self.no_emit()
+
+    def handle_keepalive(self, cfg, me, row: HvState, m: Msgs, key):
+        """Active-link liveness (the TCP-keepalive / EXIT-prune analog,
+        partisan_socket.erl:17-19 + pluggable :971-984).  A keepalive from a
+        current active peer refreshes its slot TTL; one from a peer that
+        believes we are ITS active neighbor but is not in ours re-adds it
+        when there is room (no eviction — avoids repair cascades), healing
+        one-sided edges left by dropped disconnects."""
+        peer = m.src
+        present = ps.contains(row.active, peer)
+        row = self._reset_ttl(cfg, row, jnp.where(present, peer, -1))
+        room = ps.size(row.active) < cfg.max_active_size
+        readd = (~present) & room & self._is_addable(row, peer, m.data["dcid"])
+        row2, _, _ = self._add_active(cfg, me, row,
+                                      jnp.where(readd, peer, -1), key)
+        return row2, self.no_emit()
+
+    def handle_ctl_join(self, cfg, me, row: HvState, m: Msgs, key):
+        """Remember the contact and send join; the tick re-sends while the
+        active view is empty — the connection-retry loop of the reference
+        (pluggable :944-969, 1 s tick) that makes join storms safe under
+        inbox overflow."""
+        peer = m.data["joiner"]
+        row = row.replace(contact=jnp.where(peer == me, row.contact, peer),
+                          left=jnp.where(peer == me, row.left, False))
+        return row, self.emit(peer[None], self.typ("join"),
+                              dcid=self._my_dcid_for(row, peer))
+
+    def handle_ctl_leave(self, cfg, me, row: HvState, m: Msgs, key):
+        """Graceful leave: disconnect every active peer and clear views."""
+        new_id = (row.epoch << _EPOCH_SHIFT) | (row.dc_cnt & ((1 << _EPOCH_SHIFT) - 1))
+        dc = self.emit(row.active, self.typ("disconnect"), id=new_id)
+        row = row.replace(
+            active=jnp.full_like(row.active, -1),
+            passive=jnp.full_like(row.passive, -1),
+            contact=jnp.full_like(row.contact, -1),
+            left=jnp.ones_like(row.left),
+            dc_cnt=row.dc_cnt + 1,
+        )
+        return row, dc
+
+    # ------------------------------------------------------------------ timer
+
+    def tick(self, cfg, me, row: HvState, rnd, key):
+        # -- failure detection: age active slots; expired links are demoted
+        #    to passive (the EXIT-prune path, pluggable :971-984, hyparview
+        #    :609-654 — here triggered by keepalive silence, not socket death)
+        occupied = row.active >= 0
+        ttl = jnp.where(occupied, row.active_ttl - 1, 0)
+        expired = occupied & (ttl <= 0)
+        expired_peers = jnp.where(expired, row.active, -1)
+        row = row.replace(active=jnp.where(expired, -1, row.active),
+                          active_ttl=ttl)
+        for j in range(expired_peers.shape[0]):  # static unroll over A slots
+            row = self._add_passive(cfg, me, row, expired_peers[j],
+                                    prng.decision_key(key, 40 + j))
+        # staggered by node id: ~N/interval nodes fire per round, avoiding
+        # the synchronized-storm artifact of a global phase
+        stay = ~row.left
+        shuffle_due = (((rnd + me) % cfg.shuffle_interval) == 0) & stay
+        promo_due = (((rnd + me) % cfg.random_promotion_interval) == 0) & stay
+
+        tgt = ps.random_member(row.active, prng.decision_key(key, 12))
+        sample = self._shuffle_sample(cfg, me, row, key)
+        sh = self.emit(jnp.where(shuffle_due, tgt, -1)[None],
+                       self.typ("shuffle"), cap=self.tick_emit_cap,
+                       origin=me, ttl=cfg.arwl, sample=sample)
+
+        under = ps.size(row.active) < cfg.min_active_size
+        cand = ps.random_member(row.passive, prng.decision_key(key, 13))
+        prio = jnp.where(ps.size(row.active) == 0, HIGH, LOW)
+        nr = self.emit(jnp.where(promo_due & under, cand, -1)[None],
+                       self.typ("neighbor_request"), cap=self.tick_emit_cap,
+                       prio=prio, sample=sample,
+                       dcid=self._my_dcid_for(row, cand))
+
+        # join retry while isolated (connection retry, pluggable :944-969)
+        retry_due = (((rnd % cfg.connection_retry_interval) == 0) & stay
+                     & (ps.size(row.active) == 0) & (row.contact >= 0))
+        jn = self.emit(jnp.where(retry_due, row.contact, -1)[None],
+                       self.typ("join"), cap=self.tick_emit_cap,
+                       dcid=self._my_dcid_for(row, row.contact))
+
+        # keepalives to every active peer (failure-detection heartbeat)
+        ka_due = ((rnd % cfg.keepalive_interval) == 0) & stay
+        dcids = jax.vmap(lambda p: self._my_dcid_for(row, p))(row.active)
+        ka = self.emit(jnp.where(ka_due, row.active, -1),
+                       self.typ("keepalive"), cap=self.tick_emit_cap,
+                       dcid=dcids)
+        return row, self.merge(sh, nr, jn, ka, cap=self.tick_emit_cap)

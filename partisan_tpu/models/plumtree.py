@@ -1,0 +1,226 @@
+"""Plumtree epidemic broadcast trees — TPU-native rebuild of
+``src/partisan_plumtree_broadcast.erl``, run as an upper layer over a
+membership protocol via :class:`~partisan_tpu.models.stack.Stacked`.
+
+Semantics mirrored (reference sites):
+  * per-root eager/lazy peer sets (:59-111), defaulting eager to the current
+    membership peer set when a root is first seen (:652-659);
+  * broadcast -> eager_push to eager peers + lazy ``i_have`` scheduling
+    (:176-178, 282-287, 425-441) — lazy pushes ride the engine's ``delay``
+    field with ``lazy_tick_period`` rounds, replacing the 1 s lazy timer;
+  * fresh merge => graft sender eager + re-push round+1 (:288-298, 374-378);
+    stale => prune sender to lazy + send ``prune`` (:368-373);
+  * ``i_have`` of a missing message => ``graft`` + eager (:299-307, 380-386)
+    (the reference defers the graft behind a timer round; one simulation
+    round plays that role);
+  * ``graft`` => re-send the broadcast (:308-313, 388-402);
+  * periodic anti-entropy ``exchange`` with a random peer every
+    ``exchange_tick_period`` (:346-350, 455-485).
+
+The broadcast *handler* (the `partisan_plumtree_broadcast_handler` behaviour
+:26-43) is fixed to the framework's default backend semantics
+(``partisan_plumtree_backend``: monotonically-timestamped per-key values,
+heartbeat style :110-124): each node stores (seq, val) per key; ``merge`` =
+keep the higher seq; ``is_stale`` = seq <= known.  K keys are tracked
+(single-key anti-entropy, BASELINE #3, is K=1).
+
+Tree state is root-bucketed: a direct-mapped table of R root slots (root id
+modulo R); collision evicts the older tree, which then lazily rebuilds from
+membership — an explicit fixed-shape approximation of the reference's
+unbounded per-root dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..ops import padded_set as ps
+from ..ops.msg import Msgs
+from .. import prng
+from .stack import StackState, UpperProtocol
+
+
+@struct.dataclass
+class PtState:
+    root_key: jax.Array   # [N, R] which root owns each tree bucket (-1 free)
+    eager: jax.Array      # [N, R, A] eager peer set per root bucket
+    lazy: jax.Array       # [N, R, A] lazy peer set per root bucket
+    seq: jax.Array        # [N, K] highest seq delivered per key
+    val: jax.Array        # [N, K] value at that seq
+    next_seq: jax.Array   # [N] local broadcast seq source
+
+
+class Plumtree(UpperProtocol):
+    msg_types = ("bcast", "i_have", "graft", "prune", "exchange",
+                 "ctl_pt_broadcast")
+
+    def __init__(self, cfg: Config, n_keys: int = 1, n_roots: int = 4):
+        self.cfg = cfg
+        self.K = n_keys
+        self.R = n_roots
+        self.A = cfg.max_active_size
+        self.data_spec: Dict = {
+            "pt_root": ((), jnp.int32),
+            "pt_key": ((), jnp.int32),
+            "pt_seq": ((), jnp.int32),
+            "pt_val": ((), jnp.int32),
+            "pt_round": ((), jnp.int32),  # tree-depth counter (:282-287)
+        }
+        self.emit_cap = cfg.max_active_size + 2
+        self.tick_emit_cap = 1
+
+    def init_upper(self, cfg: Config, key: jax.Array) -> PtState:
+        n = cfg.n_nodes
+        return PtState(
+            root_key=jnp.full((n, self.R), -1, jnp.int32),
+            eager=jnp.full((n, self.R, self.A), -1, jnp.int32),
+            lazy=jnp.full((n, self.R, self.A), -1, jnp.int32),
+            seq=jnp.zeros((n, self.K), jnp.int32),
+            val=jnp.zeros((n, self.K), jnp.int32),
+            next_seq=jnp.zeros((n,), jnp.int32),
+        )
+
+    # ------------------------------------------------------- tree primitives
+
+    def _bucket(self, up: PtState, root: jax.Array, peers: jax.Array):
+        """Locate (allocating if needed) the tree bucket for ``root``.
+        Returns (state, slot, eager_row, lazy_row).  A fresh bucket starts
+        with eager = current membership peers, lazy = {} (:652-659)."""
+        slot = jnp.where(root >= 0, root % self.R, 0)
+        owned = up.root_key[slot] == root
+        fresh_eager = peers
+        eager = jnp.where(owned, up.eager[slot], fresh_eager)
+        lazy = jnp.where(owned, up.lazy[slot], -1)
+        up = up.replace(
+            root_key=up.root_key.at[slot].set(jnp.where(root >= 0, root,
+                                                        up.root_key[slot])))
+        return up, slot, eager, lazy
+
+    def _store(self, up: PtState, slot, eager, lazy) -> PtState:
+        return up.replace(eager=up.eager.at[slot].set(eager),
+                          lazy=up.lazy.at[slot].set(lazy))
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_bcast(self, cfg, me, row: StackState, m: Msgs, key):
+        up = row.upper
+        k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
+        seq, val, root = m.data["pt_seq"], m.data["pt_val"], m.data["pt_root"]
+        fresh = seq > up.seq[k]
+
+        peers = self.active_peers(row)
+        up, slot, eager, lazy = self._bucket(up, root, peers)
+        # fresh: deliver, graft sender eager, push round+1 to other eagers,
+        # schedule lazy i_haves (delayed by lazy_tick_period)
+        up = up.replace(seq=up.seq.at[k].set(jnp.where(fresh, seq, up.seq[k])),
+                        val=up.val.at[k].set(jnp.where(fresh, val, up.val[k])))
+        eager_f = ps.insert(eager, jnp.where(fresh, m.src, -1))
+        lazy_f = ps.remove(lazy, jnp.where(fresh, m.src, -1))
+        # stale: prune sender to lazy (:368-373)
+        stale = ~fresh & (m.src >= 0)
+        eager_s = ps.remove(eager_f, jnp.where(stale, m.src, -1))
+        lazy_s = ps.insert(lazy_f, jnp.where(stale, m.src, -1))
+        up = self._store(up, slot, eager_s, lazy_s)
+
+        push_to = jnp.where(fresh, jnp.where(eager_s == m.src, -1, eager_s), -1)
+        push = self.emit(push_to, self.typ("bcast"), pt_root=root, pt_key=k,
+                         pt_seq=seq, pt_val=val,
+                         pt_round=m.data["pt_round"] + 1)
+        ih_to = jnp.where(fresh, jnp.where(lazy_s == m.src, -1, lazy_s), -1)
+        ihave = self.emit(ih_to, self.typ("i_have"),
+                          cap=self.emit_cap,
+                          delay=cfg.lazy_tick_period,
+                          pt_root=root, pt_key=k, pt_seq=seq)
+        prune = self.emit(jnp.where(stale, m.src, -1)[None],
+                          self.typ("prune"), pt_root=root)
+        return self.up(row, up), self.merge(push, ihave, prune)
+
+    def handle_i_have(self, cfg, me, row: StackState, m: Msgs, key):
+        up = row.upper
+        k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
+        missing = m.data["pt_seq"] > up.seq[k]
+        peers = self.active_peers(row)
+        up, slot, eager, lazy = self._bucket(up, m.data["pt_root"], peers)
+        eager2 = ps.insert(eager, jnp.where(missing, m.src, -1))
+        lazy2 = ps.remove(lazy, jnp.where(missing, m.src, -1))
+        up = self._store(up, slot, eager2, lazy2)
+        graft = self.emit(jnp.where(missing, m.src, -1)[None],
+                          self.typ("graft"),
+                          pt_root=m.data["pt_root"], pt_key=k,
+                          pt_seq=m.data["pt_seq"])
+        return self.up(row, up), graft
+
+    def handle_graft(self, cfg, me, row: StackState, m: Msgs, key):
+        up = row.upper
+        k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
+        peers = self.active_peers(row)
+        up, slot, eager, lazy = self._bucket(up, m.data["pt_root"], peers)
+        eager2 = ps.insert(eager, m.src)
+        lazy2 = ps.remove(lazy, m.src)
+        up = self._store(up, slot, eager2, lazy2)
+        # re-send the broadcast we hold for this key (:388-402)
+        resend = self.emit(m.src[None], self.typ("bcast"),
+                           pt_root=m.data["pt_root"], pt_key=k,
+                           pt_seq=up.seq[k], pt_val=up.val[k], pt_round=0)
+        return self.up(row, up), resend
+
+    def handle_prune(self, cfg, me, row: StackState, m: Msgs, key):
+        up = row.upper
+        peers = self.active_peers(row)
+        up, slot, eager, lazy = self._bucket(up, m.data["pt_root"], peers)
+        up = self._store(up, slot, ps.remove(eager, m.src),
+                         ps.insert(lazy, m.src))
+        return self.up(row, up), self.no_emit()
+
+    def handle_exchange(self, cfg, me, row: StackState, m: Msgs, key):
+        """Push-pull anti-entropy on the key store (:455-485): adopt the
+        newer (seq, val); reply with mine when mine is newer."""
+        up = row.upper
+        k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
+        theirs_newer = m.data["pt_seq"] > up.seq[k]
+        mine_newer = up.seq[k] > m.data["pt_seq"]
+        up = up.replace(
+            seq=up.seq.at[k].set(jnp.where(theirs_newer, m.data["pt_seq"],
+                                           up.seq[k])),
+            val=up.val.at[k].set(jnp.where(theirs_newer, m.data["pt_val"],
+                                           up.val[k])))
+        rep = self.emit(jnp.where(mine_newer, m.src, -1)[None],
+                        self.typ("exchange"), pt_key=k,
+                        pt_seq=up.seq[k], pt_val=up.val[k])
+        return self.up(row, up), rep
+
+    def handle_ctl_pt_broadcast(self, cfg, me, row: StackState, m: Msgs, key):
+        """broadcast/2 (:176-178): stamp a fresh (seq, val) for the key and
+        eager-push with root = me."""
+        up = row.upper
+        k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
+        seq = jnp.maximum(up.next_seq, up.seq[k]) + 1
+        up = up.replace(seq=up.seq.at[k].set(seq),
+                        val=up.val.at[k].set(m.data["pt_val"]),
+                        next_seq=seq)
+        peers = self.active_peers(row)
+        up, slot, eager, lazy = self._bucket(up, jnp.int32(0) + me, peers)
+        up = self._store(up, slot, eager, lazy)
+        push = self.emit(eager, self.typ("bcast"), pt_root=me, pt_key=k,
+                         pt_seq=seq, pt_val=m.data["pt_val"], pt_round=0)
+        ihave = self.emit(lazy, self.typ("i_have"), cap=self.emit_cap,
+                          delay=cfg.lazy_tick_period,
+                          pt_root=me, pt_key=k, pt_seq=seq)
+        return self.up(row, up), self.merge(push, ihave)
+
+    # ------------------------------------------------------------------ timer
+
+    def tick_upper(self, cfg, me, row: StackState, rnd, key):
+        """exchange_tick (:346-350): anti-entropy with one random peer."""
+        due = ((rnd + me) % cfg.exchange_tick_period) == 0
+        peer = ps.random_member(self.active_peers(row), key)
+        up = row.upper
+        em = self.emit(jnp.where(due, peer, -1)[None], self.typ("exchange"),
+                       cap=self.tick_emit_cap, pt_key=0,
+                       pt_seq=up.seq[0], pt_val=up.val[0])
+        return row, em

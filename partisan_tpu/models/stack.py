@@ -1,0 +1,107 @@
+"""Protocol stacking — running a dissemination protocol over a membership
+protocol in one fused step.
+
+In the reference, Plumtree is a separate gen_server that asks the manager for
+peers (``Manager:cast_message`` / ``broadcast_members``,
+src/partisan_plumtree_broadcast.erl:633-638) — processes compose at runtime.
+The TPU-native composition is *static*: :class:`Stacked` fuses a lower
+(membership) protocol and an upper (dissemination) protocol into ONE handler
+table and ONE state pytree, so a round of the combined system is still a
+single jitted step with no cross-protocol host hops.
+
+Contract:
+  * combined wire tags = lower.msg_types ++ upper.msg_types (upper handler
+    ``typ()`` lookups are offset automatically);
+  * payload specs are unioned (same-name fields must agree);
+  * lower handlers see only their own state (``row.lower``);
+  * upper handlers see the WHOLE row — they may read lower state (e.g. the
+    HyParView active view as the broadcast peer set) but only write
+    ``row.upper``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+
+
+@struct.dataclass
+class StackState:
+    lower: Any
+    upper: Any
+
+
+class UpperProtocol(ProtocolBase):
+    """Base for protocols that ride on a membership layer.  Handlers receive
+    the full StackState row; use `self.active_peers(row)` for the current
+    peer set and return rows via `self.up(row, new_upper)`."""
+
+    def up(self, row: StackState, new_upper: Any) -> StackState:
+        return row.replace(upper=new_upper)
+
+    def active_peers(self, row: StackState) -> jax.Array:
+        """Padded peer-id list from the lower layer (HyParView active view /
+        full-membership member list)."""
+        lower = row.lower
+        if hasattr(lower, "active"):
+            return lower.active
+        raise NotImplementedError(
+            "lower protocol exposes no peer set; override active_peers")
+
+
+class Stacked(ProtocolBase):
+    def __init__(self, lower: ProtocolBase, upper: UpperProtocol):
+        self.lower, self.upper = lower, upper
+        self.msg_types = tuple(lower.msg_types) + tuple(upper.msg_types)
+        spec = dict(lower.data_spec)
+        for k, v in upper.data_spec.items():
+            if k in spec and spec[k] != v:
+                raise ValueError(f"data field collision with different "
+                                 f"specs: {k}: {spec[k]} vs {v}")
+            spec[k] = v
+        self.data_spec = spec
+        self.emit_cap = max(lower.emit_cap, upper.emit_cap)
+        self.tick_emit_cap = lower.tick_emit_cap + upper.tick_emit_cap
+        self.ctl_peer_field = lower.ctl_peer_field
+        # rewire both sub-protocols to emit in the stacked message space
+        for sub, off in ((lower, 0), (upper, len(lower.msg_types))):
+            sub._typ_offset = off
+            sub.data_spec = spec
+            sub.emit_cap = self.emit_cap
+
+    def typ(self, name: str) -> int:
+        return self.msg_types.index(name)
+
+    def handlers(self) -> Tuple:
+        def wrap_lower(h):
+            def f(cfg, me, row, m, key):
+                lrow, em = h(cfg, me, row.lower, m, key)
+                return row.replace(lower=lrow), em
+            return f
+
+        lows = tuple(wrap_lower(getattr(self.lower, "handle_" + t))
+                     for t in self.lower.msg_types)
+        ups = tuple(getattr(self.upper, "handle_" + t)
+                    for t in self.upper.msg_types)
+        return lows + ups
+
+    def init(self, cfg: Config, key: jax.Array) -> StackState:
+        k1, k2 = jax.random.split(key)
+        return StackState(lower=self.lower.init(cfg, k1),
+                          upper=self.upper.init_upper(cfg, k2))
+
+    def tick(self, cfg, me, row: StackState, rnd, key):
+        k1, k2 = jax.random.split(key)
+        lrow, lem = self.lower.tick(cfg, me, row.lower, rnd, k1)
+        row = row.replace(lower=lrow)
+        row, uem = self.upper.tick_upper(cfg, me, row, rnd, k2)
+        return row, self.merge(lem, uem, cap=self.tick_emit_cap)
+
+    def member_mask(self, row: StackState) -> jax.Array:
+        return self.lower.member_mask(row.lower)

@@ -1,0 +1,1 @@
+from . import bitset, graph, msg, padded_set

@@ -1,0 +1,74 @@
+"""Dense membership bitsets, uint32-word packed.
+
+The reference's full-membership strategy gossips a ``state_orset`` CRDT
+(src/partisan_full_membership_strategy.erl:33) whose value is "the set of known
+node specs".  On TPU a set over the integer node-id universe [0, N) is a packed
+bitset row ``[W] uint32`` with ``W = ceil(N/32)``; CRDT merge is bitwise OR
+(grow-only cover of the orset add-path; removals are tracked separately as a
+second "tombstone" bitset, giving the classic 2P encoding of orset semantics
+for a fixed universe — adds win ties exactly as ``state_orset`` rmv-then-add
+does because a re-add sets a fresh bit in a fresh epoch plane, see
+models/full_membership.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+WORD = 32
+
+
+def n_words(n: int) -> int:
+    return (n + WORD - 1) // WORD
+
+
+def make(n: int) -> jax.Array:
+    return jnp.zeros((n_words(n),), dtype=jnp.uint32)
+
+
+def add(bs: jax.Array, i: jax.Array) -> jax.Array:
+    """Set bit i (no-op for i < 0)."""
+    word = jnp.where(i >= 0, i // WORD, 0)
+    bit = jnp.where(i >= 0, jnp.uint32(1) << jnp.uint32(i % WORD), jnp.uint32(0))
+    return bs.at[word].set(bs[word] | bit)
+
+
+def discard(bs: jax.Array, i: jax.Array) -> jax.Array:
+    word = jnp.where(i >= 0, i // WORD, 0)
+    bit = jnp.where(i >= 0, jnp.uint32(1) << jnp.uint32(i % WORD), jnp.uint32(0))
+    return bs.at[word].set(bs[word] & ~bit)
+
+
+def union(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a | b
+
+
+def difference(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a & ~b
+
+
+def contains(bs: jax.Array, i: jax.Array) -> jax.Array:
+    word = jnp.where(i >= 0, i // WORD, 0)
+    bit = jnp.uint32(1) << jnp.uint32(jnp.where(i >= 0, i % WORD, 0))
+    return (i >= 0) & ((bs[word] & bit) != 0)
+
+
+def count(bs: jax.Array) -> jax.Array:
+    # popcount via jnp.bitwise_count (available in jax>=0.4.27)
+    return jnp.sum(jnp.bitwise_count(bs)).astype(jnp.int32)
+
+
+def to_mask(bs: jax.Array, n: int) -> jax.Array:
+    """[n] bool — unpack (small-N debugging / assertions only)."""
+    idx = jnp.arange(n)
+    return (bs[idx // WORD] >> (idx % WORD).astype(jnp.uint32)) & 1 == 1
+
+
+def from_mask(mask: jax.Array) -> jax.Array:
+    n = mask.shape[0]
+    w = n_words(n)
+    pad = jnp.zeros((w * WORD,), dtype=jnp.uint32).at[:n].set(mask.astype(jnp.uint32))
+    pad = pad.reshape(w, WORD)
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    return jnp.sum(pad << shifts, axis=1, dtype=jnp.uint32)

@@ -1,0 +1,56 @@
+"""On-device topology verification.
+
+The reference asserts cluster health by building a digraph from every node's
+active view and checking all-pairs reachability plus view symmetry
+(``hyparview_membership_check``, test/partisan_SUITE.erl:2044-2109).  Here the
+same checks are batched array ops: adjacency from the padded views, BFS as
+repeated boolean matrix "multiplication" (O(log N) squarings), symmetry as a
+transpose compare.  Used by tests and by on-device convergence metrics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def adjacency_from_views(views: jax.Array, n: int) -> jax.Array:
+    """[N, C] padded views (-1 sentinel) -> [N, N] bool adjacency."""
+    src = jnp.repeat(jnp.arange(n), views.shape[1])
+    dst = views.reshape(-1)
+    ok = dst >= 0
+    adj = jnp.zeros((n, n), dtype=bool)
+    return adj.at[src, jnp.clip(dst, 0, n - 1)].max(ok)
+
+
+def reachability(adj: jax.Array) -> jax.Array:
+    """Transitive closure by squaring: [N, N] bool, reach[i, j] iff a path
+    i -> j exists (including i == j)."""
+    n = adj.shape[0]
+    reach = adj | jnp.eye(n, dtype=bool)
+    steps = max(1, int(jnp.ceil(jnp.log2(max(n, 2)))))
+    for _ in range(steps):
+        reach = reach | (reach @ reach)
+    return reach
+
+
+def is_connected(adj: jax.Array, alive: jax.Array | None = None) -> jax.Array:
+    """All-pairs reachability among ``alive`` nodes (default: all) over the
+    *undirected* closure of adj — the digraph check of partisan_SUITE:2044."""
+    n = adj.shape[0]
+    if alive is None:
+        alive = jnp.ones((n,), dtype=bool)
+    und = adj | adj.T
+    # restrict to alive subgraph
+    und = und & alive[:, None] & alive[None, :]
+    reach = reachability(und)
+    pair_ok = reach | ~alive[:, None] | ~alive[None, :]
+    return jnp.all(pair_ok)
+
+
+def is_symmetric(adj: jax.Array, alive: jax.Array | None = None) -> jax.Array:
+    """Active-view symmetry: i in active(j) iff j in active(i)
+    (partisan_SUITE:2083-2109)."""
+    if alive is not None:
+        adj = adj & alive[:, None] & alive[None, :]
+    return jnp.all(adj == adj.T)

@@ -1,0 +1,201 @@
+"""Message tensors and the routing kernel.
+
+One simulation round moves a flat struct-of-arrays message buffer (the COO
+analog of every in-flight TCP payload in the reference) from sources to
+destination inboxes.  This replaces the reference's whole transport stack —
+per-socket gen_servers (src/partisan_peer_connection.erl), the acceptor pool
+(src/partisan_pool.erl) and the connection registry
+(src/partisan_peer_service_connections.erl) — with one batched
+sort-and-scatter: messages are sorted by destination, each destination's first
+``cap`` messages land in its padded inbox ``[N, cap]`` and are then applied
+*sequentially per node* by the engine, which preserves Erlang's per-process
+mailbox semantics while batching across all N nodes.
+
+Core per-message fields:
+  valid    bool   — liveness of the slot
+  src/dst  int32  — virtual node ids
+  typ      int32  — protocol message tag (per-protocol enum)
+  channel  int32  — logical channel lane (partisan.hrl:17-19)
+  delay    int32  — rounds to hold before delivery (ingress/egress delay +
+                    the '$delay' interposition verb, pluggable :669-764)
+  data     dict   — protocol payload (int32/uint32 arrays, leading dim M)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class Msgs:
+    valid: jax.Array          # [M] bool
+    src: jax.Array            # [M] int32
+    dst: jax.Array            # [M] int32
+    typ: jax.Array            # [M] int32
+    channel: jax.Array        # [M] int32
+    delay: jax.Array          # [M] int32
+    data: Dict[str, jax.Array]  # each [M, ...]
+
+    @property
+    def cap(self) -> int:
+        return self.valid.shape[0]
+
+    def count(self) -> jax.Array:
+        return jnp.sum(self.valid).astype(jnp.int32)
+
+
+def empty(cap: int, data_spec: Dict[str, Tuple[Tuple[int, ...], Any]]) -> Msgs:
+    """An all-invalid buffer.  ``data_spec`` maps field name -> (trailing
+    shape, dtype); e.g. {"ttl": ((), jnp.int32), "sample": ((8,), jnp.int32)}.
+    """
+    z = jnp.zeros((cap,), dtype=jnp.int32)
+    return Msgs(
+        valid=jnp.zeros((cap,), dtype=bool),
+        src=z, dst=z, typ=z, channel=z, delay=z,
+        data={k: jnp.zeros((cap,) + tuple(shape), dtype=dt)
+              for k, (shape, dt) in data_spec.items()},
+    )
+
+
+def _take(m: Msgs, idx: jax.Array) -> Msgs:
+    return jax.tree_util.tree_map(lambda x: x[idx], m)
+
+
+def concat(*bufs: Msgs) -> Msgs:
+    return jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *bufs)
+
+
+def compact(m: Msgs, cap: int) -> Tuple[Msgs, jax.Array]:
+    """Pack valid messages to the front and truncate/pad to ``cap`` slots.
+    Returns (buffer, dropped_count) — overflow is counted, never silent
+    (SURVEY §7.3)."""
+    order = jnp.argsort(jnp.where(m.valid, 0, 1), stable=True)
+    n_valid = jnp.sum(m.valid)
+    src_cap = m.cap
+    if cap >= src_cap:
+        idx = jnp.concatenate([order, jnp.zeros((cap - src_cap,), order.dtype)])
+        keep_valid = jnp.arange(cap) < n_valid
+    else:
+        idx = order[:cap]
+        keep_valid = jnp.arange(cap) < jnp.minimum(n_valid, cap)
+    out = _take(m, idx)
+    out = out.replace(valid=keep_valid)
+    dropped = jnp.maximum(n_valid - cap, 0).astype(jnp.int32)
+    return out, dropped
+
+
+def build_inbox(
+    m: Msgs, n_nodes: int, inbox_cap: int,
+    key: Optional[jax.Array] = None,
+) -> Tuple[Msgs, Msgs, jax.Array]:
+    """Route a flat buffer into per-node inboxes.
+
+    Returns ``(inbox, held, overflow)`` where ``inbox`` has every array
+    reshaped to ``[N, inbox_cap, ...]``, ``held`` is a flat buffer (same cap as
+    ``m``) of messages with ``delay > 0`` — their delay decremented — to be
+    merged into the next round, and ``overflow`` counts messages dropped
+    because a destination inbox exceeded ``inbox_cap`` this round.
+
+    ``key`` randomizes delivery order within the round, modeling the
+    reference's nondeterministic network interleaving (the trace orchestrator's
+    whole job is taming exactly this, src/partisan_trace_orchestrator.erl);
+    with a fixed key the schedule is deterministic and replayable.
+    """
+    M = m.cap
+    deliver = m.valid & (m.delay <= 0)
+    held_valid = m.valid & (m.delay > 0)
+    held = m.replace(valid=held_valid, delay=jnp.maximum(m.delay - 1, 0))
+
+    if key is not None:
+        perm = jax.random.permutation(key, M)
+        ms = _take(m, perm)
+        deliver_s = deliver[perm]
+    else:
+        ms, deliver_s = m, deliver
+
+    sort_key = jnp.where(deliver_s, ms.dst, n_nodes)  # undeliverable -> end
+    order = jnp.argsort(sort_key, stable=True)
+    ms = _take(ms, order)
+    sdst = sort_key[order]
+
+    starts = jnp.searchsorted(sdst, jnp.arange(n_nodes), side="left")
+    pos = jnp.arange(M) - starts[jnp.clip(sdst, 0, n_nodes - 1)]
+    ok = (sdst < n_nodes) & (pos < inbox_cap)
+    overflow = jnp.sum((sdst < n_nodes) & (pos >= inbox_cap)).astype(jnp.int32)
+
+    dump = n_nodes * inbox_cap  # one trash slot for masked-out writes
+    flat_idx = jnp.where(ok, jnp.clip(sdst, 0, n_nodes - 1) * inbox_cap
+                         + jnp.clip(pos, 0, inbox_cap - 1), dump)
+
+    def scatter(x: jax.Array) -> jax.Array:
+        out = jnp.zeros((dump + 1,) + x.shape[1:], dtype=x.dtype)
+        out = out.at[flat_idx].set(x)
+        return out[:dump].reshape((n_nodes, inbox_cap) + x.shape[1:])
+
+    inbox = jax.tree_util.tree_map(scatter, ms)
+    inbox = inbox.replace(valid=scatter(ok))
+    return inbox, held, overflow
+
+
+def inject(buf: Msgs, em: Msgs, src) -> Tuple[Msgs, jax.Array]:
+    """Write the valid entries of ``em`` (control-plane commands, host-built)
+    into free slots of the in-flight buffer, stamping ``src``.  Returns
+    (new_buffer, n_dropped) — dropped when the buffer has no free slots."""
+    k = em.cap
+    free_idx, = jnp.nonzero(~buf.valid, size=k, fill_value=0)
+    n_free = jnp.sum(~buf.valid)
+    rank = jnp.cumsum(em.valid) - 1          # rank among valid entries
+    ok = em.valid & (rank < n_free)
+    em = em.replace(src=jnp.broadcast_to(jnp.asarray(src, jnp.int32), (k,)))
+    # the i-th valid entry takes the i-th free slot; masked writes are dumped
+    idx = jnp.where(ok, free_idx[jnp.clip(rank, 0, k - 1)], buf.cap)
+
+    def write(b: jax.Array, e: jax.Array) -> jax.Array:
+        pad = jnp.zeros((1,) + b.shape[1:], b.dtype)
+        return jnp.concatenate([b, pad]).at[idx].set(e)[: buf.cap]
+
+    out = jax.tree_util.tree_map(write, buf, em)
+    dropped = (jnp.sum(em.valid) - jnp.sum(ok)).astype(jnp.int32)
+    return out, dropped
+
+
+def reduce_to_nodes(
+    m: Msgs, n_nodes: int,
+    reducer: str = "or",
+    value_field: Optional[str] = None,
+) -> jax.Array:
+    """Commutative fast-path delivery: no sort, no per-slot loop — one
+    ``segment_sum``/``max``-style scatter by destination.  Correct whenever the
+    protocol's delivery effect is an idempotent/commutative merge (infection
+    spread, monotonic channels' keep-latest reduction, partisan.hrl:17-19 +
+    partisan_peer_connection.erl:82-100).  Returns a per-node ``[N]`` (or
+    ``[N, ...]`` when ``value_field`` is a vector field) reduction.
+    """
+    dump = n_nodes
+    dst = jnp.where(m.valid, m.dst, dump)
+    if value_field is None:
+        vals = m.valid
+    else:
+        vals = m.data[value_field]
+    if reducer == "or":
+        out = jnp.zeros((n_nodes + 1,) + vals.shape[1:], dtype=vals.dtype)
+        out = out.at[dst].max(vals)  # max == or for bool/uint
+    elif reducer == "sum":
+        out = jnp.zeros((n_nodes + 1,) + vals.shape[1:],
+                        dtype=jnp.promote_types(vals.dtype, jnp.int32))
+        out = out.at[dst].add(jnp.where(
+            m.valid.reshape((-1,) + (1,) * (vals.ndim - 1)), vals, 0))
+    elif reducer == "max":
+        if jnp.issubdtype(vals.dtype, jnp.integer) or vals.dtype == bool:
+            neutral = jnp.iinfo(vals.dtype).min if vals.dtype != bool else False
+        else:
+            neutral = -jnp.inf
+        out = jnp.full((n_nodes + 1,) + vals.shape[1:], neutral, dtype=vals.dtype)
+        out = out.at[dst].max(vals)
+    else:
+        raise ValueError(reducer)
+    return out[:n_nodes]

@@ -1,0 +1,116 @@
+"""Fixed-capacity padded integer sets with sentinel -1.
+
+These are the vectorized primitives behind the reference's view maintenance:
+``add_to_active_view`` with random eviction
+(src/partisan_hyparview_peer_service_manager.erl:1371-1420),
+``add_to_passive_view`` (:1422-1448), random peer selection (:1346-1361) and
+shuffle sampling (:572-607).  Every function operates on ONE row (a single
+node's view, shape ``[C]`` int32, empty slots are ``-1``) and is designed to be
+``vmap``-ped over the node axis.  All shapes are static; all control flow is
+``jnp.where``-style selects, so everything fuses under ``jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+EMPTY = -1
+
+
+def make(cap: int) -> jax.Array:
+    return jnp.full((cap,), EMPTY, dtype=jnp.int32)
+
+
+def valid_mask(s: jax.Array) -> jax.Array:
+    return s >= 0
+
+
+def size(s: jax.Array) -> jax.Array:
+    return jnp.sum(s >= 0).astype(jnp.int32)
+
+
+def contains(s: jax.Array, x: jax.Array) -> jax.Array:
+    return jnp.any((s == x) & (x >= 0))
+
+
+def remove(s: jax.Array, x: jax.Array) -> jax.Array:
+    return jnp.where((s == x) & (x >= 0), EMPTY, s)
+
+
+def insert(s: jax.Array, x: jax.Array) -> jax.Array:
+    """Insert ``x`` if absent and there is a free slot; silently no-op
+    otherwise (including x < 0).  Returns the new set."""
+    new, _, _ = insert_evict(s, x, None)
+    return new
+
+
+def insert_evict(
+    s: jax.Array, x: jax.Array, key: jax.Array | None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Insert ``x``; when the set is full evict a uniformly random victim
+    (the ``add_to_active_view`` drop, hyparview :1466-1512).
+
+    Returns ``(new_set, evicted, inserted)`` where ``evicted`` is the dropped
+    member id or -1, and ``inserted`` is a bool scalar.  With ``key=None`` no
+    eviction happens (full set => insert refused), which is the
+    ``add_to_passive_view``-without-eviction building block.
+    """
+    cap = s.shape[0]
+    present = contains(s, x)
+    want = (x >= 0) & ~present
+    free = s < 0
+    has_free = jnp.any(free)
+    first_free = jnp.argmax(free)  # valid only when has_free
+    if key is None:
+        slot = first_free
+        do = want & has_free
+        evicted = jnp.int32(EMPTY)
+    else:
+        rand_slot = jax.random.randint(key, (), 0, cap)
+        slot = jnp.where(has_free, first_free, rand_slot)
+        do = want
+        evicted = jnp.where(do & ~has_free, s[slot], EMPTY).astype(jnp.int32)
+    new = jnp.where((jnp.arange(cap) == slot) & do, x, s)
+    return new, evicted, do
+
+
+def random_member(
+    s: jax.Array, key: jax.Array, exclude: jax.Array | None = None
+) -> jax.Array:
+    """Uniformly random member (or -1 when empty), optionally excluding one id
+    — the ``select_random(State, [exclude...])`` helper (hyparview :1346-1361).
+    ``exclude`` may be a scalar or a 1-D array of ids to exclude."""
+    ok = s >= 0
+    if exclude is not None:
+        ex = jnp.atleast_1d(jnp.asarray(exclude))
+        ok = ok & ~jnp.any(s[None, :] == ex[:, None], axis=0)
+    n = jnp.sum(ok)
+    # Gumbel-max over valid slots: uniform among them, fixed-shape.
+    g = jax.random.gumbel(key, s.shape)
+    idx = jnp.argmax(jnp.where(ok, g, -jnp.inf))
+    return jnp.where(n > 0, s[idx], EMPTY).astype(jnp.int32)
+
+
+def random_k(
+    s: jax.Array, key: jax.Array, k: int, exclude: jax.Array | None = None
+) -> jax.Array:
+    """Up to ``k`` distinct random members, -1 padded — the shuffle sample
+    (``select_random_sublist``, hyparview :572-607, 1589-1595)."""
+    ok = s >= 0
+    if exclude is not None:
+        ex = jnp.atleast_1d(jnp.asarray(exclude))
+        ok = ok & ~jnp.any(s[None, :] == ex[:, None], axis=0)
+    g = jax.random.gumbel(key, s.shape)
+    order = jnp.argsort(jnp.where(ok, g, -jnp.inf))[::-1]  # valid slots first
+    picked = s[order[:k]]
+    rank_ok = jnp.arange(k) < jnp.sum(ok)
+    return jnp.where(rank_ok, picked, EMPTY).astype(jnp.int32)
+
+
+def members_first(s: jax.Array) -> jax.Array:
+    """Compact valid members to the front (order not preserved)."""
+    order = jnp.argsort(jnp.where(s >= 0, 0, 1), stable=True)
+    return s[order]

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, node_sharding, place_world, shard_spec

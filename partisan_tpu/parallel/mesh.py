@@ -1,0 +1,74 @@
+"""Device-mesh sharding of the simulator — the distributed backend.
+
+The reference's distribution story is TCP sockets + ETF framing
+(src/partisan_peer_connection.erl, src/partisan_socket.erl:17-19); the
+TPU-native equivalent (SURVEY §2.11, §5.8) is sharding the **node axis**
+across a ``jax.sharding.Mesh`` and letting XLA insert ICI collectives for the
+cross-shard message traffic: the router's sort-by-destination is a global
+all-to-all under the hood, exactly the "pick a mesh, annotate shardings, let
+XLA insert collectives" recipe.
+
+Every state leaf is ``[N, ...]`` sharded on axis 0; the flat message buffer
+``[M, ...]`` is likewise sharded on axis 0 (messages live where they were
+emitted; routing moves them).  Scalars (round counter) are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine import World
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the node axis.  On a real slice this is the ICI ring; in
+    tests it is the 8-device virtual CPU mesh (tests/conftest.py)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (NODE_AXIS,))
+
+
+def shard_spec(leaf: Any) -> P:
+    """Shard axis 0 for arrays with a leading (node or message) axis;
+    replicate scalars."""
+    if hasattr(leaf, "ndim") and leaf.ndim >= 1:
+        return P(NODE_AXIS) if leaf.ndim >= 1 else P()
+    return P()
+
+
+def node_sharding(mesh: Mesh, leaf: Any) -> NamedSharding:
+    if hasattr(leaf, "ndim") and leaf.ndim >= 1:
+        return NamedSharding(mesh, P(NODE_AXIS))
+    return NamedSharding(mesh, P())
+
+
+def place_world(world: World, mesh: Mesh) -> World:
+    """device_put every leaf with its sharding; XLA propagates from there.
+
+    Scalar leaves (round counter) replicate; [N,...] and [M,...] leaves are
+    row-sharded.  Requires N and the message cap to be divisible by the mesh
+    size (pad N up if needed — node ids beyond the real N just stay inert
+    rows with alive=False).
+    """
+    def put(leaf):
+        return jax.device_put(leaf, node_sharding(mesh, leaf))
+    return jax.tree_util.tree_map(put, world)
+
+
+def constrain(tree: Any, mesh: Mesh) -> Any:
+    """with_sharding_constraint over a pytree — used inside jitted steps to
+    pin intermediate layouts when XLA's propagation needs a hint."""
+    def c(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1:
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, P(NODE_AXIS)))
+        return leaf
+    return jax.tree_util.tree_map(c, tree)

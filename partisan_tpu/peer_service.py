@@ -1,0 +1,56 @@
+"""Public facade — the ``partisan_peer_service.erl`` analog.
+
+The reference facade (src/partisan_peer_service.erl:24-42) exposes
+join/leave/members/forward_message against whatever manager is configured.
+Here the same verbs operate on a :class:`~partisan_tpu.engine.World` by
+injecting control messages into the in-flight buffer; effects take place when
+the next round runs.  All helpers are pure (world in, world out) so they can
+be composed inside jit or driven from the host / the Erlang port bridge.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import Config
+from .engine import ProtocolBase, World
+from .ops import msg as msgops
+
+
+def _ctl(world: World, proto: ProtocolBase, node: int, typ_name: str,
+         **data) -> World:
+    em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
+                    cap=1, **data)
+    msgs, _ = msgops.inject(world.msgs, em, src=node)
+    return world.replace(msgs=msgs)
+
+
+def join(world: World, proto: ProtocolBase, node: int, peer: int) -> World:
+    """node joins the cluster via peer (partisan_peer_service:join/1 :52)."""
+    return _ctl(world, proto, node, "ctl_join",
+                **{proto.ctl_peer_field: peer})
+
+
+def leave(world: World, proto: ProtocolBase, node: int, target: int | None = None) -> World:
+    """leave/0-1: self-leave when target is None (partisan_peer_service.erl:62-70)."""
+    return _ctl(world, proto, node, "ctl_leave",
+                **{proto.ctl_peer_field: node if target is None else target})
+
+
+def cluster(world: World, proto: ProtocolBase,
+            pairs: Sequence[Tuple[int, int]]) -> World:
+    """Pairwise joins, the test-harness clustering pattern
+    (test/partisan_support.erl cluster/3)."""
+    for node, peer in pairs:
+        world = join(world, proto, node, peer)
+    return world
+
+
+def members(world: World, proto: ProtocolBase, node: int) -> jax.Array:
+    """[N] bool membership mask as seen by ``node``
+    (partisan_peer_service:members/0)."""
+    row = jax.tree_util.tree_map(lambda x: x[node], world.state)
+    return proto.member_mask(row)

@@ -1,0 +1,30 @@
+"""Per-node PRNG discipline.
+
+The reference seeds every slave node deterministically with
+``{phash2(Node), 1, 1}`` (test/partisan_support.erl:162-166) so that protocol
+randomness (view eviction, walk targets, shuffle samples) is reproducible per
+node.  The TPU rebuild mirrors this with one jax PRNG key per virtual node,
+folded with the round number each step — randomness is a pure function of
+(seed, node_id, round, decision_slot).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def node_keys(seed: int, n_nodes: int) -> jax.Array:
+    """[N, 2] uint32 — one independent key per virtual node."""
+    root = jax.random.PRNGKey(seed)
+    return jax.random.split(root, n_nodes)
+
+
+def round_key(key: jax.Array, rnd: jax.Array) -> jax.Array:
+    """Fold the round counter into a per-node key (call inside the step)."""
+    return jax.random.fold_in(key, rnd)
+
+
+def decision_key(key: jax.Array, slot: int) -> jax.Array:
+    """Distinct stream per decision site within one node-round."""
+    return jax.random.fold_in(key, slot)

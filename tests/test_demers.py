@@ -1,0 +1,79 @@
+"""Demers epidemic-protocol tests — the analog of `gossip_test`
+(test/partisan_SUITE.erl:1138: start the protocol on 4 nodes, broadcast,
+assert delivery everywhere within a bounded window)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.engine import init_world, make_step
+from partisan_tpu.models.demers import (
+    AntiEntropy, DirectMail, DirectMailAcked, rumor_init, rumor_run)
+from partisan_tpu.ops import msg as msgops
+
+
+def broadcast(world, proto, node, rumor):
+    em = proto.emit(jnp.asarray([node], jnp.int32),
+                    proto.typ("ctl_broadcast"), cap=1, rumor=rumor)
+    msgs, _ = msgops.inject(world.msgs, em, src=node)
+    return world.replace(msgs=msgs)
+
+
+def test_direct_mail_delivers_to_all():
+    cfg = pt.Config(n_nodes=4, inbox_cap=8)
+    proto = DirectMail(cfg, n_rumors=2)
+    world = init_world(cfg, proto)
+    step = make_step(cfg, proto, donate=False)
+    world = broadcast(world, proto, 0, 0)
+    for _ in range(3):
+        world, _ = step(world)
+    seen = np.asarray(world.state.seen)
+    assert seen[:, 0].all(), "rumor 0 must reach all 4 nodes"
+    assert not seen[:, 1].any()
+
+
+def test_direct_mail_acked_collects_acks():
+    cfg = pt.Config(n_nodes=4, inbox_cap=8)
+    proto = DirectMailAcked(cfg, n_rumors=2)
+    world = init_world(cfg, proto)
+    step = make_step(cfg, proto, donate=False)
+    world = broadcast(world, proto, 1, 0)
+    for _ in range(4):
+        world, _ = step(world)
+    seen = np.asarray(world.state.seen)
+    acked = np.asarray(world.state.acked)
+    assert seen[:, 0].all()
+    assert acked[1, 0] == 3, "origin must collect an ack per recipient"
+
+
+def test_anti_entropy_converges():
+    cfg = pt.Config(n_nodes=8, inbox_cap=8, periodic_interval=2)
+    proto = AntiEntropy(cfg, n_rumors=2)
+    world = init_world(cfg, proto)
+    step = make_step(cfg, proto, donate=False)
+    world = broadcast(world, proto, 3, 1)
+    for _ in range(20):
+        world, _ = step(world)
+    seen = np.asarray(world.state.seen)
+    assert seen[:, 1].all(), "push-pull anti-entropy must spread the rumor"
+
+
+class TestRumorFastPath:
+    def test_full_infection_without_churn(self):
+        n = 4096
+        out = rumor_run(rumor_init(n), 40, n, 2, 4, 0.0)
+        assert float(out.infected.mean()) > 0.95
+
+    def test_churn_keeps_endemic_state(self):
+        n = 4096
+        out = rumor_run(rumor_init(n), 150, n, 2, 1, 0.01)
+        frac = float(out.infected.mean())
+        assert 0.01 < frac < 1.0
+
+    def test_determinism(self):
+        n = 1024
+        a = rumor_run(rumor_init(n), 30, n, 2, 1, 0.01)
+        b = rumor_run(rumor_init(n), 30, n, 2, 1, 0.01)
+        np.testing.assert_array_equal(np.asarray(a.infected),
+                                      np.asarray(b.infected))

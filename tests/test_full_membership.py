@@ -1,0 +1,89 @@
+"""Full-membership strategy integration tests — the batched analog of the
+reference's `connectivity_test`/`gossip_test` with
+`with_full_membership_strategy` (test/partisan_SUITE.erl:121-308) and
+BASELINE config #1 (3-node full mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu import engine, peer_service
+from partisan_tpu.config import Config
+from partisan_tpu.models.full_membership import FullMembership
+
+
+def converged_membership(world, proto, cfg):
+    """All nodes see the same member set; returns (bool, mask)."""
+    masks = jax.vmap(proto.member_mask)(world.state)
+    same = np.all(np.asarray(masks) == np.asarray(masks)[0:1], axis=None)
+    return bool(same), np.asarray(masks[0])
+
+
+def run_rounds(cfg, proto, world, n):
+    step = engine.make_step(cfg, proto, donate=False)
+    for _ in range(n):
+        world, metrics = step(world)
+    return world
+
+
+def test_three_node_join_converges():
+    cfg = Config(n_nodes=3, periodic_interval=2, inbox_cap=8)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    # pairwise join, the support-harness pattern (partisan_support cluster/3)
+    world = peer_service.join(world, proto, 1, 0)
+    world = peer_service.join(world, proto, 2, 0)
+    world = run_rounds(cfg, proto, world, 8)
+    same, mask = converged_membership(world, proto, cfg)
+    assert same
+    np.testing.assert_array_equal(mask, [True, True, True])
+
+
+def test_members_view():
+    cfg = Config(n_nodes=3, periodic_interval=2)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    m0 = np.asarray(peer_service.members(world, proto, 0))
+    np.testing.assert_array_equal(m0, [True, False, False])
+
+
+def test_leave_propagates():
+    cfg = Config(n_nodes=4, periodic_interval=2, inbox_cap=8)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    for n in (1, 2, 3):
+        world = peer_service.join(world, proto, n, 0)
+    world = run_rounds(cfg, proto, world, 8)
+    same, mask = converged_membership(world, proto, cfg)
+    assert same and mask.sum() == 4
+    # node 3 leaves (self-leave gossips the removal, full :58-89)
+    world = peer_service.leave(world, proto, 3)
+    world = run_rounds(cfg, proto, world, 8)
+    masks = np.asarray(jax.vmap(proto.member_mask)(world.state))
+    for n in (0, 1, 2):
+        np.testing.assert_array_equal(masks[n], [True, True, True, False])
+
+
+def test_sixteen_node_convergence_rounds():
+    """Convergence in O(diameter) rounds on a chain-join topology."""
+    cfg = Config(n_nodes=16, periodic_interval=2, inbox_cap=32)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    world = peer_service.cluster(world, proto, [(i, i - 1) for i in range(1, 16)])
+    world = run_rounds(cfg, proto, world, 12)
+    same, mask = converged_membership(world, proto, cfg)
+    assert same and mask.all()
+
+
+def test_crashed_node_stops_gossiping():
+    cfg = Config(n_nodes=3, periodic_interval=2, inbox_cap=8)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    world = peer_service.join(world, proto, 1, 0)
+    world = run_rounds(cfg, proto, world, 6)
+    # crash node 2 before it ever joins; nothing from it should arrive
+    world = world.replace(alive=world.alive.at[2].set(False))
+    world = peer_service.join(world, proto, 2, 0)
+    world = run_rounds(cfg, proto, world, 6)
+    m0 = np.asarray(peer_service.members(world, proto, 0))
+    np.testing.assert_array_equal(m0, [True, True, False])

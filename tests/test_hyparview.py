@@ -1,0 +1,127 @@
+"""HyParView integration tests — batched analogs of the reference's
+`hyparview_manager_*` cases and the digraph membership check
+(test/partisan_SUITE.erl:1586-1706, 2044-2109), plus BASELINE configs #2
+(16 nodes) and the N=64 connectivity-parity bar."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.ops import graph
+
+
+def boot(n, rounds, cfg_kw=None, join_to=0):
+    cfg = pt.Config(n_nodes=n, inbox_cap=8, shuffle_interval=5,
+                    **(cfg_kw or {}))
+    proto = HyParView(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = peer_service.cluster(world, proto,
+                                 [(i, join_to) for i in range(1, n)])
+    for _ in range(rounds):
+        world, m = step(world)
+    return cfg, proto, world, step
+
+
+def active_sizes(world):
+    return np.asarray(jax.vmap(lambda a: (a >= 0).sum())(world.state.active))
+
+
+class TestSixteenNodes:
+    """BASELINE config #2: 16 nodes, default ARWL/PRWL/view sizes."""
+
+    @pytest.fixture(scope="class")
+    def booted(self):
+        return boot(16, 40)
+
+    def test_connected(self, booted):
+        _, _, world, _ = booted
+        adj = graph.adjacency_from_views(world.state.active, 16)
+        assert bool(graph.is_connected(adj))
+
+    def test_symmetric(self, booted):
+        _, _, world, _ = booted
+        adj = graph.adjacency_from_views(world.state.active, 16)
+        assert bool(graph.is_symmetric(adj))
+
+    def test_view_bounds(self, booted):
+        cfg, _, world, _ = booted
+        sizes = active_sizes(world)
+        assert (sizes >= cfg.min_active_size).all()
+        assert (sizes <= cfg.max_active_size).all()
+
+    def test_passive_populated(self, booted):
+        """Shuffle must fill passive views (:572-607)."""
+        _, _, world, _ = booted
+        psizes = np.asarray(jax.vmap(lambda a: (a >= 0).sum())(
+            world.state.passive))
+        assert (psizes > 0).all()
+
+
+class TestRepair:
+    def test_crash_pruned_by_keepalive_expiry(self):
+        """A crashed node must vanish from every active view within the
+        keepalive TTL window and the survivors stay connected — the EXIT
+        prune + passive promotion repair (hyparview :609-654)."""
+        cfg, proto, world, step = boot(16, 40)
+        victim = int(active_sizes(world).argmax())
+        world = world.replace(alive=world.alive.at[victim].set(False))
+        for _ in range(cfg.keepalive_ttl + cfg.random_promotion_interval + 6):
+            world, _ = step(world)
+        act = np.asarray(world.state.active)
+        alive = np.ones(16, bool)
+        alive[victim] = False
+        assert not (act[alive] == victim).any(), "crashed peer still in views"
+        adj = graph.adjacency_from_views(world.state.active, 16)
+        assert bool(graph.is_connected(adj, jnp.asarray(alive)))
+
+    def test_graceful_leave(self):
+        cfg, proto, world, step = boot(16, 40)
+        world = peer_service.leave(world, proto, 5)
+        for _ in range(cfg.keepalive_ttl + 8):
+            world, _ = step(world)
+        act = np.asarray(world.state.active)
+        alive = np.ones(16, bool)
+        alive[5] = False
+        assert not (act[alive] == 5).any()
+        assert int(active_sizes(world)[5]) == 0
+
+    def test_late_join(self):
+        """A node joining an established cluster integrates (join walk,
+        :703-771)."""
+        n = 17
+        cfg = pt.Config(n_nodes=n, inbox_cap=8, shuffle_interval=5)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = peer_service.cluster(world, proto,
+                                     [(i, 0) for i in range(1, n - 1)])
+        for _ in range(30):
+            world, _ = step(world)
+        world = peer_service.join(world, proto, n - 1, 0)
+        for _ in range(20):
+            world, _ = step(world)
+        sizes = active_sizes(world)
+        assert sizes[n - 1] >= 1
+        adj = graph.adjacency_from_views(world.state.active, n)
+        assert bool(graph.is_connected(adj))
+
+
+@pytest.mark.slow
+def test_sixtyfour_node_parity():
+    """The BASELINE bar: HyParView active-view connectivity at N=64 with
+    default protocol constants (statistical parity with the Erlang
+    reference, SURVEY §7.3 'Two RNG semantics')."""
+    cfg, proto, world, step = boot(64, 80)
+    adj = graph.adjacency_from_views(world.state.active, 64)
+    assert bool(graph.is_connected(adj))
+    assert bool(graph.is_symmetric(adj))
+    sizes = active_sizes(world)
+    assert (sizes >= cfg.min_active_size).all()
+    assert (sizes <= cfg.max_active_size).all()
+    # view-size distribution: most nodes should sit near the cap
+    assert sizes.mean() >= 4.0

@@ -1,0 +1,211 @@
+"""Unit tests for the core ops layer — the analog of the reference's inline
+eunit tests for pure data structures
+(src/partisan_peer_service_connections.erl:129-202, SURVEY §4.1.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu.ops import bitset, graph, msg as msgops, padded_set as ps
+
+
+class TestPaddedSet:
+    def test_make_size_contains(self):
+        s = ps.make(6)
+        assert int(ps.size(s)) == 0
+        assert not bool(ps.contains(s, jnp.int32(3)))
+
+    def test_insert_remove(self):
+        s = ps.make(4)
+        s = ps.insert(s, jnp.int32(7))
+        s = ps.insert(s, jnp.int32(9))
+        s = ps.insert(s, jnp.int32(7))  # dup: no-op
+        assert int(ps.size(s)) == 2
+        assert bool(ps.contains(s, jnp.int32(7)))
+        s = ps.remove(s, jnp.int32(7))
+        assert int(ps.size(s)) == 1
+        assert not bool(ps.contains(s, jnp.int32(7)))
+
+    def test_insert_negative_is_noop(self):
+        s = ps.make(4)
+        s = ps.insert(s, jnp.int32(-1))
+        assert int(ps.size(s)) == 0
+
+    def test_insert_full_no_evict_refuses(self):
+        s = ps.make(2)
+        s = ps.insert(s, jnp.int32(1))
+        s = ps.insert(s, jnp.int32(2))
+        s2 = ps.insert(s, jnp.int32(3))
+        assert sorted(np.asarray(s2).tolist()) == [1, 2]
+
+    def test_insert_evict(self):
+        key = jax.random.PRNGKey(0)
+        s = ps.make(2)
+        s = ps.insert(s, jnp.int32(1))
+        s = ps.insert(s, jnp.int32(2))
+        s2, evicted, did = ps.insert_evict(s, jnp.int32(3), key)
+        assert bool(did)
+        assert int(evicted) in (1, 2)
+        vals = sorted(np.asarray(s2).tolist())
+        assert 3 in vals and int(evicted) not in vals
+
+    def test_random_member_uniform_and_exclude(self):
+        s = ps.make(8)
+        for v in [3, 5, 9]:
+            s = ps.insert(s, jnp.int32(v))
+        seen = set()
+        for i in range(60):
+            m = int(ps.random_member(s, jax.random.PRNGKey(i)))
+            seen.add(m)
+        assert seen == {3, 5, 9}
+        for i in range(30):
+            m = int(ps.random_member(s, jax.random.PRNGKey(i),
+                                     exclude=jnp.asarray([5, 9])))
+            assert m == 3
+
+    def test_random_member_empty(self):
+        assert int(ps.random_member(ps.make(4), jax.random.PRNGKey(0))) == -1
+
+    def test_random_k(self):
+        s = ps.make(8)
+        for v in [3, 5, 9]:
+            s = ps.insert(s, jnp.int32(v))
+        out = np.asarray(ps.random_k(s, jax.random.PRNGKey(1), 5))
+        got = [v for v in out.tolist() if v >= 0]
+        assert sorted(got) == [3, 5, 9]
+        out2 = np.asarray(ps.random_k(s, jax.random.PRNGKey(2), 2))
+        assert len([v for v in out2.tolist() if v >= 0]) == 2
+
+
+class TestBitset:
+    def test_add_contains_count(self):
+        bs = bitset.make(100)
+        bs = bitset.add(bs, jnp.int32(0))
+        bs = bitset.add(bs, jnp.int32(63))
+        bs = bitset.add(bs, jnp.int32(99))
+        assert int(bitset.count(bs)) == 3
+        for i in [0, 63, 99]:
+            assert bool(bitset.contains(bs, jnp.int32(i)))
+        assert not bool(bitset.contains(bs, jnp.int32(50)))
+
+    def test_union_difference_roundtrip(self):
+        a = bitset.add(bitset.make(64), jnp.int32(3))
+        b = bitset.add(bitset.make(64), jnp.int32(40))
+        u = bitset.union(a, b)
+        assert int(bitset.count(u)) == 2
+        d = bitset.difference(u, b)
+        assert int(bitset.count(d)) == 1 and bool(bitset.contains(d, jnp.int32(3)))
+
+    def test_mask_roundtrip(self):
+        mask = jnp.asarray(np.random.RandomState(0).rand(77) > 0.5)
+        bs = bitset.from_mask(mask)
+        back = bitset.to_mask(bs, 77)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(mask))
+
+
+class TestRouter:
+    SPEC = {"x": ((), jnp.int32)}
+
+    def _mk(self, entries, cap=16):
+        m = msgops.empty(cap, self.SPEC)
+        for i, (src, dst, typ, x) in enumerate(entries):
+            m = m.replace(
+                valid=m.valid.at[i].set(True),
+                src=m.src.at[i].set(src), dst=m.dst.at[i].set(dst),
+                typ=m.typ.at[i].set(typ),
+                data={"x": m.data["x"].at[i].set(x)},
+            )
+        return m
+
+    def test_build_inbox_routes_by_dst(self):
+        m = self._mk([(0, 2, 1, 10), (1, 2, 1, 11), (2, 0, 0, 12)])
+        inbox, held, overflow = msgops.build_inbox(m, n_nodes=4, inbox_cap=4)
+        assert int(overflow) == 0
+        v = np.asarray(inbox.valid)
+        assert v[2].sum() == 2 and v[0].sum() == 1 and v[1].sum() == 0
+        xs = sorted(np.asarray(inbox.data["x"])[2][v[2]].tolist())
+        assert xs == [10, 11]
+        assert int(held.count()) == 0
+
+    def test_inbox_overflow_counted(self):
+        m = self._mk([(0, 1, 0, i) for i in range(5)])
+        inbox, _, overflow = msgops.build_inbox(m, n_nodes=2, inbox_cap=3)
+        assert int(overflow) == 2
+        assert np.asarray(inbox.valid)[1].sum() == 3
+
+    def test_delay_held(self):
+        m = self._mk([(0, 1, 0, 1)])
+        m = m.replace(delay=m.delay.at[0].set(2))
+        inbox, held, _ = msgops.build_inbox(m, n_nodes=2, inbox_cap=2)
+        assert int(jnp.sum(inbox.valid)) == 0
+        assert int(held.count()) == 1
+        assert int(held.delay[0]) == 1
+
+    def test_compact(self):
+        m = self._mk([(0, 1, 0, 5), (0, 2, 0, 6), (0, 3, 0, 7)], cap=8)
+        out, dropped = msgops.compact(m, 2)
+        assert int(dropped) == 1
+        assert int(out.count()) == 2
+        assert bool(np.all(np.asarray(out.valid)[:2]))
+
+    def test_inject(self):
+        buf = msgops.empty(4, self.SPEC)
+        em = self._mk([(0, 3, 1, 42)], cap=2)
+        out, dropped = msgops.inject(buf, em, src=7)
+        assert int(dropped) == 0
+        assert int(out.count()) == 1
+        i = int(np.asarray(out.valid).argmax())
+        assert int(out.src[i]) == 7 and int(out.dst[i]) == 3
+        assert int(out.data["x"][i]) == 42
+
+    def test_inject_unpacked_valid_slots(self):
+        """Valid entries at arbitrary positions must land in free slots
+        (regression: rank-vs-position drop bug)."""
+        buf = msgops.empty(4, self.SPEC)
+        buf = buf.replace(valid=buf.valid.at[0].set(True).at[1].set(True))
+        em = msgops.empty(4, self.SPEC)
+        em = em.replace(  # valid slots at positions 2 and 3 only
+            valid=em.valid.at[2].set(True).at[3].set(True),
+            dst=em.dst.at[2].set(1).at[3].set(2),
+            data={"x": em.data["x"].at[2].set(7).at[3].set(8)},
+        )
+        out, dropped = msgops.inject(buf, em, src=0)
+        assert int(dropped) == 0
+        assert int(out.count()) == 4
+        got = sorted(np.asarray(out.data["x"])[np.asarray(out.valid)].tolist())
+        assert got[-2:] == [7, 8]
+
+    def test_reduce_max_uint32(self):
+        """max-reduce over a uint32 field must not wrap the neutral element."""
+        m = self._mk([(0, 1, 0, 0)])
+        m.data["v"] = jnp.zeros((m.cap,), jnp.uint32).at[0].set(7)
+        got = msgops.reduce_to_nodes(m, 3, reducer="max", value_field="v")
+        assert got.dtype == jnp.uint32
+        assert np.asarray(got).tolist() == [0, 7, 0]
+
+    def test_reduce_to_nodes_or(self):
+        m = self._mk([(0, 1, 0, 1), (2, 1, 0, 1), (0, 3, 0, 1)])
+        got = msgops.reduce_to_nodes(m, 4, reducer="or")
+        np.testing.assert_array_equal(np.asarray(got), [0, 1, 0, 1])
+
+
+class TestGraph:
+    def test_connected_ring(self):
+        n = 8
+        views = jnp.stack([jnp.stack([(i + 1) % n, (i - 1) % n])
+                           for i in jnp.arange(n)]).astype(jnp.int32)
+        adj = graph.adjacency_from_views(views, n)
+        assert bool(graph.is_connected(adj))
+        assert bool(graph.is_symmetric(adj))
+
+    def test_disconnected(self):
+        views = jnp.asarray([[1], [0], [3], [2]], dtype=jnp.int32)
+        adj = graph.adjacency_from_views(views, 4)
+        assert not bool(graph.is_connected(adj))
+
+    def test_alive_subset(self):
+        views = jnp.asarray([[1], [0], [3], [2]], dtype=jnp.int32)
+        adj = graph.adjacency_from_views(views, 4)
+        alive = jnp.asarray([True, True, False, False])
+        assert bool(graph.is_connected(adj, alive))

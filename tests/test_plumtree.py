@@ -1,0 +1,75 @@
+"""Plumtree-over-HyParView tests — BASELINE config #3 (broadcast over the
+overlay with single-key anti-entropy; `with_broadcast` group of
+test/partisan_SUITE.erl)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.engine import init_world, make_step
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.plumtree import Plumtree
+from partisan_tpu.models.stack import Stacked
+from partisan_tpu.ops import msg as msgops
+
+
+def pt_broadcast(world, proto, node, val):
+    em = proto.emit(jnp.asarray([node], jnp.int32),
+                    proto.typ("ctl_pt_broadcast"), cap=1, pt_val=val)
+    msgs, _ = msgops.inject(world.msgs, em, src=node)
+    return world.replace(msgs=msgs)
+
+
+@pytest.fixture(scope="module")
+def booted():
+    n = 16
+    cfg = pt.Config(n_nodes=n, inbox_cap=12, shuffle_interval=5,
+                    exchange_tick_period=10)
+    proto = Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1))
+    world = init_world(cfg, proto)
+    step = make_step(cfg, proto, donate=False)
+    world = peer_service.cluster(world, proto, [(i, 0) for i in range(1, n)])
+    for _ in range(30):
+        world, _ = step(world)
+    return cfg, proto, world, step
+
+
+def test_broadcast_reaches_all(booted):
+    cfg, proto, world, step = booted
+    world = pt_broadcast(world, proto, 3, 42)
+    for _ in range(8):
+        world, _ = step(world)
+    vals = np.asarray(world.state.upper.val[:, 0])
+    assert (vals == 42).all(), f"coverage {(vals == 42).sum()}/16"
+
+
+def test_newer_broadcast_supersedes(booted):
+    cfg, proto, world, step = booted
+    world = pt_broadcast(world, proto, 3, 42)
+    for _ in range(8):
+        world, _ = step(world)
+    world = pt_broadcast(world, proto, 7, 99)
+    for _ in range(8):
+        world, _ = step(world)
+    vals = np.asarray(world.state.upper.val[:, 0])
+    seqs = np.asarray(world.state.upper.seq[:, 0])
+    assert (vals == 99).all()
+    assert (seqs == seqs[7]).all()
+
+
+def test_partitioned_node_catches_up_via_exchange(booted):
+    """Anti-entropy exchange repairs a missed broadcast (:455-485)."""
+    cfg, proto, world, step = booted
+    world = world.replace(partition=world.partition.at[11].set(1))
+    world = pt_broadcast(world, proto, 0, 7)
+    for _ in range(8):
+        world, _ = step(world)
+    vals = np.asarray(world.state.upper.val[:, 0])
+    assert vals[11] != 7, "partitioned node must miss the broadcast"
+    world = world.replace(partition=world.partition.at[11].set(0))
+    for _ in range(2 * cfg.exchange_tick_period + cfg.keepalive_ttl):
+        world, _ = step(world)
+    vals = np.asarray(world.state.upper.val[:, 0])
+    assert vals[11] == 7, "exchange must deliver the missed value"
