@@ -51,9 +51,15 @@ class Config:
     scamp_c: int = 5                   # ?SCAMP_C_VALUE (partisan.hrl:31)
     scamp_message_window: int = 10     # ?SCAMP_MESSAGE_WINDOW (partisan.hrl:32)
     scamp_exact_keep_probability: bool = True
-    # ^ the reference quantizes SCAMP's keep probability to a fair coin
+    # ^ the reference quantizes SCAMP's keep probability to a biased coin
     #   (scamp_v2 :292-296, 352-360); True uses the paper's 1/(1+|view|),
-    #   False reproduces the reference's coin flip for behavioural parity.
+    #   False reproduces the reference's 0.4 coin for behavioural parity.
+    scamp_paper_fanout: bool = True
+    # ^ True: a contact receiving a NEW subscription fans copies to its whole
+    #   partial view + c extras (the SCAMP paper's subscription algorithm,
+    #   which yields the (c+1)·ln N view-size fixed point).  False: the
+    #   reference's shape — the *joiner* fans over its own (trivial) view
+    #   (v1 :51-100, v2 :64-117), so every join injects only ~3 walks.
 
     # --- plumtree (partisan.hrl:58-59, plumtree_broadcast.erl) --------------
     lazy_tick_period: int = 1          # 1 s

@@ -71,7 +71,8 @@ class Plumtree(UpperProtocol):
             "pt_val": ((), jnp.int32),
             "pt_round": ((), jnp.int32),  # tree-depth counter (:282-287)
         }
-        self.emit_cap = cfg.max_active_size + 2
+        # handle_bcast worst case: A eager pushes + A lazy i_haves + 1 prune
+        self.emit_cap = 2 * cfg.max_active_size + 1
         self.tick_emit_cap = 1
 
     def init_upper(self, cfg: Config, key: jax.Array) -> PtState:

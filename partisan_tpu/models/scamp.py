@@ -1,0 +1,318 @@
+"""SCAMP (Scalable Membership Protocol) v1 & v2 — TPU-native rebuild of
+``src/partisan_scamp_v1_membership_strategy.erl`` and
+``src/partisan_scamp_v2_membership_strategy.erl``.
+
+Both strategies keep a *partial view* whose expected size self-stabilizes to
+O((c+1)·log N); v2 additionally tracks the *in-view* (who holds a
+subscription to me), enabling graceful leave by rewiring.
+
+Semantics mirrored (reference sites):
+  * join (v1 :51-100, v2 :64-117): add contact to the partial view, send
+    ``forward_subscription(me)`` to the contact, forward a subscription for
+    the joiner to every existing partial-view member, plus ``c`` (v1) /
+    ``c − 1`` (v2) extra copies to random members.
+  * forward_subscription (v1 :213-252, v2 :284-327): keep with probability
+    P = 1/(1 + |view|) if absent, else re-forward to one random member.
+    The reference quantizes P to a biased coin — ``rand:uniform(10) >= 5``
+    yields 1 w.p. 0.6, and the subscription is kept when the draw is 0, i.e.
+    a *constant* keep probability of 0.4 independent of view size (SURVEY
+    §2.4 calls out the fidelity bug).  ``cfg.scamp_exact_keep_probability``
+    selects the paper's P (True, default) or the reference's 0.4 coin
+    (False, behavioural parity).
+  * keep_subscription (v2 :328-338): the keeper notifies the subject, which
+    records the keeper in its in-view.
+  * remove_subscription (v1 :191-212, v2 :261-283): remove + re-gossip to
+    the pre-removal partial view.
+  * leave / bootstrap_remove_subscription (v2 :192-238): only the departing
+    node acts: in-view members 1..L−(c−1) get ``replace_subscription``
+    (rewire their partial-view edge to one of my partial-view members,
+    round-robin), the remainder get ``remove_subscription``; local state
+    resets.  v1 leave (:102-124) just removes + gossips the removal.
+  * periodic + isolation detection (v1 :126-172, v2 :130-178): ping all
+    partial-view members every ``periodic_interval``; a node that received
+    no ping for ``periodic_interval × scamp_message_window`` rounds
+    considers itself isolated and re-subscribes via one random member.
+
+Walk dynamics are one hop per round: a re-forwarded subscription is a fresh
+message next round (SURVEY §7.3 "random walks").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import padded_set as ps
+from ..ops.msg import Msgs
+from .. import prng
+
+
+@struct.dataclass
+class ScampState:
+    partial: jax.Array       # [N, P] padded partial-view peer set
+    in_view: jax.Array       # [N, P] padded in-view (v2; unused rows in v1)
+    last_msg_rnd: jax.Array  # [N] round of last ping received (isolation)
+    left: jax.Array          # [N] bool — departed, inert until rejoin
+
+
+def default_view_cap(n_nodes: int, c: int) -> int:
+    """Partial-view capacity: SCAMP converges to ~(c+1)·ln N subscriptions
+    per node; double it for headroom (fixed shapes, SURVEY §7.3)."""
+    return max(16, int(2 * (c + 1) * math.log(max(n_nodes, 2))))
+
+
+class ScampV1(ProtocolBase):
+    """v1: single membership set, no in-view, no graceful rewiring."""
+
+    msg_types = ("subscription", "forward_subscription",
+                 "remove_subscription", "ping", "ctl_join", "ctl_leave")
+    version = 1
+
+    def __init__(self, cfg: Config, view_cap: int | None = None):
+        self.cfg = cfg
+        self.P = view_cap or default_view_cap(cfg.n_nodes, cfg.scamp_c)
+        self.data_spec: Dict = {
+            "subject": ((), jnp.int32),      # the node a subscription is for
+            "replacement": ((), jnp.int32),  # v2 rewiring target
+            "peer": ((), jnp.int32),         # ctl verbs
+        }
+        # join fans to the whole partial view + c extra copies + 1 to contact
+        self.emit_cap = self.P + cfg.scamp_c + 1
+        self.tick_emit_cap = self.P + 1  # pings to all + isolation resub
+
+    # ------------------------------------------------------------------ state
+
+    def init(self, cfg: Config, key: jax.Array) -> ScampState:
+        n = cfg.n_nodes
+        # partial view starts as {myself} (v1 init :43-49, v2 init :56-62);
+        # self is implicit here (ids are rows), so the stored set is empty.
+        return ScampState(
+            partial=jnp.full((n, self.P), -1, jnp.int32),
+            in_view=jnp.full((n, self.P), -1, jnp.int32),
+            last_msg_rnd=jnp.zeros((n,), jnp.int32),
+            left=jnp.zeros((n,), bool),
+        )
+
+    def member_mask(self, row: ScampState) -> jax.Array:
+        n = self.cfg.n_nodes
+        m = jnp.zeros((n,), bool)
+        return m.at[jnp.clip(row.partial, 0, n - 1)].max(row.partial >= 0)
+
+    # ------------------------------------------------------------- primitives
+
+    def _keep_probability(self, row: ScampState) -> jax.Array:
+        if self.cfg.scamp_exact_keep_probability:
+            return 1.0 / (1.0 + ps.size(row.partial).astype(jnp.float32))
+        return jnp.float32(0.4)  # the reference's quantized coin (:352-360)
+
+    def _forward_on(self, row: ScampState, subject, key, valid=True) -> Msgs:
+        """Re-forward a subscription to ONE random partial-view member
+        (select_random_sublist(State, 1)).  The subject itself is an eligible
+        hop — the reference's view always contains self, so a walk landing on
+        its own subject just bounces onward next round."""
+        nxt = ps.random_member(row.partial, key)
+        return self.emit(jnp.where(valid, nxt, -1)[None],
+                         self.typ("forward_subscription"), subject=subject)
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_forward_subscription(self, cfg, me, row: ScampState, m, key):
+        """Keep w.p. P if the subject is new to me; otherwise re-forward the
+        walk.  The reference never drops a walk outright — a node receiving
+        its OWN subscription, or one it already holds, forwards another copy
+        (its view always contains itself, so select_random_sublist is never
+        empty; v1 :213-252).  Here self is implicit in the row encoding, so
+        the walk dies only when the partial view is truly empty."""
+        subject = m.data["subject"]
+        alive = (subject >= 0) & ~row.left
+        can_keep = alive & (subject != me) & ~ps.contains(row.partial, subject)
+        coin = jax.random.uniform(prng.decision_key(key, 0), ())
+        keep = can_keep & (coin < self._keep_probability(row))
+        new_partial = ps.insert(row.partial, jnp.where(keep, subject, -1))
+        row = row.replace(partial=new_partial)
+        kp = self._keep_notify(me, subject, keep)
+        fwd = self._forward_on(row, subject, prng.decision_key(key, 1),
+                               valid=alive & ~keep)
+        return row, self.merge(kp, fwd)
+
+    def _keep_notify(self, me, subject, keep) -> Msgs:
+        """v1 keeps silently; v2 overrides to notify the subject."""
+        return self.no_emit(cap=1)
+
+    def handle_remove_subscription(self, cfg, me, row: ScampState, m, key):
+        node = m.data["subject"]
+        present = ps.contains(row.partial, node) & (node != me)
+        # gossip the removal to the pre-removal view (v1 :191-212)
+        gossip = self.emit(jnp.where(present, row.partial, -1),
+                           self.typ("remove_subscription"), subject=node)
+        row = row.replace(partial=ps.remove(
+            row.partial, jnp.where(present, node, -1)))
+        return row, gossip
+
+    def handle_ping(self, cfg, me, row: ScampState, m, key):
+        # liveness only: remember when we last heard from anyone (:179-192);
+        # the ping payload carries its send round in `subject`
+        return row.replace(
+            last_msg_rnd=jnp.maximum(row.last_msg_rnd, m.data["subject"])), \
+            self.no_emit()
+
+    def handle_subscription(self, cfg, me, row: ScampState, m, key):
+        """A NEW subscription arriving at the contact node.
+
+        Paper mode (`scamp_paper_fanout`): forward one copy to every
+        partial-view member plus ``c`` extra copies to random members — the
+        SCAMP subscription algorithm that sustains (c+1)·ln N views.  An
+        empty-view contact keeps the subscription directly (first join).
+
+        Reference mode: identical to a forward_subscription walk hop."""
+        if not cfg.scamp_paper_fanout:
+            return self.handle_forward_subscription(cfg, me, row, m, key)
+        subject = m.data["subject"]
+        ok = (subject >= 0) & (subject != me) & ~row.left
+        lonely = ps.size(row.partial) == 0
+        keep = ok & lonely & ~ps.contains(row.partial, subject)
+        row = row.replace(partial=ps.insert(
+            row.partial, jnp.where(keep, subject, -1)))
+        kp = self._keep_notify(me, subject, keep)
+        fan = self.emit(jnp.where(ok & ~lonely, row.partial, -1),
+                        self.typ("forward_subscription"), subject=subject)
+        extras = ps.random_k(row.partial, prng.decision_key(key, 2),
+                             self.cfg.scamp_c)
+        ex = self.emit(jnp.where(ok & ~lonely, extras, -1),
+                       self.typ("forward_subscription"), subject=subject)
+        return row, self.merge(kp, fan, ex)
+
+    def handle_ctl_join(self, cfg, me, row: ScampState, m, key):
+        """join(contact): the joiner-side strategy callback (v1 :51-100):
+        adopt the contact, announce my subscription to it, and fan the
+        contact's subscription over my previous view ([myself] on a fresh
+        node — those copies walk from here, v1 :65-95)."""
+        contact = m.data["peer"]
+        ok = (contact >= 0) & (contact != me)
+        old_view = row.partial
+        was_empty = ps.size(old_view) == 0
+        row = row.replace(
+            partial=ps.insert(row.partial, jnp.where(ok, contact, -1)),
+            left=jnp.where(ok, False, row.left))
+        # announce my subscription to the contact
+        sub_me = self.emit(jnp.where(ok, contact, -1)[None],
+                           self.typ("subscription"), subject=me)
+        # forward the contact's subscription to everyone I already knew;
+        # a fresh node's view is just [myself], which the reference models
+        # as walk copies sent to self (fan 1 + sublist 1) — two self-hops
+        fan = self.emit(jnp.where(ok, old_view, -1),
+                        self.typ("forward_subscription"), subject=contact)
+        extras = ps.random_k(old_view, prng.decision_key(key, 2),
+                             self._extra_copies(cfg))
+        ex = self.emit(jnp.where(ok, extras, -1),
+                       self.typ("forward_subscription"), subject=contact)
+        self_hops = self.emit(
+            jnp.where(ok & was_empty, jnp.stack([me, me]), -1),
+            self.typ("forward_subscription"), subject=contact)
+        return row, self.merge(sub_me, fan, ex, self_hops)
+
+    def _extra_copies(self, cfg: Config) -> int:
+        return cfg.scamp_c  # v2 overrides with c − 1 (:64-117)
+
+    def handle_ctl_leave(self, cfg, me, row: ScampState, m, key):
+        """v1 leave (:102-124): drop + gossip removal (no rewiring)."""
+        target = m.data["peer"]
+        self_leave = target == me
+        gossip = self.emit(row.partial, self.typ("remove_subscription"),
+                           subject=target)
+        row = row.replace(
+            partial=jnp.where(self_leave, -1,
+                              ps.remove(row.partial, target)),
+            left=row.left | self_leave)
+        return row, gossip
+
+    # ------------------------------------------------------------------ timer
+
+    def tick(self, cfg, me, row: ScampState, rnd, key):
+        stay = ~row.left
+        due = (((rnd + me) % cfg.periodic_interval) == 0) & stay
+        pings = self.emit(jnp.where(due, row.partial, -1), self.typ("ping"),
+                          cap=self.tick_emit_cap, subject=rnd)
+        silence = rnd - row.last_msg_rnd
+        isolated = due & (silence > cfg.periodic_interval
+                          * cfg.scamp_message_window)
+        resub = self._forward_on(row, me, prng.decision_key(key, 3),
+                                 valid=isolated)
+        return row, self.merge(pings, resub, cap=self.tick_emit_cap)
+
+
+class ScampV2(ScampV1):
+    """v2: + in-view tracking (keep_subscription) and graceful leave by
+    rewiring (bootstrap_remove / replace_subscription), scamp_v2 :46-49."""
+
+    msg_types = ("subscription", "forward_subscription",
+                 "remove_subscription", "ping",
+                 "keep_subscription", "replace_subscription",
+                 "bootstrap_remove_subscription",
+                 "ctl_join", "ctl_leave")
+    version = 2
+
+    def _extra_copies(self, cfg: Config) -> int:
+        return max(cfg.scamp_c - 1, 0)  # "important difference" (v2 :104)
+
+    def _keep_notify(self, me, subject, keep) -> Msgs:
+        """Tell the subject we kept its subscription so it can record us in
+        its in-view (:314-321)."""
+        return self.emit(jnp.where(keep, subject, -1)[None],
+                         self.typ("keep_subscription"), cap=1)
+
+    def handle_keep_subscription(self, cfg, me, row: ScampState, m, key):
+        row = row.replace(in_view=ps.insert(row.in_view, m.src))
+        return row, self.no_emit()
+
+    def handle_replace_subscription(self, cfg, me, row: ScampState, m, key):
+        """Rewire: partial-view entries == node become replacement
+        (:239-260).  Skip when the replacement is already present or is me
+        (padded sets are sets)."""
+        node, repl = m.data["subject"], m.data["replacement"]
+        hit = (row.partial == node) & (node >= 0)
+        ok = (repl >= 0) & (repl != me) & ~ps.contains(row.partial, repl)
+        row = row.replace(partial=jnp.where(
+            hit, jnp.where(ok, repl, -1), row.partial))
+        return row, self.no_emit()
+
+    def handle_bootstrap_remove_subscription(self, cfg, me, row, m, key):
+        """Only the departing node acts (:200-238): rewire the first
+        L−(c−1) in-view members to partial-view members (round-robin),
+        remove-gossip to the rest, reset local state."""
+        node = m.data["subject"]
+        its_me = node == me
+        iv = ps.members_first(row.in_view)
+        pv = ps.members_first(row.partial)
+        L = ps.size(row.in_view)
+        n_pv = jnp.maximum(ps.size(row.partial), 1)
+        n_replace = jnp.maximum(L - (self.cfg.scamp_c - 1), 0)
+        k = jnp.arange(self.P)
+        is_replace = its_me & (k < n_replace) & (iv >= 0)
+        is_remove = its_me & (k >= n_replace) & (iv >= 0)
+        repl = pv[k % n_pv]
+        rmsgs = self.emit(jnp.where(is_replace, iv, -1),
+                          self.typ("replace_subscription"),
+                          subject=me, replacement=repl)
+        dmsgs = self.emit(jnp.where(is_remove, iv, -1),
+                          self.typ("remove_subscription"), subject=me)
+        row = row.replace(
+            partial=jnp.where(its_me, -1, row.partial),
+            in_view=jnp.where(its_me, -1, row.in_view),
+            left=row.left | its_me)
+        return row, self.merge(rmsgs, dmsgs)
+
+    def handle_ctl_leave(self, cfg, me, row: ScampState, m, key):
+        """leave(target) (v2 :180-190): notify the partial view (and the
+        target itself) with a bootstrap message; the target does the work."""
+        target = m.data["peer"]
+        to = jnp.concatenate([target[None], row.partial])
+        em = self.emit(to, self.typ("bootstrap_remove_subscription"),
+                       subject=target)
+        return row, em

@@ -1,0 +1,27 @@
+"""Fixed-capacity ring/slot allocation shared by the QoS backends.
+
+One idiom, three users (ack outstanding ring, causal pending buffer, rpc
+promise ring): find a free slot in a validity mask and write fields there,
+masked so a full ring is a visible no-op the caller must surface (SURVEY
+§7.3: overflow is counted, never silent).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def alloc(valid: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Returns (ok, slot): the first free slot of a [C] validity mask, with
+    ok False (and slot unspecified-but-in-range) when the ring is full."""
+    free = ~valid
+    return jnp.any(free), jnp.argmax(free)
+
+
+def masked_set(arr: jax.Array, slot: jax.Array, ok: jax.Array,
+               val) -> jax.Array:
+    """arr[slot] = val when ok, else unchanged (shape-stable)."""
+    return arr.at[slot].set(jnp.where(ok, val, arr[slot]))

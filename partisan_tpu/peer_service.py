@@ -21,16 +21,17 @@ from .ops import msg as msgops
 
 
 def _ctl(world: World, proto: ProtocolBase, node: int, typ_name: str,
-         **data) -> World:
+         delay: int = 0, **data) -> World:
     em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
-                    cap=1, **data)
+                    cap=1, delay=delay, **data)
     msgs, _ = msgops.inject(world.msgs, em, src=node)
     return world.replace(msgs=msgs)
 
 
-def join(world: World, proto: ProtocolBase, node: int, peer: int) -> World:
+def join(world: World, proto: ProtocolBase, node: int, peer: int,
+         delay: int = 0) -> World:
     """node joins the cluster via peer (partisan_peer_service:join/1 :52)."""
-    return _ctl(world, proto, node, "ctl_join",
+    return _ctl(world, proto, node, "ctl_join", delay=delay,
                 **{proto.ctl_peer_field: peer})
 
 
@@ -41,11 +42,16 @@ def leave(world: World, proto: ProtocolBase, node: int, target: int | None = Non
 
 
 def cluster(world: World, proto: ProtocolBase,
-            pairs: Sequence[Tuple[int, int]]) -> World:
+            pairs: Sequence[Tuple[int, int]],
+            stagger: int = 0) -> World:
     """Pairwise joins, the test-harness clustering pattern
-    (test/partisan_support.erl cluster/3)."""
-    for node, peer in pairs:
-        world = join(world, proto, node, peer)
+    (test/partisan_support.erl cluster/3).  ``stagger > 0`` trickles joins
+    in batches of ``stagger`` per round (the reference's sequential join +
+    avoid_rush jitter, pluggable :1423-1458) to keep join storms under the
+    contact node's inbox cap."""
+    for i, (node, peer) in enumerate(pairs):
+        world = join(world, proto, node, peer,
+                     delay=(i // stagger) if stagger else 0)
     return world
 
 

@@ -1,0 +1,133 @@
+"""Acknowledgement + retransmission — TPU-native rebuild of
+``src/partisan_acknowledgement_backend.erl`` (ETS store of
+{MessageClock, RescheduleableMessage}, store/ack/outstanding :49-78) plus
+the manager's 1 s ``retransmit`` timer that re-sends everything outstanding
+(partisan_pluggable_peer_service_manager.erl:905-942, 1299-1301).
+
+Per-node state is a fixed ring of outstanding slots (SURVEY §2.11: an
+"outstanding-message ring buffer per node; retransmit as a masked re-emit
+each round").  Delivery is at-least-once exactly like the reference: a
+retransmitted message that crosses its own ack is delivered twice; acks are
+keyed by a per-origin monotone sequence number (the analog of the message
+clock, pluggable :687, 737-741).
+
+:class:`AckedDelivery` is the runnable layer (the `with_ack` suite group,
+test/partisan_SUITE.erl:573).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import ring
+from ..ops.msg import Msgs
+
+
+@struct.dataclass
+class AckRow:
+    out_valid: jax.Array    # [R] outstanding slots
+    out_dst: jax.Array      # [R]
+    out_payload: jax.Array  # [R]
+    out_seq: jax.Array      # [R] origin-scoped message id
+    out_age: jax.Array      # [R] rounds since (re)transmission
+    next_seq: jax.Array     # scalar — monotone id source
+    seen: jax.Array         # [S] delivery counters per origin (test surface)
+
+
+def init_rows(n_nodes: int, ring_cap: int = 8) -> AckRow:
+    n = n_nodes
+    return AckRow(
+        out_valid=jnp.zeros((n, ring_cap), bool),
+        out_dst=jnp.zeros((n, ring_cap), jnp.int32),
+        out_payload=jnp.zeros((n, ring_cap), jnp.int32),
+        out_seq=jnp.zeros((n, ring_cap), jnp.int32),
+        out_age=jnp.zeros((n, ring_cap), jnp.int32),
+        next_seq=jnp.ones((n,), jnp.int32),
+        seen=jnp.zeros((n, n_nodes), jnp.int32),
+    )
+
+
+def store(row: AckRow, dst, payload) -> Tuple[AckRow, jax.Array, jax.Array]:
+    """acknowledgement_backend:store/2 — park an outgoing message until its
+    ack arrives.  Returns (row', seq, stored_ok); stored_ok False = ring
+    full (surfaced, never silent)."""
+    ok, slot = ring.alloc(row.out_valid)
+    seq = row.next_seq
+    wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+    row = row.replace(
+        out_valid=wr(row.out_valid, True),
+        out_dst=wr(row.out_dst, dst),
+        out_payload=wr(row.out_payload, payload),
+        out_seq=wr(row.out_seq, seq),
+        out_age=wr(row.out_age, 0),
+        next_seq=seq + 1,
+    )
+    return row, seq, ok
+
+
+def ack(row: AckRow, seq) -> AckRow:
+    """acknowledgement_backend:ack/1 — clear the matching slot."""
+    hit = row.out_valid & (row.out_seq == seq)
+    return row.replace(out_valid=row.out_valid & ~hit)
+
+
+def outstanding(row: AckRow) -> jax.Array:
+    return jnp.sum(row.out_valid).astype(jnp.int32)
+
+
+class AckedDelivery(ProtocolBase):
+    """``ctl_send`` ships an app message expecting an ack; unacked messages
+    are re-sent every ``retransmit_interval`` rounds (pluggable :905-942).
+    ``seen[origin]`` counts deliveries per origin — the store_proc assertion
+    surface of ack_test."""
+
+    msg_types = ("app", "app_ack", "ctl_send")
+
+    def __init__(self, cfg: Config, ring_cap: int = 8):
+        self.cfg = cfg
+        self.R = ring_cap
+        self.data_spec: Dict = {
+            "payload": ((), jnp.int32),
+            "seq": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+        }
+        self.emit_cap = 1
+        self.tick_emit_cap = ring_cap
+
+    def init(self, cfg: Config, key: jax.Array) -> AckRow:
+        return init_rows(cfg.n_nodes, self.R)
+
+    def handle_ctl_send(self, cfg, me, row: AckRow, m: Msgs, key):
+        dst = m.data["peer"]
+        row, seq, ok = store(row, dst, m.data["payload"])
+        em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("app"),
+                       payload=m.data["payload"], seq=seq)
+        return row, em
+
+    def handle_app(self, cfg, me, row: AckRow, m: Msgs, key):
+        """Deliver + send_acknowledgement back to the origin (pluggable
+        :1217-1227, 1612-1617)."""
+        src = jnp.clip(m.src, 0, row.seen.shape[0] - 1)
+        row = row.replace(seen=row.seen.at[src].add(1))
+        return row, self.emit(m.src[None], self.typ("app_ack"),
+                              seq=m.data["seq"])
+
+    def handle_app_ack(self, cfg, me, row: AckRow, m: Msgs, key):
+        return ack(row, m.data["seq"]), self.no_emit()
+
+    def tick(self, cfg, me, row: AckRow, rnd, key):
+        """Retransmit timer: re-emit every outstanding slot whose age hits
+        the interval; age resets on retransmission."""
+        age = jnp.where(row.out_valid, row.out_age + 1, 0)
+        due = row.out_valid & (age >= cfg.retransmit_interval)
+        row = row.replace(out_age=jnp.where(due, 0, age))
+        em = self.emit(jnp.where(due, row.out_dst, -1),
+                       self.typ("app"), cap=self.tick_emit_cap,
+                       payload=row.out_payload, seq=row.out_seq)
+        return row, em

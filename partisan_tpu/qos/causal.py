@@ -1,0 +1,195 @@
+"""Causal delivery — TPU-native rebuild of
+``src/partisan_causality_backend.erl`` (per-label gen_server).
+
+Reference semantics (sites):
+  * ``emit`` (:115-139): bump the local vclock; the wire message carries the
+    *order buffer entry for the destination* (the clock of the last message
+    we sent to that same destination — absent on first send) as its causal
+    dependency, plus the new message clock; then the order buffer is updated.
+  * ``receive`` (:143-154) buffers the message and attempts delivery of the
+    whole buffer; ``internal_receive_message`` (:232-254) delivers when the
+    receiver has no entry in the incoming order buffer (no dependency) or
+    when the local clock **dominates** the dependency clock.
+  * ``deliver`` (:193-223): local = increment(me, merge(local, msg_clock)).
+  * a periodic ``deliver`` timer retries the buffer (:168-180) — here every
+    round's drain plays that role (redelivery_interval 1).
+
+State is one row per node (vmap over N); the actor universe is the node-id
+table so clocks are dense ``[A] int32`` (qos/vclock.py).  The order buffer
+is ``[A, A]`` per node — O(N²) per node is intentional: causal labels are a
+small-cluster app feature in the reference too (causal_test runs on 2-3
+nodes, test/partisan_SUITE.erl:402).
+
+:class:`CausalDelivery` wraps the row ops into a runnable protocol — the
+analog of wiring the backend into the pluggable manager's forward_message
+path (partisan_pluggable_peer_service_manager.erl:693-725, 1198-1214).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import ring
+from ..ops.msg import Msgs
+from . import vclock
+
+
+@struct.dataclass
+class CausalRow:
+    vc: jax.Array          # [A] local vector clock
+    ob: jax.Array          # [A, A] order buffer: last clock sent per dst
+    ob_sent: jax.Array     # [A] bool — have we ever sent to dst (orddict key)
+    pend_valid: jax.Array  # [B] pending (buffered) messages
+    pend_src: jax.Array    # [B]
+    pend_payload: jax.Array  # [B]
+    pend_dep: jax.Array    # [B, A] dependency clock
+    pend_has_dep: jax.Array  # [B] bool
+    pend_clock: jax.Array  # [B, A] message clock
+    log: jax.Array         # [L] first L delivered payloads, delivery order
+    log_src: jax.Array     # [L] their senders
+    log_n: jax.Array       # scalar int32 TOTAL delivered count (may exceed L;
+                           # entries past L are delivered but unrecorded)
+    pend_dropped: jax.Array  # scalar int32 — messages lost to a full pending
+                             # ring (the reference buffers unboundedly
+                             # :148-151; fixed shapes make loss explicit)
+
+
+def init_rows(n_nodes: int, buf_cap: int = 8, log_cap: int = 16) -> CausalRow:
+    """Batched [N, ...] causal state (one label)."""
+    n, a = n_nodes, n_nodes
+    return CausalRow(
+        vc=jnp.zeros((n, a), jnp.int32),
+        ob=jnp.zeros((n, a, a), jnp.int32),
+        ob_sent=jnp.zeros((n, a), bool),
+        pend_valid=jnp.zeros((n, buf_cap), bool),
+        pend_src=jnp.zeros((n, buf_cap), jnp.int32),
+        pend_payload=jnp.zeros((n, buf_cap), jnp.int32),
+        pend_dep=jnp.zeros((n, buf_cap, a), jnp.int32),
+        pend_has_dep=jnp.zeros((n, buf_cap), bool),
+        pend_clock=jnp.zeros((n, buf_cap, a), jnp.int32),
+        log=jnp.full((n, log_cap), -1, jnp.int32),
+        log_src=jnp.full((n, log_cap), -1, jnp.int32),
+        log_n=jnp.zeros((n,), jnp.int32),
+        pend_dropped=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def emit(row: CausalRow, me: jax.Array, dst: jax.Array
+         ) -> Tuple[CausalRow, jax.Array, jax.Array, jax.Array]:
+    """The emit half (:115-139).  Returns (row', dep_clock, has_dep,
+    msg_clock) — the wire fields of the causal message."""
+    clock = vclock.increment(row.vc, me)
+    d = jnp.clip(dst, 0, row.ob.shape[0] - 1)
+    dep = row.ob[d]
+    has_dep = row.ob_sent[d]
+    row = row.replace(
+        vc=clock,
+        ob=row.ob.at[d].set(clock),
+        ob_sent=row.ob_sent.at[d].set(True),
+    )
+    return row, dep, has_dep, clock
+
+
+def receive(row: CausalRow, src, payload, dep, has_dep, clock
+            ) -> Tuple[CausalRow, jax.Array]:
+    """Buffer an incoming causal message (:143-154).  Returns (row',
+    dropped) — dropped is True when the pending ring is full (the reference
+    buffers unboundedly; fixed shapes force an explicit overflow signal)."""
+    ok, slot = ring.alloc(row.pend_valid)
+    wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+    row = row.replace(
+        pend_valid=wr(row.pend_valid, True),
+        pend_src=wr(row.pend_src, src),
+        pend_payload=wr(row.pend_payload, payload),
+        pend_dep=wr(row.pend_dep, dep),
+        pend_has_dep=wr(row.pend_has_dep, has_dep),
+        pend_clock=wr(row.pend_clock, clock),
+        pend_dropped=row.pend_dropped + (~ok).astype(jnp.int32),
+    )
+    return row, ~ok
+
+
+def drain(row: CausalRow, me: jax.Array) -> Tuple[CausalRow, jax.Array]:
+    """Attempt delivery of every buffered message (the fold of :149-152 +
+    the periodic deliver timer :168-180).  Two passes over the ring so a
+    delivery that satisfies another message's dependency in the same round
+    is honored (the reference re-folds on every receive).  Returns (row',
+    n_delivered)."""
+    B = row.pend_valid.shape[0]
+    L = row.log.shape[0]
+
+    def try_slot(i, carry):
+        row, n = carry
+        deliverable = row.pend_valid[i] & (
+            ~row.pend_has_dep[i]
+            | vclock.dominates(row.vc, row.pend_dep[i]))
+        new_vc = vclock.increment(vclock.merge(row.vc, row.pend_clock[i]), me)
+        li = jnp.clip(row.log_n, 0, L - 1)
+        record = deliverable & (row.log_n < L)  # log holds the first L only
+        row = row.replace(
+            vc=jnp.where(deliverable, new_vc, row.vc),
+            pend_valid=row.pend_valid.at[i].set(
+                row.pend_valid[i] & ~deliverable),
+            log=row.log.at[li].set(jnp.where(
+                record, row.pend_payload[i], row.log[li])),
+            log_src=row.log_src.at[li].set(jnp.where(
+                record, row.pend_src[i], row.log_src[li])),
+            log_n=row.log_n + deliverable.astype(jnp.int32),
+        )
+        return row, n + deliverable.astype(jnp.int32)
+
+    n0 = jnp.int32(0)
+    row, n = jax.lax.fori_loop(0, B, try_slot, (row, n0))
+    row, n = jax.lax.fori_loop(0, B, try_slot, (row, n))
+    return row, n
+
+
+class CausalDelivery(ProtocolBase):
+    """Runnable causal-messaging layer: ``ctl_csend`` stamps and ships a
+    causal message; receivers buffer and drain every round.  The delivery
+    log per node is the assertion surface (causal_test,
+    test/partisan_SUITE.erl:402)."""
+
+    msg_types = ("causal", "ctl_csend")
+
+    def __init__(self, cfg: Config, buf_cap: int = 8, log_cap: int = 16):
+        self.cfg = cfg
+        self.buf_cap, self.log_cap = buf_cap, log_cap
+        a = cfg.n_nodes
+        self.data_spec: Dict = {
+            "payload": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+            "dep": ((a,), jnp.int32),
+            "has_dep": ((), jnp.int32),
+            "clock": ((a,), jnp.int32),
+            "cdelay": ((), jnp.int32),  # test hook: wire delay for reordering
+        }
+        self.emit_cap = 1
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> CausalRow:
+        return init_rows(cfg.n_nodes, self.buf_cap, self.log_cap)
+
+    def handle_ctl_csend(self, cfg, me, row: CausalRow, m: Msgs, key):
+        dst = m.data["peer"]
+        row, dep, has_dep, clock = emit(row, me, dst)
+        em = self.emit(dst[None], self.typ("causal"),
+                       payload=m.data["payload"], dep=dep,
+                       has_dep=has_dep.astype(jnp.int32), clock=clock,
+                       delay=m.data["cdelay"])
+        return row, em
+
+    def handle_causal(self, cfg, me, row: CausalRow, m: Msgs, key):
+        row, _ = receive(row, m.src, m.data["payload"], m.data["dep"],
+                         m.data["has_dep"] > 0, m.data["clock"])
+        return row, self.no_emit()
+
+    def tick(self, cfg, me, row: CausalRow, rnd, key):
+        row, _ = drain(row, me)
+        return row, self.no_emit(self.tick_emit_cap)

@@ -1,0 +1,108 @@
+"""RPC over the simulated overlay — TPU-native rebuild of
+``src/partisan_rpc_backend.erl``: ``call/5`` forwards
+``{call, M, F, A, Timeout, {origin, Node, Self}}`` on the rpc channel
+(:49-65, 120-127); the receiving side applies the function and replies
+(:84-99); ``partisan_promise_backend`` is the reply store.
+
+The TPU analog: the callable surface is a static table of pure jittable
+functions (the reference dispatches to M:F — dynamic code loading has no
+jit analog, so functions register at trace time); a call ships
+``(ref, fn, arg)``, the server applies ``lax.switch`` over the table and
+replies ``(ref, result)``; replies land in a fixed promise ring per node
+(the promise backend), matched by ref.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import ring
+from ..ops.msg import Msgs
+
+
+@struct.dataclass
+class RpcRow:
+    next_ref: jax.Array      # scalar — per-node monotone call ref
+    prom_valid: jax.Array    # [P] promise ring (partisan_promise_backend)
+    prom_ref: jax.Array      # [P]
+    prom_result: jax.Array   # [P]
+    prom_done: jax.Array     # [P] reply arrived
+
+
+def init_rows(n_nodes: int, promise_cap: int = 8) -> RpcRow:
+    n = n_nodes
+    return RpcRow(
+        next_ref=jnp.ones((n,), jnp.int32),
+        prom_valid=jnp.zeros((n, promise_cap), bool),
+        prom_ref=jnp.zeros((n, promise_cap), jnp.int32),
+        prom_result=jnp.zeros((n, promise_cap), jnp.int32),
+        prom_done=jnp.zeros((n, promise_cap), bool),
+    )
+
+
+class Rpc(ProtocolBase):
+    """``ctl_call`` = partisan_rpc_backend:call (fire a request, park a
+    promise); the reply fulfils the promise.  ``fns`` is the registered
+    function table: int32 -> int32 pure functions."""
+
+    msg_types = ("rpc_req", "rpc_reply", "ctl_call")
+
+    def __init__(self, cfg: Config,
+                 fns: Sequence[Callable[[jax.Array], jax.Array]] = (),
+                 promise_cap: int = 8):
+        self.cfg = cfg
+        self.fns = tuple(fns) or (lambda x: x,)
+        self.P = promise_cap
+        self.data_spec: Dict = {
+            "ref": ((), jnp.int32),
+            "fn": ((), jnp.int32),
+            "arg": ((), jnp.int32),
+            "result": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+        }
+        self.emit_cap = 1
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> RpcRow:
+        return init_rows(cfg.n_nodes, self.P)
+
+    def handle_ctl_call(self, cfg, me, row: RpcRow, m: Msgs, key):
+        dst, fn, arg = m.data["peer"], m.data["fn"], m.data["arg"]
+        ok, slot = ring.alloc(row.prom_valid)
+        ok = ok & (dst >= 0)
+        ref = row.next_ref
+        wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+        row = row.replace(
+            next_ref=ref + 1,
+            prom_valid=wr(row.prom_valid, True),
+            prom_ref=wr(row.prom_ref, ref),
+            prom_done=wr(row.prom_done, False),
+        )
+        em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("rpc_req"),
+                       ref=ref, fn=fn, arg=arg)
+        return row, em
+
+    def handle_rpc_req(self, cfg, me, row: RpcRow, m: Msgs, key):
+        """Server side: apply the registered function, reply to origin
+        (rpc_backend :84-99)."""
+        fn = jnp.clip(m.data["fn"], 0, len(self.fns) - 1)
+        result = jax.lax.switch(fn, self.fns, m.data["arg"])
+        return row, self.emit(m.src[None], self.typ("rpc_reply"),
+                              ref=m.data["ref"], result=result)
+
+    def handle_rpc_reply(self, cfg, me, row: RpcRow, m: Msgs, key):
+        """Fulfil the promise and free its slot for reuse (the reference's
+        promise backend discards resolved promises); the done flag and
+        result stay readable until the slot is reallocated."""
+        hit = row.prom_valid & (row.prom_ref == m.data["ref"])
+        row = row.replace(
+            prom_valid=row.prom_valid & ~hit,
+            prom_done=row.prom_done | hit,
+            prom_result=jnp.where(hit, m.data["result"], row.prom_result))
+        return row, self.no_emit()

@@ -1,0 +1,57 @@
+"""Vector clocks over a fixed actor universe — the TPU-native rebuild of
+``src/partisan_vclock.erl`` (Riak-derived: fresh/descends/dominates/merge/
+increment/glb, :57-77 ff.).
+
+The reference represents a clock as an orddict ``[{actor, counter}]`` over
+dynamically-discovered actors; here the actor universe is the node-id table
+(SURVEY §5.6), so a clock is a dense ``[A] int32`` row and every comparison
+is a vectorized reduction.  All functions operate on single clocks and are
+designed to be ``vmap``-ped; "absent actor" equals counter 0 exactly as in
+the reference (missing orddict key defaults to 0 in descends).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fresh(n_actors: int) -> jax.Array:
+    """partisan_vclock:fresh/0 — the zero clock."""
+    return jnp.zeros((n_actors,), jnp.int32)
+
+
+def increment(clock: jax.Array, actor: jax.Array) -> jax.Array:
+    """partisan_vclock:increment/2 — bump one actor's counter."""
+    return clock.at[actor].add(1)
+
+
+def merge(a: jax.Array, b: jax.Array) -> jax.Array:
+    """partisan_vclock:merge/1 — pointwise max."""
+    return jnp.maximum(a, b)
+
+
+def glb(a: jax.Array, b: jax.Array) -> jax.Array:
+    """partisan_vclock:glb/2 — pointwise min (greatest lower bound)."""
+    return jnp.minimum(a, b)
+
+
+def descends(a: jax.Array, b: jax.Array) -> jax.Array:
+    """True iff ``a`` has seen every event ``b`` has (a >= b pointwise) —
+    partisan_vclock:descends/2.  Every clock descends the fresh clock."""
+    return jnp.all(a >= b)
+
+
+def dominates(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Strict descent: descends(a, b) and a != b
+    (partisan_vclock:dominates/2)."""
+    return descends(a, b) & jnp.any(a > b)
+
+
+def equal(a: jax.Array, b: jax.Array) -> jax.Array:
+    return jnp.all(a == b)
+
+
+def concurrent(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Neither descends the other."""
+    return ~descends(a, b) & ~descends(b, a)

@@ -1,0 +1,163 @@
+"""QoS backend tests — vclock unit tests (partisan_vclock.erl:41-43 inline
+eunit analog), causal_test (test/partisan_SUITE.erl:402), ack_test (:573)
+and rpc_test (:813) rebuilt as batched assertions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.ops import msg as msgops
+from partisan_tpu.qos import vclock
+from partisan_tpu.qos.ack import AckedDelivery, outstanding
+from partisan_tpu.qos.causal import CausalDelivery
+from partisan_tpu.qos.rpc import Rpc
+
+
+# ---------------------------------------------------------------- vclock
+
+class TestVClock:
+    def test_fresh_descends_all(self):
+        a = vclock.fresh(4)
+        assert bool(vclock.descends(a, a))
+        assert not bool(vclock.dominates(a, a))
+
+    def test_increment_dominates(self):
+        a = vclock.fresh(4)
+        b = vclock.increment(a, jnp.int32(1))
+        assert bool(vclock.descends(b, a))
+        assert bool(vclock.dominates(b, a))
+        assert not bool(vclock.descends(a, b))
+
+    def test_concurrent(self):
+        a = vclock.increment(vclock.fresh(4), jnp.int32(0))
+        b = vclock.increment(vclock.fresh(4), jnp.int32(1))
+        assert bool(vclock.concurrent(a, b))
+        m = vclock.merge(a, b)
+        assert bool(vclock.descends(m, a)) and bool(vclock.descends(m, b))
+
+    def test_glb(self):
+        a = jnp.asarray([2, 0, 1, 0], jnp.int32)
+        b = jnp.asarray([1, 3, 1, 0], jnp.int32)
+        assert (np.asarray(vclock.glb(a, b)) == [1, 0, 1, 0]).all()
+
+
+# ---------------------------------------------------------------- helpers
+
+def send_ctl(world, proto, node, typ_name, **data):
+    em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
+                    cap=1, **data)
+    msgs, _ = msgops.inject(world.msgs, em, src=node)
+    return world.replace(msgs=msgs)
+
+
+# ---------------------------------------------------------------- causal
+
+class TestCausal:
+    def test_fifo_under_reordering(self):
+        """causal_test: three messages 0 -> 1 whose wire delays REVERSE the
+        arrival order must still be delivered in send order (the dependency
+        clock of each message is the clock of the previous send to the same
+        destination, causality_backend :115-139)."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = CausalDelivery(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False, randomize_delivery=False)
+        # payload i sent in one batch; delays 6/3/0 reverse arrival order
+        for i, d in ((1, 6), (2, 3), (3, 0)):
+            world = send_ctl(world, proto, 0, "ctl_csend",
+                             peer=1, payload=i, cdelay=d)
+        for _ in range(14):
+            world, _ = step(world)
+        log = np.asarray(world.state.log[1])
+        n = int(world.state.log_n[1])
+        assert n == 3
+        assert list(log[:3]) == [1, 2, 3], f"causal order violated: {log[:3]}"
+
+    def test_no_dependency_delivers_immediately(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = CausalDelivery(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 2, "ctl_csend",
+                         peer=3, payload=7, cdelay=0)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.log_n[3]) == 1
+        assert int(world.state.log[3][0]) == 7
+
+    def test_transitive_chain(self):
+        """0 -> 1 -> 2 chain: each hop's delivery precedes the next send, so
+        all logs fill despite random delivery order."""
+        cfg = pt.Config(n_nodes=3, inbox_cap=8)
+        proto = CausalDelivery(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 0, "ctl_csend",
+                         peer=1, payload=10, cdelay=0)
+        for _ in range(4):
+            world, _ = step(world)
+        world = send_ctl(world, proto, 1, "ctl_csend",
+                         peer=2, payload=11, cdelay=0)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.log_n[1]) == 1
+        assert int(world.state.log_n[2]) == 1
+
+
+# ------------------------------------------------------------------- ack
+
+class TestAck:
+    def _world(self, drop_rounds=0):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=3)
+        proto = AckedDelivery(cfg)
+        interpose = None
+        if drop_rounds:
+            def interpose(m, rnd):
+                # omission fault: drop app messages in early rounds
+                # (interposition fun returning `undefined`,
+                # crash_fault_model :116-140)
+                drop = (m.typ == proto.typ("app")) & (rnd < drop_rounds)
+                return m.replace(valid=m.valid & ~drop)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=interpose)
+        return cfg, proto, world, step
+
+    def test_delivery_and_ring_clears(self):
+        cfg, proto, world, step = self._world()
+        world = send_ctl(world, proto, 0, "ctl_send", peer=2, payload=9)
+        for _ in range(8):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) >= 1          # delivered
+        assert int(outstanding(jax.tree_util.tree_map(
+            lambda x: x[0], world.state))) == 0          # acked + cleared
+
+    def test_retransmit_through_omission(self):
+        """ack_test with send-omission faults: the first transmissions are
+        dropped; the retransmit timer must eventually get it through."""
+        cfg, proto, world, step = self._world(drop_rounds=5)
+        world = send_ctl(world, proto, 0, "ctl_send", peer=2, payload=9)
+        for _ in range(20):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) >= 1
+        assert int(outstanding(jax.tree_util.tree_map(
+            lambda x: x[0], world.state))) == 0
+
+
+# ------------------------------------------------------------------- rpc
+
+class TestRpc:
+    def test_call_reply(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = Rpc(cfg, fns=(lambda x: x * 2, lambda x: x + 100))
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 0, "ctl_call", peer=3, fn=0, arg=21)
+        world = send_ctl(world, proto, 1, "ctl_call", peer=3, fn=1, arg=5)
+        for _ in range(6):
+            world, _ = step(world)
+        st = world.state
+        assert bool(st.prom_done[0][0]) and int(st.prom_result[0][0]) == 42
+        assert bool(st.prom_done[1][0]) and int(st.prom_result[1][0]) == 105

@@ -1,0 +1,135 @@
+"""SCAMP v1/v2 tests — the `with_scamp_v1/v2_membership_strategy` suite
+groups (test/partisan_SUITE.erl:121-308, connectivity_test :1214) plus the
+BASELINE config #4 bar (ScampV2 at 1024 simulated nodes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.models.scamp import ScampV1, ScampV2, default_view_cap
+from partisan_tpu.ops import graph
+
+
+def boot(proto_cls, n, rounds, stagger=4, cfg_kw=None, **proto_kw):
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=5,
+                    **(cfg_kw or {}))
+    proto = proto_cls(cfg, **proto_kw)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = peer_service.cluster(world, proto,
+                                 [(i, 0) for i in range(1, n)],
+                                 stagger=stagger)
+    for _ in range(rounds):
+        world, m = step(world)
+    return cfg, proto, world, step
+
+
+def view_sizes(world):
+    return np.asarray(jax.vmap(lambda a: (a >= 0).sum())(world.state.partial))
+
+
+@pytest.mark.parametrize("proto_cls", [ScampV1, ScampV2])
+class TestConnectivity:
+    """connectivity_test analog: after joins + gossip rounds, the directed
+    subscription graph must let every node reach every other."""
+
+    def test_small_cluster_connected(self, proto_cls):
+        n = 16
+        _, _, world, _ = boot(proto_cls, n, 40)
+        adj = graph.adjacency_from_views(world.state.partial, n)
+        # partial views are DIRECTED; connectivity bar is weak connectivity
+        sym = adj | adj.T
+        assert bool(graph.is_connected(sym))
+
+    def test_view_sizes_scale(self, proto_cls):
+        """Mean partial-view size lands near the SCAMP fixed point
+        (c+1)·ln N rather than degenerating to 0 or N."""
+        n = 64
+        cfg, _, world, _ = boot(proto_cls, n, 60)
+        sizes = view_sizes(world)
+        target = (cfg.scamp_c + 1) * np.log(n)
+        assert sizes.mean() >= 2.0
+        assert sizes.mean() <= 2.5 * target
+        assert (sizes <= default_view_cap(n, cfg.scamp_c)).all()
+
+
+class TestV2Specifics:
+    def test_keep_builds_in_view(self):
+        n = 16
+        _, _, world, _ = boot(ScampV2, n, 40)
+        iv = np.asarray(jax.vmap(lambda a: (a >= 0).sum())(
+            world.state.in_view))
+        # someone must have recorded keepers (in-view edges mirror kept
+        # subscriptions, scamp_v2 :328-338)
+        assert iv.sum() > 0
+
+    def test_graceful_leave_rewires(self):
+        """After leave(5), node 5 vanishes from every partial view but the
+        survivors stay weakly connected (bootstrap_remove_subscription
+        rewiring, scamp_v2 :192-238)."""
+        n = 16
+        cfg, proto, world, step = boot(ScampV2, n, 40)
+        world = peer_service.leave(world, proto, 5)
+        for _ in range(25):
+            world, _ = step(world)
+        part = np.asarray(world.state.partial)
+        alive = np.ones(n, bool)
+        alive[5] = False
+        assert not (part[alive] == 5).any(), "departed node still referenced"
+        adj = graph.adjacency_from_views(world.state.partial, n)
+        sym = (adj | adj.T) & alive[None, :] & alive[:, None]
+        assert bool(graph.is_connected(sym, jnp.asarray(alive)))
+
+    def test_isolation_resubscribe(self):
+        """An isolated node (empty views, silent peers) re-subscribes after
+        the silence window (scamp_v2 :130-178)."""
+        n = 8
+        cfg, proto, world, step = boot(
+            ScampV2, n, 30, cfg_kw={"scamp_message_window": 2})
+        # force-isolate node 3: wipe its views and every reference to it
+        st = world.state
+        part = jnp.where(jnp.arange(n)[:, None] == 3, -1, st.partial)
+        part = jnp.where(part == 3, -1, part)
+        world = world.replace(state=st.replace(
+            partial=part,
+            in_view=jnp.where(jnp.arange(n)[:, None] == 3, -1, st.in_view)))
+        for _ in range(cfg.periodic_interval * cfg.scamp_message_window + 40):
+            world, _ = step(world)
+        adj = graph.adjacency_from_views(world.state.partial, n)
+        sym = adj | adj.T
+        assert bool(sym[3].any() or sym[:, 3].any()), \
+            "isolated node never re-subscribed"
+
+
+def test_reference_coin_compat_flag():
+    """scamp_exact_keep_probability=False reproduces the reference's
+    0.4-quantized keep coin (scamp_v2 :352-360); the cluster still forms."""
+    n = 16
+    _, _, world, _ = boot(
+        ScampV2, n, 40, cfg_kw={"scamp_exact_keep_probability": False})
+    adj = graph.adjacency_from_views(world.state.partial, n)
+    assert bool(graph.is_connected(adj | adj.T))
+
+
+@pytest.mark.slow
+def test_scamp_v2_1024_nodes():
+    """BASELINE config #4: ScampV2 at 1024 simulated nodes — the overlay
+    must be weakly connected and view sizes must stay near (c+1)·ln N."""
+    n = 1024
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=5)
+    proto = ScampV2(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = peer_service.cluster(world, proto,
+                                 [(i, 0) for i in range(1, n)], stagger=8)
+    for _ in range(220):
+        world, _ = step(world)
+    sizes = view_sizes(world)
+    assert sizes.mean() >= 2.0
+    adj = graph.adjacency_from_views(world.state.partial, n)
+    sym = adj | adj.T
+    # all-pairs reachability on the undirected closure
+    assert bool(graph.is_connected(sym))
