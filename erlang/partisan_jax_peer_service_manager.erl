@@ -1,0 +1,262 @@
+%% -------------------------------------------------------------------
+%% partisan_jax_peer_service_manager: peer-service manager backed by the
+%% partisan_tpu simulator over an Erlang port.
+%%
+%% Drop-in for the `partisan_peer_service_manager' behaviour
+%% (reference: src/partisan_peer_service_manager.erl:30-67): set
+%%   {partisan, [{partisan_peer_service_manager,
+%%                partisan_jax_peer_service_manager}]}
+%% and N virtual nodes run as rows of a sharded JAX array on the TPU;
+%% join/leave/members map onto port commands (bridge/port_server.py);
+%% rounds advance on a timer tick.  Real Erlang processes address virtual
+%% nodes by integer id carried in the node_spec's name:
+%% 'vnodeN@jax' <-> row N.
+%%
+%% Wire: open_port/2 with {packet, 4} + binary, terms via term_to_binary
+%% — the same framing the reference uses for its own peer links
+%% (src/partisan_socket.erl:17-19).
+%%
+%% NOTE: the build image for the TPU rebuild carries no Erlang toolchain;
+%% this module is compiled and exercised only in deployments that embed
+%% the simulator into a live partisan cluster.  The Python PortClient
+%% (bridge/client.py) drives the identical wire protocol in CI.
+%% -------------------------------------------------------------------
+-module(partisan_jax_peer_service_manager).
+
+-behaviour(gen_server).
+-behaviour(partisan_peer_service_manager).
+
+%% partisan_peer_service_manager callbacks
+-export([start_link/0,
+         members/0,
+         myself/0,
+         get_local_state/0,
+         join/1,
+         sync_join/1,
+         leave/0,
+         leave/1,
+         update_members/1,
+         on_down/2,
+         on_up/2,
+         forward_message/2,
+         forward_message/3,
+         forward_message/4,
+         forward_message/5,
+         cast_message/3,
+         cast_message/4,
+         cast_message/5,
+         receive_message/2,
+         decode/1,
+         reserve/1,
+         partitions/0,
+         inject_partition/2,
+         resolve_partition/1,
+         send_message/2]).
+
+%% gen_server callbacks
+-export([init/1, handle_call/3, handle_cast/2, handle_info/2,
+         terminate/2, code_change/3]).
+
+-define(ROUND_INTERVAL, 100).  %% ms per simulator round quantum
+-define(ADVANCE_ROUNDS, 1).
+
+-record(state, {port          :: port(),
+                myid          :: non_neg_integer(),
+                n_nodes       :: pos_integer(),
+                manager       :: atom(),
+                membership    :: [non_neg_integer()]}).
+
+%%%===================================================================
+%%% API
+%%%===================================================================
+
+start_link() ->
+    gen_server:start_link({local, ?MODULE}, ?MODULE, [], []).
+
+members() ->
+    gen_server:call(?MODULE, members, infinity).
+
+myself() ->
+    partisan_peer_service_manager:myself().
+
+get_local_state() ->
+    gen_server:call(?MODULE, get_local_state, infinity).
+
+join(NodeSpec) ->
+    gen_server:call(?MODULE, {join, NodeSpec}, infinity).
+
+sync_join(NodeSpec) ->
+    gen_server:call(?MODULE, {join, NodeSpec}, infinity).
+
+leave() ->
+    gen_server:call(?MODULE, {leave, self_id}, infinity).
+
+leave(NodeSpec) ->
+    gen_server:call(?MODULE, {leave, NodeSpec}, infinity).
+
+update_members(_Members) ->
+    {error, not_implemented}.
+
+on_down(_Name, _Fun) ->
+    {error, not_implemented}.
+
+on_up(_Name, _Fun) ->
+    {error, not_implemented}.
+
+forward_message(Pid, Message) ->
+    forward_message(Pid, undefined, Message).
+
+forward_message(Name, ServerRef, Message) ->
+    forward_message(Name, undefined, ServerRef, Message).
+
+forward_message(Name, Channel, ServerRef, Message) ->
+    forward_message(Name, Channel, ServerRef, Message, []).
+
+forward_message(Name, _Channel, ServerRef, Message, _Options) ->
+    gen_server:call(?MODULE,
+                    {forward_message, Name, ServerRef, Message},
+                    infinity).
+
+cast_message(Name, ServerRef, Message) ->
+    cast_message(Name, undefined, ServerRef, Message).
+
+cast_message(Name, Channel, ServerRef, Message) ->
+    cast_message(Name, Channel, ServerRef, Message, []).
+
+cast_message(Name, _Channel, ServerRef, Message, _Options) ->
+    gen_server:cast(?MODULE, {forward_message, Name, ServerRef, Message}).
+
+receive_message(_Peer, Message) ->
+    partisan_util:process_forward(?MODULE, Message).
+
+decode(State) ->
+    State.
+
+reserve(_Tag) ->
+    {error, no_available_slots}.
+
+partitions() ->
+    {error, not_implemented}.
+
+inject_partition(_Origin, _TTL) ->
+    {error, not_implemented}.
+
+resolve_partition(_Reference) ->
+    {error, not_implemented}.
+
+send_message(Name, Message) ->
+    forward_message(Name, undefined, Message).
+
+%%%===================================================================
+%%% gen_server callbacks
+%%%===================================================================
+
+init([]) ->
+    NNodes = partisan_config:get(jax_n_nodes, 64),
+    Manager = partisan_config:get(jax_manager, hyparview),
+    MyId = partisan_config:get(jax_my_id, 0),
+    Python = partisan_config:get(jax_python, "python3"),
+    Port = open_port({spawn_executable, os:find_executable(Python)},
+                     [{args, ["-m", "partisan_tpu.bridge.port_server"]},
+                      {packet, 4}, binary, exit_status]),
+    ok = command(Port, {start, Manager, [{n_nodes, NNodes}]}),
+    erlang:send_after(?ROUND_INTERVAL, self(), advance),
+    {ok, #state{port=Port, myid=MyId, n_nodes=NNodes,
+                manager=Manager, membership=[MyId]}}.
+
+handle_call(members, _From, #state{port=Port, myid=MyId}=State) ->
+    {ok, Ids} = command(Port, {members, MyId}),
+    {reply, {ok, [id_to_node(Id) || Id <- Ids]}, State};
+
+handle_call(get_local_state, _From, #state{membership=M}=State) ->
+    {reply, {state, undefined, M}, State};
+
+handle_call({join, NodeSpec}, _From,
+            #state{port=Port, myid=MyId}=State) ->
+    ok = command(Port, {join, MyId, node_to_id(NodeSpec)}),
+    {reply, ok, State};
+
+handle_call({leave, self_id}, _From,
+            #state{port=Port, myid=MyId}=State) ->
+    ok = command(Port, {leave, MyId}),
+    {reply, ok, State};
+
+handle_call({leave, NodeSpec}, _From, #state{port=Port}=State) ->
+    ok = command(Port, {leave, node_to_id(NodeSpec)}),
+    {reply, ok, State};
+
+handle_call({forward_message, Name, ServerRef, Message}, _From,
+            #state{}=State) ->
+    %% Data-plane messages ride disterl to the owning BEAM node while the
+    %% overlay membership itself is simulated on the TPU; a full virtual
+    %% data plane goes through the batched enqueue command instead.
+    Node = case Name of
+               N when is_atom(N) -> N;
+               #{name := N} -> N
+           end,
+    _ = erlang:send({ServerRef, Node}, Message, [noconnect]),
+    {reply, ok, State};
+
+handle_call(_Msg, _From, State) ->
+    {reply, {error, unknown_call}, State}.
+
+handle_cast({forward_message, Name, ServerRef, Message}, State) ->
+    {reply, ok, S} =
+        handle_call({forward_message, Name, ServerRef, Message},
+                    undefined, State),
+    {noreply, S};
+
+handle_cast(_Msg, State) ->
+    {noreply, State}.
+
+handle_info(advance, #state{port=Port, myid=MyId}=State) ->
+    {ok, _Metrics} = command(Port, {advance, ?ADVANCE_ROUNDS}),
+    {ok, Ids} = command(Port, {members, MyId}),
+    partisan_peer_service_events:update([id_to_node(Id) || Id <- Ids]),
+    erlang:send_after(?ROUND_INTERVAL, self(), advance),
+    {noreply, State#state{membership=Ids}};
+
+handle_info({Port, {exit_status, Status}}, #state{port=Port}=State) ->
+    {stop, {port_exited, Status}, State};
+
+handle_info(_Msg, State) ->
+    {noreply, State}.
+
+terminate(_Reason, #state{port=Port}) ->
+    catch command(Port, stop),
+    catch port_close(Port),
+    ok.
+
+code_change(_OldVsn, State, _Extra) ->
+    {ok, State}.
+
+%%%===================================================================
+%%% Internal
+%%%===================================================================
+
+command(Port, Term) ->
+    Port ! {self(), {command, term_to_binary(Term)}},
+    receive
+        {Port, {data, Data}} ->
+            case binary_to_term(Data) of
+                ok -> ok;
+                {ok, Result} -> {ok, Result};
+                {error, Reason} -> {error, Reason}
+            end
+    after 60000 ->
+            {error, port_timeout}
+    end.
+
+%% Virtual node ids <-> node_spec names: 'vnodeN@jax'.
+id_to_node(Id) ->
+    Name = list_to_atom("vnode" ++ integer_to_list(Id) ++ "@jax"),
+    #{name => Name, listen_addrs => [], channels => [undefined],
+      parallelism => 1}.
+
+node_to_id(#{name := Name}) ->
+    node_to_id(Name);
+node_to_id(Name) when is_atom(Name) ->
+    S = atom_to_list(Name),
+    {match, [Digits]} = re:run(S, "^vnode([0-9]+)@",
+                               [{capture, all_but_first, list}]),
+    list_to_integer(Digits).

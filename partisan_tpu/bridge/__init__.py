@@ -1,0 +1,18 @@
+"""Erlang port bridge (SURVEY §7.1 plane 2): the control-plane link that
+lets an unmodified Erlang node drive the TPU simulator as its peer-service
+backend.
+
+Wire stack, mirroring how the reference frames its own peer links
+(``{packet, 4}`` + External Term Format, partisan_socket.erl:17-19,
+partisan_peer_service_client.erl:275-276):
+
+  Erlang `partisan_jax_peer_service_manager` (erlang/…erl)
+    <-> port, 4-byte big-endian length frames
+    <-> ETF terms (bridge/etf.py codec; C++ bulk path in native/)
+    <-> bridge/port_server.py command loop
+    <-> partisan_tpu engine (one World per session)
+
+Commands batch per round quantum — the port never round-trips per message
+(SURVEY §7.3 "Host<->device bridge latency")."""
+
+from .etf import Atom, decode, encode  # noqa: F401

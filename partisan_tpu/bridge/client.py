@@ -1,0 +1,71 @@
+"""Python port client — drives a ``port_server`` subprocess over the same
+packet-4/ETF wire the Erlang manager uses.  Stands in for the Erlang side
+in tests and doubles as a host-language API for driving remote simulator
+processes."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Any, List, Optional
+
+from . import etf
+from .etf import Atom
+
+
+class PortClient:
+    def __init__(self, env: Optional[dict] = None):
+        e = dict(os.environ)
+        e.setdefault("JAX_PLATFORMS", "cpu")
+        e.update(env or {})
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "partisan_tpu.bridge.port_server"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=e)
+
+    def call(self, term: Any) -> Any:
+        self.proc.stdin.write(etf.frame(etf.encode(term)))
+        self.proc.stdin.flush()
+        payload = etf.read_frame(self.proc.stdout)
+        if not payload:
+            raise EOFError("port server closed")
+        return etf.decode(payload)
+
+    # convenience verbs mirroring partisan_peer_service
+    def start(self, manager: str, **props) -> Any:
+        plist = [(Atom(k), list(v) if isinstance(v, tuple) else v)
+                 for k, v in props.items()]
+        return self.call((Atom("start"), Atom(manager), plist))
+
+    def join(self, node: int, peer: int) -> Any:
+        return self.call((Atom("join"), node, peer))
+
+    def leave(self, node: int) -> Any:
+        return self.call((Atom("leave"), node))
+
+    def advance(self, k: int) -> Any:
+        return self.call((Atom("advance"), k))
+
+    def members(self, node: int) -> List[int]:
+        ok, ids = self.call((Atom("members"), node))
+        assert ok == Atom("ok")
+        return ids
+
+    def health(self) -> dict:
+        ok, h = self.call(Atom("health"))
+        assert ok == Atom("ok")
+        return h
+
+    def stop(self) -> None:
+        try:
+            self.call(Atom("stop"))
+        finally:
+            self.proc.stdin.close()
+            self.proc.wait(timeout=30)
+
+    def __enter__(self) -> "PortClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.proc.poll() is None:
+            self.stop()

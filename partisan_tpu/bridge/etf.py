@@ -1,0 +1,250 @@
+"""External Term Format codec — the wire encoding of the Erlang port
+bridge, mirroring the reference's use of ``term_to_binary``/ETF over its
+peer links (partisan_util.erl term_to_iolist :235-297,
+partisan_peer_service_client.erl:275-276).
+
+Pure-Python reference implementation of the subset the port protocol
+needs: integers (small/32-bit/bignum), atoms, binaries, strings, floats,
+tuples, lists, maps.  Mapping:
+
+  Erlang                   Python
+  ------                   ------
+  atom                     :class:`Atom` (str subclass)
+  integer                  int
+  float (NEW_FLOAT)        float
+  binary                   bytes
+  tuple                    tuple
+  list                     list        (STRING_EXT decodes to list[int])
+  map                      dict
+
+The bulk fast path (flat int lists, e.g. member ids and message batches)
+is delegated to the C++ native codec when built (native_loader.py); this
+module is the behavioural reference it is tested against.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Tuple
+
+VERSION = 131
+
+# tags (erts term format)
+NEW_FLOAT = 70
+SMALL_INT = 97
+INT = 98
+ATOM = 100          # deprecated latin-1 atom, decoded for compat
+SMALL_TUPLE = 104
+LARGE_TUPLE = 105
+NIL = 106
+STRING = 107
+LIST = 108
+BINARY = 109
+SMALL_BIG = 110
+LARGE_BIG = 111
+MAP = 116
+ATOM_UTF8 = 118
+SMALL_ATOM_UTF8 = 119
+
+
+class Atom(str):
+    """An Erlang atom; distinct from str (which encodes as binary)."""
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Atom({str.__repr__(self)})"
+
+
+def encode(term: Any) -> bytes:
+    """term_to_binary/1."""
+    out = bytearray([VERSION])
+    _enc(term, out)
+    return bytes(out)
+
+
+def _enc(t: Any, out: bytearray) -> None:
+    if isinstance(t, Atom):
+        b = t.encode("utf-8")
+        if len(b) < 256:
+            out.append(SMALL_ATOM_UTF8)
+            out.append(len(b))
+        else:
+            out.append(ATOM_UTF8)
+            out += struct.pack(">H", len(b))
+        out += b
+    elif isinstance(t, bool):
+        _enc(Atom("true") if t else Atom("false"), out)
+    elif isinstance(t, int):
+        if 0 <= t < 256:
+            out.append(SMALL_INT)
+            out.append(t)
+        elif -(1 << 31) <= t < (1 << 31):
+            out.append(INT)
+            out += struct.pack(">i", t)
+        else:
+            sign = 1 if t < 0 else 0
+            mag = abs(t)
+            digits = bytearray()
+            while mag:
+                digits.append(mag & 0xFF)
+                mag >>= 8
+            if len(digits) < 256:
+                out.append(SMALL_BIG)
+                out.append(len(digits))
+            else:
+                out.append(LARGE_BIG)
+                out += struct.pack(">I", len(digits))
+            out.append(sign)
+            out += digits
+    elif isinstance(t, float):
+        out.append(NEW_FLOAT)
+        out += struct.pack(">d", t)
+    elif isinstance(t, (bytes, bytearray)):
+        out.append(BINARY)
+        out += struct.pack(">I", len(t))
+        out += t
+    elif isinstance(t, str):
+        _enc(t.encode("utf-8"), out)
+    elif isinstance(t, tuple):
+        if len(t) < 256:
+            out.append(SMALL_TUPLE)
+            out.append(len(t))
+        else:
+            out.append(LARGE_TUPLE)
+            out += struct.pack(">I", len(t))
+        for x in t:
+            _enc(x, out)
+    elif isinstance(t, list):
+        if not t:
+            out.append(NIL)
+        else:
+            out.append(LIST)
+            out += struct.pack(">I", len(t))
+            for x in t:
+                _enc(x, out)
+            out.append(NIL)
+    elif isinstance(t, dict):
+        out.append(MAP)
+        out += struct.pack(">I", len(t))
+        for k, v in t.items():
+            _enc(k, out)
+            _enc(v, out)
+    elif t is None:
+        _enc(Atom("undefined"), out)
+    else:
+        raise TypeError(f"cannot ETF-encode {type(t)}: {t!r}")
+
+
+def decode(data: bytes) -> Any:
+    """binary_to_term/1 (trailing bytes are an error)."""
+    if not data or data[0] != VERSION:
+        raise ValueError("bad ETF version byte")
+    term, pos = _dec(data, 1)
+    if pos != len(data):
+        raise ValueError(f"trailing bytes after term at {pos}")
+    return term
+
+
+def decode_prefix(data: bytes) -> Tuple[Any, int]:
+    """Decode one term, returning (term, bytes_consumed)."""
+    if not data or data[0] != VERSION:
+        raise ValueError("bad ETF version byte")
+    term, pos = _dec(data, 1)
+    return term, pos
+
+
+def _dec(b: bytes, p: int) -> Tuple[Any, int]:
+    tag = b[p]
+    p += 1
+    if tag == SMALL_INT:
+        return b[p], p + 1
+    if tag == INT:
+        return struct.unpack_from(">i", b, p)[0], p + 4
+    if tag == NEW_FLOAT:
+        return struct.unpack_from(">d", b, p)[0], p + 8
+    if tag in (SMALL_ATOM_UTF8, ATOM, ATOM_UTF8):
+        if tag == SMALL_ATOM_UTF8:
+            n, p = b[p], p + 1
+        else:
+            n, p = struct.unpack_from(">H", b, p)[0], p + 2
+        name = b[p:p + n].decode("utf-8")
+        p += n
+        if name == "true":
+            return True, p
+        if name == "false":
+            return False, p
+        return Atom(name), p
+    if tag in (SMALL_TUPLE, LARGE_TUPLE):
+        if tag == SMALL_TUPLE:
+            n, p = b[p], p + 1
+        else:
+            n, p = struct.unpack_from(">I", b, p)[0], p + 4
+        items = []
+        for _ in range(n):
+            x, p = _dec(b, p)
+            items.append(x)
+        return tuple(items), p
+    if tag == NIL:
+        return [], p
+    if tag == STRING:  # list of small ints packed as chars
+        n = struct.unpack_from(">H", b, p)[0]
+        p += 2
+        return list(b[p:p + n]), p + n
+    if tag == LIST:
+        n = struct.unpack_from(">I", b, p)[0]
+        p += 4
+        items = []
+        for _ in range(n):
+            x, p = _dec(b, p)
+            items.append(x)
+        tail, p = _dec(b, p)
+        if tail != []:
+            items.append(tail)  # improper list: keep the tail as last elem
+        return items, p
+    if tag == BINARY:
+        n = struct.unpack_from(">I", b, p)[0]
+        p += 4
+        return bytes(b[p:p + n]), p + n
+    if tag in (SMALL_BIG, LARGE_BIG):
+        if tag == SMALL_BIG:
+            n, p = b[p], p + 1
+        else:
+            n, p = struct.unpack_from(">I", b, p)[0], p + 4
+        sign = b[p]
+        p += 1
+        mag = int.from_bytes(b[p:p + n], "little")
+        return (-mag if sign else mag), p + n
+    if tag == MAP:
+        n = struct.unpack_from(">I", b, p)[0]
+        p += 4
+        d = {}
+        for _ in range(n):
+            k, p = _dec(b, p)
+            v, p = _dec(b, p)
+            d[k] = v
+        return d, p
+    raise ValueError(f"unsupported ETF tag {tag} at {p - 1}")
+
+
+# ---------------------------------------------------------------- framing
+
+def frame(payload: bytes) -> bytes:
+    """{packet, 4} framing (partisan_socket.erl:17)."""
+    return struct.pack(">I", len(payload)) + payload
+
+
+def read_frame(stream) -> bytes:
+    """Blocking read of one 4-byte-length frame; b'' on clean EOF."""
+    hdr = stream.read(4)
+    if not hdr:
+        return b""
+    if len(hdr) < 4:
+        raise EOFError("truncated frame header")
+    (n,) = struct.unpack(">I", hdr)
+    payload = b""
+    while len(payload) < n:
+        chunk = stream.read(n - len(payload))
+        if not chunk:
+            raise EOFError("truncated frame body")
+        payload += chunk
+    return payload

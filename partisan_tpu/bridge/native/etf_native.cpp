@@ -1,0 +1,102 @@
+// Native bulk ETF codec for the Erlang port bridge.
+//
+// The port's hot path is bulk numeric traffic: member-id lists, batched
+// message tuples (src, dst, typ, payload) crossing per round quantum
+// (SURVEY §7.3 "the port must batch").  Encoding a million-element Erlang
+// list through per-object Python is ~100x slower than this flat C++ walk,
+// so the structural terms stay in bridge/etf.py while int-list payloads
+// route here (native_loader.py picks this up via ctypes when built).
+//
+// Wire format shared with the Python codec (External Term Format):
+//   VERSION(131) LIST(108) count(u32) {SMALL_INT(97) u8 | INT(98) i32}* NIL(106)
+//   empty list = VERSION NIL.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+namespace {
+constexpr uint8_t VERSION = 131;
+constexpr uint8_t SMALL_INT = 97;
+constexpr uint8_t INT = 98;
+constexpr uint8_t NIL = 106;
+constexpr uint8_t LIST = 108;
+
+inline void put_u32(uint8_t *p, uint32_t v) {
+  p[0] = static_cast<uint8_t>(v >> 24);
+  p[1] = static_cast<uint8_t>(v >> 16);
+  p[2] = static_cast<uint8_t>(v >> 8);
+  p[3] = static_cast<uint8_t>(v);
+}
+
+inline uint32_t get_u32(const uint8_t *p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+}  // namespace
+
+extern "C" {
+
+// Worst-case encoded size for n int32s (INT form each) + header/footer.
+size_t etf_intlist_max_size(size_t n) { return 2 + 4 + 5 * n + 1; }
+
+// Encode n int32s as an ETF list into out (caller sizes it with
+// etf_intlist_max_size).  Returns bytes written.
+size_t etf_encode_intlist(const int32_t *vals, size_t n, uint8_t *out) {
+  size_t w = 0;
+  out[w++] = VERSION;
+  if (n == 0) {
+    out[w++] = NIL;
+    return w;
+  }
+  out[w++] = LIST;
+  put_u32(out + w, static_cast<uint32_t>(n));
+  w += 4;
+  for (size_t i = 0; i < n; ++i) {
+    int32_t v = vals[i];
+    if (v >= 0 && v < 256) {
+      out[w++] = SMALL_INT;
+      out[w++] = static_cast<uint8_t>(v);
+    } else {
+      out[w++] = INT;
+      put_u32(out + w, static_cast<uint32_t>(v));
+      w += 4;
+    }
+  }
+  out[w++] = NIL;
+  return w;
+}
+
+// Decode an ETF int list of up to cap entries into vals.  Returns the
+// element count, or -1 on malformed input / non-int elements / overflow.
+long etf_decode_intlist(const uint8_t *in, size_t len, int32_t *vals,
+                        size_t cap) {
+  size_t r = 0;
+  if (len < 2 || in[r++] != VERSION) return -1;
+  uint8_t tag = in[r++];
+  if (tag == NIL) return 0;
+  if (tag != LIST) return -1;
+  if (r + 4 > len) return -1;
+  uint32_t n = get_u32(in + r);
+  r += 4;
+  if (n > cap) return -1;
+  for (uint32_t i = 0; i < n; ++i) {
+    if (r >= len) return -1;
+    uint8_t t = in[r++];
+    if (t == SMALL_INT) {
+      if (r + 1 > len) return -1;
+      vals[i] = in[r++];
+    } else if (t == INT) {
+      if (r + 4 > len) return -1;
+      vals[i] = static_cast<int32_t>(get_u32(in + r));
+      r += 4;
+    } else {
+      return -1;
+    }
+  }
+  if (r >= len || in[r] != NIL) return -1;
+  return static_cast<long>(n);
+}
+
+}  // extern "C"

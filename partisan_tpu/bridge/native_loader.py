@@ -1,0 +1,92 @@
+"""Build + bind the native bulk ETF codec (native/etf_native.cpp).
+
+Compiled on first use with g++ into a per-source-hash cached shared
+library (no pybind11 in the image — plain C ABI via ctypes, per the
+environment's binding guidance).  Every entry point degrades to the
+pure-Python codec in bridge/etf.py when no compiler is available, so the
+bridge works everywhere and is merely faster where g++ exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+from . import etf
+
+_SRC = os.path.join(os.path.dirname(__file__), "native", "etf_native.cpp")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"partisan_tpu_etf_{digest}.so")
+    if not os.path.exists(cache):
+        tmp = cache + f".{os.getpid()}.tmp"
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        os.replace(tmp, cache)
+    lib = ctypes.CDLL(cache)
+    lib.etf_intlist_max_size.restype = ctypes.c_size_t
+    lib.etf_intlist_max_size.argtypes = [ctypes.c_size_t]
+    lib.etf_encode_intlist.restype = ctypes.c_size_t
+    lib.etf_encode_intlist.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.etf_decode_intlist.restype = ctypes.c_long
+    lib.etf_decode_intlist.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_size_t]
+    return lib
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _tried:
+        _tried = True
+        _lib = _build()
+    return _lib
+
+
+def encode_intlist(vals) -> bytes:
+    """ETF-encode a flat int32 array (bulk path; Python fallback)."""
+    arr = np.ascontiguousarray(np.asarray(vals, dtype=np.int32))
+    lib = native_lib()
+    if lib is None:
+        return etf.encode([int(x) for x in arr])
+    out = np.empty(lib.etf_intlist_max_size(arr.size), dtype=np.uint8)
+    n = lib.etf_encode_intlist(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), arr.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out[:n].tobytes()
+
+
+def decode_intlist(data: bytes, cap: Optional[int] = None) -> np.ndarray:
+    """Decode an ETF int list into an int32 array (bulk path)."""
+    lib = native_lib()
+    if lib is None:
+        vals: List[int] = etf.decode(data)
+        return np.asarray(vals, dtype=np.int32)
+    cap = cap if cap is not None else max(len(data), 1)
+    out = np.empty(cap, dtype=np.int32)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    n = lib.etf_decode_intlist(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), buf.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+    if n < 0:
+        # not a flat int list (or > cap): fall back to the full codec
+        vals = etf.decode(data)
+        return np.asarray(vals, dtype=np.int32)
+    return out[:n].copy()

@@ -1,0 +1,219 @@
+"""Port command loop — the process an Erlang node opens with
+``open_port({spawn, "python -m partisan_tpu.bridge.port_server"},
+[{packet, 4}, binary])`` to use the TPU simulator as its peer-service
+backend (the control channel of SURVEY §7.1 plane 2).
+
+One session = one World.  Commands are ETF tuples with atom heads (the
+shapes of the `partisan_peer_service_manager` behaviour,
+partisan_peer_service_manager.erl:30-67); every reply is ``ok``,
+``{ok, Term}`` or ``{error, Reason}``:
+
+  {start, Manager, Props}     Manager: hyparview | full | scamp_v1 |
+                              scamp_v2 | static | client_server;
+                              Props: [{n_nodes, N} | {seed, S} | ...]
+  {join, Node, Peer}          peer_service:join (queued; applies on advance)
+  {leave, Node}               peer_service:leave
+  {advance, K}                run K rounds, reply {ok, MetricsMap}
+  {members, Node}             {ok, [Id]}  (bulk int list — native codec path)
+  {crash, [Node]} / {recover, [Node]}
+  {partition, [[Node]]} / resolve_partition
+  {checkpoint, Path} / {restore, Path}
+  health                      {ok, Map} of metrics.world_health
+  stop                        close the session and exit
+
+Join/leave/crash commands batch between ``advance`` calls — the port never
+round-trips per message (SURVEY §7.3 "Host<->device bridge latency").
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+from typing import Any, BinaryIO, Dict, Optional
+
+import numpy as np
+
+from .. import checkpoint as ckpt
+from .. import metrics as metrics_mod
+from ..config import Config, from_mapping
+from ..engine import init_world, make_step
+from ..peer_service import join as ps_join, leave as ps_leave
+from ..verify import faults
+from . import etf
+from .etf import Atom
+
+_MANAGERS = {
+    "hyparview": lambda cfg: _mk("hyparview", cfg),
+    "full": lambda cfg: _mk("full", cfg),
+    "scamp_v1": lambda cfg: _mk("scamp_v1", cfg),
+    "scamp_v2": lambda cfg: _mk("scamp_v2", cfg),
+    "static": lambda cfg: _mk("static", cfg),
+    "client_server": lambda cfg: _mk("client_server", cfg),
+}
+
+
+def _mk(name: str, cfg: Config):
+    # local imports keep server start cheap before `start` arrives
+    if name == "hyparview":
+        from ..models.hyparview import HyParView
+        return HyParView(cfg)
+    if name == "full":
+        from ..models.full_membership import FullMembership
+        return FullMembership(cfg)
+    if name == "scamp_v1":
+        from ..models.scamp import ScampV1
+        return ScampV1(cfg)
+    if name == "scamp_v2":
+        from ..models.scamp import ScampV2
+        return ScampV2(cfg)
+    if name == "static":
+        from ..models.managers import StaticManager
+        return StaticManager(cfg)
+    if name == "client_server":
+        from ..models.managers import ClientServerManager
+        return ClientServerManager(cfg)
+    raise ValueError(f"unknown manager {name}")
+
+
+class Session:
+    def __init__(self) -> None:
+        self.cfg: Optional[Config] = None
+        self.proto = None
+        self.world = None
+        self.step = None
+
+    # ------------------------------------------------------------- commands
+
+    def cmd_start(self, manager: Atom, props) -> Any:
+        overrides: Dict[str, Any] = {}
+        for item in props:
+            k, v = item
+            if isinstance(v, list):
+                v = tuple(v)
+            overrides[str(k)] = v
+        self.cfg = from_mapping(overrides)
+        if str(manager) not in _MANAGERS:
+            return (Atom("error"), Atom("unknown_manager"))
+        self.proto = _MANAGERS[str(manager)](self.cfg)
+        self.world = init_world(self.cfg, self.proto)
+        self.step = make_step(self.cfg, self.proto, donate=False)
+        return Atom("ok")
+
+    def _started(self) -> bool:
+        return self.world is not None
+
+    def cmd_join(self, node: int, peer: int) -> Any:
+        self.world = ps_join(self.world, self.proto, int(node), int(peer))
+        return Atom("ok")
+
+    def cmd_leave(self, node: int) -> Any:
+        self.world = ps_leave(self.world, self.proto, int(node))
+        return Atom("ok")
+
+    def cmd_advance(self, k: int) -> Any:
+        last = {}
+        for _ in range(int(k)):
+            self.world, last = self.step(self.world)
+        out = {Atom(name): _to_term(v) for name, v in last.items()}
+        return (Atom("ok"), out)
+
+    def cmd_members(self, node: int) -> Any:
+        row = _tree_index(self.world.state, int(node))
+        mask = np.asarray(self.proto.member_mask(row))
+        ids = np.flatnonzero(mask).astype(np.int32)
+        return (Atom("ok"), [int(x) for x in ids])
+
+    def cmd_crash(self, nodes) -> Any:
+        self.world = faults.crash(self.world, [int(n) for n in nodes])
+        return Atom("ok")
+
+    def cmd_recover(self, nodes) -> Any:
+        self.world = faults.recover(self.world, [int(n) for n in nodes])
+        return Atom("ok")
+
+    def cmd_partition(self, groups) -> Any:
+        self.world = faults.inject_partition(
+            self.world, [[int(n) for n in g] for g in groups])
+        return Atom("ok")
+
+    def cmd_resolve_partition(self) -> Any:
+        self.world = faults.resolve_partition(self.world)
+        return Atom("ok")
+
+    def cmd_checkpoint(self, path) -> Any:
+        ckpt.save(_as_str(path), self.cfg, self.world)
+        return Atom("ok")
+
+    def cmd_restore(self, path) -> Any:
+        self.world, _ = ckpt.load(_as_str(path), self.world)
+        return Atom("ok")
+
+    def cmd_health(self) -> Any:
+        h = metrics_mod.world_health(self.world, self.proto)
+        return (Atom("ok"), {Atom(k): _to_term(v) for k, v in h.items()})
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, term: Any) -> Any:
+        if term == Atom("stop"):
+            return None
+        if term == Atom("health"):
+            return self._guard(self.cmd_health)
+        if not (isinstance(term, tuple) and term and
+                isinstance(term[0], Atom)):
+            return (Atom("error"), Atom("badarg"))
+        head, *args = term
+        name = f"cmd_{head}"
+        if head != Atom("start") and not self._started():
+            return (Atom("error"), Atom("not_started"))
+        fn = getattr(self, name, None)
+        if fn is None:
+            return (Atom("error"), Atom("unknown_command"))
+        return self._guard(fn, *args)
+
+    def _guard(self, fn, *args) -> Any:
+        try:
+            return fn(*args)
+        except Exception as e:  # noqa: BLE001 — port must not die on badarg
+            traceback.print_exc(file=sys.stderr)
+            return (Atom("error"), str(e).encode()[:200])
+
+
+def _tree_index(tree, i: int):
+    import jax
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+def _as_str(x) -> str:
+    return x.decode() if isinstance(x, (bytes, bytearray)) else str(x)
+
+
+def _to_term(v) -> Any:
+    arr = np.asarray(v)
+    if arr.ndim == 0:
+        return float(arr) if arr.dtype.kind == "f" else int(arr)
+    return [_to_term(x) for x in arr]
+
+
+def serve(stdin: BinaryIO, stdout: BinaryIO) -> None:
+    session = Session()
+    while True:
+        payload = etf.read_frame(stdin)
+        if not payload:
+            return
+        term = etf.decode(payload)
+        reply = session.handle(term)
+        if reply is None:  # stop
+            stdout.write(etf.frame(etf.encode(Atom("ok"))))
+            stdout.flush()
+            return
+        stdout.write(etf.frame(etf.encode(reply)))
+        stdout.flush()
+
+
+def main() -> None:
+    serve(sys.stdin.buffer, sys.stdout.buffer)
+
+
+if __name__ == "__main__":
+    main()

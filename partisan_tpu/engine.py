@@ -47,6 +47,9 @@ class World:
     rnd: jax.Array             # scalar int32 round counter
     alive: jax.Array           # [N] bool crash mask (faults, SURVEY §5.3)
     partition: jax.Array       # [N] int32 partition ids (0 = no partition)
+    aux: Any = None            # harness-owned pytree (e.g. the model
+                               # checker's omission schedule) readable by
+                               # 3-arg interposition funs without recompiling
 
 
 def default_out_cap(cfg: Config, proto: "ProtocolBase") -> int:
@@ -146,6 +149,7 @@ def make_step(
     interpose_recv: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
     randomize_delivery: bool = True,
     donate: bool = True,
+    capture_wire: bool = False,
 ) -> Callable[[World], Tuple[World, Dict[str, jax.Array]]]:
     """Compile one simulation round for `proto`.
 
@@ -153,6 +157,11 @@ def make_step(
     funs (partisan_pluggable_peer_service_manager.erl:51-58, 640-667): pure
     functions over the flat message buffer that may invalidate (drop), rewrite
     fields, or bump `delay` ('$delay'), keyed off the round number.
+
+    ``capture_wire=True`` adds the post-interposition pre-route buffer to
+    the metrics dict (keys ``wire_valid/src/dst/typ/channel/hash``) — the
+    per-round trace dump consumed by verify/trace.py (the
+    pre_interposition-fun recording of partisan_trace_orchestrator.erl).
     """
     N = cfg.n_nodes
     K = cfg.inbox_cap
@@ -161,6 +170,22 @@ def make_step(
     n_types = len(proto.msg_types)
     handlers = proto.handlers()
     out_cap = out_cap or default_out_cap(cfg, proto)
+    # channel/parallelism plumbing (SURVEY §2.11): partition-keyed lane
+    # dispatch and the monotonic keep-latest reduction
+    pk_field = "partition_key" if "partition_key" in proto.data_spec else None
+
+    def _interp(fn, m, rnd, world):
+        """Interposition funs take (msgs, rnd) or (msgs, rnd, world) — the
+        3-arg form reads runtime data (world.aux) so fault schedules swap
+        without recompiling."""
+        import inspect
+        if len(inspect.signature(fn).parameters) >= 3:
+            return fn(m, rnd, world)
+        return fn(m, rnd)
+    mono_mask = None
+    if cfg.monotonic_channels:
+        mono_mask = jnp.asarray(
+            [c in cfg.monotonic_channels for c in cfg.channels], dtype=bool)
 
     def noop_handler(node_id, row, m, key):
         return row, proto.no_emit()
@@ -207,12 +232,27 @@ def make_step(
                      == world.partition[jnp.clip(now.dst, 0, N - 1)])
         now = now.replace(valid=now.valid & same_part)
         if interpose_recv is not None:
-            now = interpose_recv(now, rnd)
+            now = _interp(interpose_recv, now, rnd, world)
+
+        # -- connection lanes: partition-key hash or random spread over the
+        #    k parallel connections (dispatch_pid, partisan_util.erl:142-201)
+        if cfg.parallelism > 1:
+            now = msgops.dispatch(
+                now, cfg.parallelism,
+                now.data[pk_field] if pk_field else None,
+                salt=jnp.uint32(rnd))
+        # -- monotonic channels: keep-latest per connection
+        #    (partisan_peer_connection.erl:82-100)
+        if mono_mask is not None:
+            now = msgops.monotonic_elide(now, N, mono_mask,
+                                         cfg.n_channels, cfg.parallelism)
 
         # -- route
         route_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed ^ 0x5EED), rnd) \
             if randomize_delivery else None
-        inbox, _, overflow = msgops.build_inbox(now, N, K, key=route_key)
+        inbox, _, overflow = msgops.build_inbox(
+            now, N, K, key=route_key,
+            n_channels=cfg.n_channels, parallelism=cfg.parallelism)
 
         # -- deliver (per-node sequential, batched over N)
         dkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 1)
@@ -228,13 +268,14 @@ def make_step(
             out = jax.tree_util.tree_map(
                 lambda x: x.reshape((N * per,) + x.shape[2:]), em)
             src = jnp.repeat(node_ids, per)
-            return out.replace(src=src)
+            return out.replace(src=src,
+                               born=jnp.full((N * per,), rnd, jnp.int32))
 
         new = msgops.concat(flat(demits, K * E), flat(temits, T))
         alive_src = world.alive[jnp.clip(new.src, 0, N - 1)]
         new = new.replace(valid=new.valid & alive_src)
         if interpose_send is not None:
-            new = interpose_send(new, rnd)  # once, at send time only
+            new = _interp(interpose_send, new, rnd, world)  # once, at send
         out = msgops.concat(new, held)
         out, dropped = msgops.compact(out, out_cap)
 
@@ -245,6 +286,11 @@ def make_step(
             "inbox_overflow": overflow,
             "out_dropped": dropped,
         }
+        if capture_wire:
+            metrics.update(
+                wire_valid=now.valid, wire_src=now.src, wire_dst=now.dst,
+                wire_typ=now.typ, wire_channel=now.channel,
+                wire_hash=msgops.wire_hash(now))
         new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
         return new_world, metrics
 

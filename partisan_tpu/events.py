@@ -1,0 +1,59 @@
+"""Membership events + console (SURVEY §2.8) — rebuilds of
+``partisan_peer_service_events.erl`` (gen_event with function-callback
+handlers, :59-81) and ``partisan_peer_service_console.erl``.
+
+The reference sync-notifies registered callbacks on every membership
+update.  Here membership lives on device; the event surface is a host-side
+differ: feed it each round's world and it invokes callbacks only for nodes
+whose member set changed (the ``partisan_peer_service:add_sup_callback``
+contract)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .engine import ProtocolBase, World
+
+Callback = Callable[[int, np.ndarray], None]  # (node, member_mask)
+
+
+class PeerServiceEvents:
+    def __init__(self, proto: ProtocolBase):
+        self.proto = proto
+        self._callbacks: List[Callback] = []
+        self._last: Optional[np.ndarray] = None
+
+    def add_sup_callback(self, fn: Callback) -> None:
+        """partisan_peer_service:add_sup_callback/1."""
+        self._callbacks.append(fn)
+
+    def update(self, world: World) -> int:
+        """Diff membership against the previous call; fire callbacks for
+        changed nodes.  Returns the number of changed nodes."""
+        masks = np.asarray(jax.vmap(self.proto.member_mask)(world.state))
+        changed = 0
+        if self._last is not None:
+            diff = (masks != self._last).any(axis=1)
+            for node in np.flatnonzero(diff):
+                changed += 1
+                for fn in self._callbacks:
+                    fn(int(node), masks[node])
+        self._last = masks
+        return changed
+
+
+def members(world: World, proto: ProtocolBase, node: int) -> List[int]:
+    """Console members/1: the node's member list as ids."""
+    row = jax.tree_util.tree_map(lambda x: x[node], world.state)
+    mask = np.asarray(proto.member_mask(row))
+    return np.flatnonzero(mask).tolist()
+
+
+def format_members(world: World, proto: ProtocolBase,
+                   node: int) -> str:
+    """partisan_peer_service_console:members/1 pretty-printer."""
+    ms = members(world, proto, node)
+    return f"node {node}: {len(ms)} members: {ms}"

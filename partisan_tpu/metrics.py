@@ -1,0 +1,74 @@
+"""Cluster observability (SURVEY §5.5) — per-round scalar metrics reduced
+ON DEVICE, the rebuild of the reference's scattered instrumentation
+(distance ping/pong RTTs pluggable :852-873, queue-depth logging :875-879,
+transmission logging plumtree :666-685).
+
+RTT is degenerate in a round-synchronous simulator (always one round), so
+the useful health signals are topology ones: view-size histograms, isolated
+node counts, convergence.  Everything here is jittable and cheap enough to
+run every round inside a scan; stream the dict to host at whatever cadence
+observability needs."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .engine import ProtocolBase, World
+from .ops import graph
+
+
+def view_stats(views: jax.Array, alive: jax.Array,
+               prefix: str = "") -> Dict[str, jax.Array]:
+    """[N, C] padded views -> size histogram + isolation count (the
+    active-view histogram / isolated-node metrics of SURVEY §5.5)."""
+    sizes = jnp.sum(views >= 0, axis=1)
+    sizes = jnp.where(alive, sizes, -1)
+    C = views.shape[1]
+    hist = jnp.zeros((C + 1,), jnp.int32).at[
+        jnp.clip(sizes, 0, C)].add(jnp.where(alive, 1, 0))
+    return {
+        prefix + "isolated": jnp.sum(alive & (sizes == 0)).astype(jnp.int32),
+        prefix + "mean_view": jnp.sum(jnp.maximum(sizes, 0))
+        / jnp.maximum(jnp.sum(alive), 1),
+        prefix + "view_hist": hist,
+    }
+
+
+def connectivity(views: jax.Array, alive: jax.Array) -> Dict[str, jax.Array]:
+    """All-pairs reachability + symmetry, on device (the digraph check,
+    test/partisan_SUITE.erl:2044-2109).  O(N^2 log N) — meant for health
+    probes at test scale, not the 10^6-node fast path."""
+    n = views.shape[0]
+    adj = graph.adjacency_from_views(views, n)
+    return {
+        "connected": graph.is_connected(adj, alive),
+        "symmetric": graph.is_symmetric(adj, alive),
+    }
+
+
+def convergence(member_masks: jax.Array, alive: jax.Array) -> jax.Array:
+    """Fraction of alive nodes sharing the modal membership view —
+    rounds-to-convergence is THE full-membership metric (SURVEY §7.2 M1).
+    member_masks: [N, N] bool (row i = node i's view)."""
+    ref = member_masks[jnp.argmax(alive)]
+    agree = jnp.all(member_masks == ref[None, :], axis=1) & alive
+    return jnp.sum(agree) / jnp.maximum(jnp.sum(alive), 1)
+
+
+def world_health(world: World, proto: ProtocolBase) -> Dict[str, jax.Array]:
+    """One-call health snapshot for protocols exposing member_mask."""
+    masks = jax.vmap(proto.member_mask)(world.state)
+    out = {
+        "alive": jnp.sum(world.alive).astype(jnp.int32),
+        "inflight": world.msgs.count(),
+        "convergence": convergence(masks, world.alive),
+    }
+    views = getattr(world.state, "active", None)
+    if views is None:
+        views = getattr(world.state, "partial", None)
+    if views is not None:
+        out.update(view_stats(views, world.alive))
+    return out

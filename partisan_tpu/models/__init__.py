@@ -1,5 +1,9 @@
-from .demers import AntiEntropy, DirectMail, DirectMailAcked, rumor_init, rumor_run
+from .commit import AlsbergDay, BernsteinCTP, Skeen3PC, TwoPhaseCommit
+from .demers import (AntiEntropy, DirectMail, DirectMailAcked, rumor_init,
+                     rumor_run)
 from .full_membership import FullMembership
 from .hyparview import HyParView
+from .managers import ClientServerManager, StaticManager
 from .plumtree import Plumtree
+from .scamp import ScampV1, ScampV2
 from .stack import Stacked, StackState, UpperProtocol

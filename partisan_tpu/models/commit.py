@@ -1,0 +1,402 @@
+"""Atomic-commitment and primary-backup workloads — TPU-native rebuilds of
+the reference's model-checked example protocols (SURVEY §2.10):
+
+  * :class:`TwoPhaseCommit`  — ``protocols/lampson_2pc.erl``
+  * :class:`BernsteinCTP`    — ``protocols/bernstein_ctp.erl`` (2PC + the
+    cooperative-termination decision_request/decision sub-protocol)
+  * :class:`Skeen3PC`        — ``protocols/skeen_3pc.erl`` (3-phase commit
+    with the precommit round and non-blocking participant timeout)
+  * :class:`AlsbergDay`      — ``protocols/alsberg_day.erl`` (primary-backup
+    replication; the acked/membership variants are flags)
+
+Shape notes: the reference keeps ETS tables of concurrent transactions;
+these rebuilds track ONE transaction per coordinator (the reference's own
+model-checking harness drives exactly one broadcast per execution,
+test/filibuster_SUITE.erl) with participant sets as dense ``[N]`` bool
+rows.  Like the reference, commit/abort fan-outs are NOT retransmitted —
+dropping one is precisely the divergence the model checker must find
+(Makefile:105-113 expects failing schedules for every one of these).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops.msg import Msgs
+
+# participant_status / coordinator_status values
+IDLE, PREPARING, PRECOMMITTING, COMMITTING, ABORTING, DONE = 0, 1, 2, 3, 4, 5
+P_NONE, P_PREPARED, P_PRECOMMIT, P_COMMITTED, P_ABORTED = 0, 1, 2, 3, 4
+
+
+@struct.dataclass
+class TxnState:
+    # coordinator half (valid on the node that got ctl_broadcast)
+    c_status: jax.Array      # [N] int32 coordinator_status
+    c_value: jax.Array       # [N] int32 transaction payload
+    c_prepared: jax.Array    # [N, N] bool — prepared votes collected
+    c_precommit: jax.Array   # [N, N] bool — precommit acks (3PC)
+    c_acked: jax.Array       # [N, N] bool — commit/abort acks
+    c_timeout: jax.Array     # [N] int32 coordinator_timeout countdown
+    # participant half
+    p_status: jax.Array      # [N] int32 participant_status
+    p_value: jax.Array       # [N] int32 stored transaction value
+    p_coord: jax.Array       # [N] int32 the coordinator node
+    p_timeout: jax.Array     # [N] int32 participant_timeout countdown (ctp/3pc)
+    delivered: jax.Array     # [N] int32 — value forwarded to the app on
+                             # commit (process_forward, lampson_2pc :378-390);
+                             # -1 = nothing delivered. THE agreement surface.
+
+
+class TwoPhaseCommit(ProtocolBase):
+    """lampson_2pc.erl: prepare -> prepared -> commit -> commit_ack with a
+    coordinator timeout that aborts while still PREPARING (:189-220).
+    Participants ack aborts; a commit already applied stays applied — the
+    window the model checker exploits."""
+
+    msg_types = ("prepare", "prepared", "commit", "commit_ack",
+                 "abort", "abort_ack", "ctl_broadcast")
+    has_precommit = False
+    participant_timeout: int | None = None  # ctp/3pc override
+
+    def __init__(self, cfg: Config, coordinator_timeout: int = 8):
+        self.cfg = cfg
+        self.T = coordinator_timeout
+        self.data_spec: Dict = {
+            "value": ((), jnp.int32),
+            "coord": ((), jnp.int32),
+            "decision": ((), jnp.int32),
+        }
+        self.emit_cap = cfg.n_nodes  # fan-outs go to every participant
+        self.tick_emit_cap = cfg.n_nodes
+
+    # ------------------------------------------------------------------ state
+
+    def init(self, cfg: Config, key: jax.Array) -> TxnState:
+        n = cfg.n_nodes
+        z = jnp.zeros((n,), jnp.int32)
+        zb = jnp.zeros((n, n), bool)
+        return TxnState(
+            c_status=z, c_value=z, c_prepared=zb, c_precommit=zb,
+            c_acked=zb, c_timeout=z,
+            p_status=z, p_value=z, p_coord=jnp.full((n,), -1, jnp.int32),
+            p_timeout=z, delivered=jnp.full((n,), -1, jnp.int32),
+        )
+
+    def _everyone(self, me) -> jax.Array:
+        """All participants incl. self (membership(), lampson_2pc :150-156)."""
+        return jnp.arange(self.cfg.n_nodes, dtype=jnp.int32)
+
+    def _fan(self, me, typ, cond, **data) -> Msgs:
+        to = jnp.where(cond, self._everyone(me), -1)
+        return self.emit(to, typ, **data)
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_ctl_broadcast(self, cfg, me, row: TxnState, m: Msgs, key):
+        """broadcast/2 (:123-156): become coordinator, prepare everywhere."""
+        fresh = row.c_status == IDLE
+        row = row.replace(
+            c_status=jnp.where(fresh, PREPARING, row.c_status),
+            c_value=jnp.where(fresh, m.data["value"], row.c_value),
+            c_timeout=jnp.where(fresh, self.T, row.c_timeout),
+        )
+        return row, self._fan(me, self.typ("prepare"), fresh,
+                              value=m.data["value"], coord=me)
+
+    def handle_prepare(self, cfg, me, row: TxnState, m: Msgs, key):
+        """:433-441 participant side: log + vote prepared."""
+        ok = row.p_status == P_NONE
+        row = row.replace(
+            p_status=jnp.where(ok, P_PREPARED, row.p_status),
+            p_value=jnp.where(ok, m.data["value"], row.p_value),
+            p_coord=jnp.where(ok, m.data["coord"], row.p_coord),
+            p_timeout=jnp.where(ok, self._p_timeout_init(), row.p_timeout),
+        )
+        return row, self.emit(jnp.where(ok, m.data["coord"], -1)[None],
+                              self.typ("prepared"))
+
+    def _p_timeout_init(self):
+        return jnp.int32(self.participant_timeout or 0)
+
+    def handle_prepared(self, cfg, me, row: TxnState, m: Msgs, key):
+        """:391-424 coordinator: collect votes; all in -> decide commit."""
+        voting = row.c_status == PREPARING
+        prepared = row.c_prepared.at[m.src].set(
+            row.c_prepared[m.src] | voting)
+        all_in = jnp.all(prepared)
+        row = row.replace(
+            c_prepared=prepared,
+            c_status=jnp.where(voting & all_in, self._decided_status(),
+                               row.c_status))
+        em = self._decide_fan(cfg, me, row, voting & all_in)
+        return row, em
+
+    def _decided_status(self):
+        return jnp.int32(PRECOMMITTING if self.has_precommit else COMMITTING)
+
+    def _decide_fan(self, cfg, me, row, go) -> Msgs:
+        typ = self.typ("precommit") if self.has_precommit \
+            else self.typ("commit")
+        return self._fan(me, typ, go, value=row.c_value, coord=me)
+
+    def handle_commit(self, cfg, me, row: TxnState, m: Msgs, key):
+        """:342-355 (:378-390 in 2pc): apply + deliver + ack.  Applies even
+        after a local abort — the reference just inserts the commit record —
+        which is exactly the observable divergence."""
+        row = row.replace(
+            p_status=jnp.int32(P_COMMITTED),
+            p_value=m.data["value"],
+            delivered=m.data["value"],
+            p_timeout=jnp.zeros_like(row.p_timeout),
+        )
+        return row, self.emit(m.data["coord"][None], self.typ("commit_ack"))
+
+    def handle_commit_ack(self, cfg, me, row: TxnState, m: Msgs, key):
+        acked = row.c_acked.at[m.src].set(True)
+        done = jnp.all(acked) & (row.c_status == COMMITTING)
+        row = row.replace(c_acked=acked,
+                          c_status=jnp.where(done, DONE, row.c_status))
+        return row, self.no_emit()
+
+    def handle_abort(self, cfg, me, row: TxnState, m: Msgs, key):
+        """:334-341: delete the participating record + ack.  A node that
+        already committed keeps its delivered value (the record delete does
+        not undo process_forward)."""
+        was_committed = row.p_status == P_COMMITTED
+        row = row.replace(
+            p_status=jnp.where(was_committed, row.p_status,
+                               jnp.int32(P_ABORTED)),
+            p_timeout=jnp.zeros_like(row.p_timeout),
+        )
+        return row, self.emit(m.data["coord"][None], self.typ("abort_ack"))
+
+    def handle_abort_ack(self, cfg, me, row: TxnState, m: Msgs, key):
+        acked = row.c_acked.at[m.src].set(True)
+        done = jnp.all(acked) & (row.c_status == ABORTING)
+        row = row.replace(c_acked=acked,
+                          c_status=jnp.where(done, DONE, row.c_status))
+        return row, self.no_emit()
+
+    # ------------------------------------------------------------------ timer
+
+    def tick(self, cfg, me, row: TxnState, rnd, key):
+        """coordinator_timeout (:189-220): still PREPARING when the clock
+        runs out -> abort everywhere."""
+        ticking = row.c_status == PREPARING
+        t = jnp.where(ticking, row.c_timeout - 1, row.c_timeout)
+        fire = ticking & (t <= 0)
+        row = row.replace(
+            c_timeout=t,
+            c_status=jnp.where(fire, ABORTING, row.c_status),
+            c_acked=jnp.where(fire, False, row.c_acked),
+        )
+        em = self._fan(me, self.typ("abort"), fire, coord=me)
+        row, em2 = self._participant_tick(cfg, me, row, rnd, key)
+        return row, self.merge(em, em2, cap=self.tick_emit_cap)
+
+    def _participant_tick(self, cfg, me, row, rnd, key):
+        return row, self.no_emit(self.tick_emit_cap)
+
+
+class BernsteinCTP(TwoPhaseCommit):
+    """bernstein_ctp.erl: 2PC + cooperative termination — a participant
+    stuck in PREPARED past its timeout asks every peer for the decision
+    (:222-278); any peer that knows (committed or aborted) replies
+    ``decision`` (:163-221) and the requester adopts it."""
+
+    msg_types = ("prepare", "prepared", "commit", "commit_ack",
+                 "abort", "abort_ack", "decision_request", "decision",
+                 "ctl_broadcast")
+    participant_timeout = 12
+
+    def handle_decision_request(self, cfg, me, row: TxnState, m: Msgs, key):
+        knows = (row.p_status == P_COMMITTED) | (row.p_status == P_ABORTED)
+        dec = jnp.where(row.p_status == P_COMMITTED, P_COMMITTED, P_ABORTED)
+        rep = self.emit(jnp.where(knows, m.src, -1)[None],
+                        self.typ("decision"), decision=dec,
+                        value=row.p_value)
+        return row, rep
+
+    def handle_decision(self, cfg, me, row: TxnState, m: Msgs, key):
+        undecided = row.p_status == P_PREPARED
+        adopt_commit = undecided & (m.data["decision"] == P_COMMITTED)
+        adopt_abort = undecided & (m.data["decision"] == P_ABORTED)
+        row = row.replace(
+            p_status=jnp.where(adopt_commit, P_COMMITTED,
+                               jnp.where(adopt_abort, P_ABORTED,
+                                         row.p_status)),
+            delivered=jnp.where(adopt_commit, m.data["value"],
+                                row.delivered))
+        return row, self.no_emit()
+
+    def _participant_tick(self, cfg, me, row: TxnState, rnd, key):
+        """participant_timeout (:254-278): PREPARED too long -> ask around."""
+        waiting = row.p_status == P_PREPARED
+        t = jnp.where(waiting, row.p_timeout - 1, row.p_timeout)
+        fire = waiting & (t <= 0) & (row.p_timeout > 0)
+        row = row.replace(p_timeout=jnp.where(
+            fire, self.participant_timeout, t))
+        em = self._fan(me, self.typ("decision_request"), fire)
+        return row, em
+
+
+class Skeen3PC(TwoPhaseCommit):
+    """skeen_3pc.erl: the extra PRECOMMIT round (:357-401) makes commitment
+    non-blocking: a participant that reached PRECOMMIT and times out
+    commits unilaterally; one stuck in PREPARED aborts (:165-195)."""
+
+    msg_types = ("prepare", "prepared", "precommit", "precommit_ack",
+                 "commit", "commit_ack", "abort", "abort_ack",
+                 "ctl_broadcast")
+    has_precommit = True
+    participant_timeout = 12
+
+    def handle_precommit(self, cfg, me, row: TxnState, m: Msgs, key):
+        ok = row.p_status == P_PREPARED
+        row = row.replace(
+            p_status=jnp.where(ok, P_PRECOMMIT, row.p_status),
+            p_timeout=jnp.where(ok, self.participant_timeout, row.p_timeout))
+        return row, self.emit(jnp.where(ok, m.data["coord"], -1)[None],
+                              self.typ("precommit_ack"))
+
+    def handle_precommit_ack(self, cfg, me, row: TxnState, m: Msgs, key):
+        """:357-391 coordinator: all precommit acks -> commit round."""
+        waiting = row.c_status == PRECOMMITTING
+        pc = row.c_precommit.at[m.src].set(row.c_precommit[m.src] | waiting)
+        all_in = jnp.all(pc)
+        go = waiting & all_in
+        row = row.replace(c_precommit=pc,
+                          c_status=jnp.where(go, COMMITTING, row.c_status))
+        return row, self._fan(me, self.typ("commit"), go,
+                              value=row.c_value, coord=me)
+
+    def _participant_tick(self, cfg, me, row: TxnState, rnd, key):
+        """participant_timeout (:165-195): PRECOMMIT -> commit unilaterally;
+        PREPARED -> abort unilaterally."""
+        waiting = (row.p_status == P_PREPARED) | (row.p_status == P_PRECOMMIT)
+        t = jnp.where(waiting, row.p_timeout - 1, row.p_timeout)
+        fire = waiting & (t <= 0) & (row.p_timeout > 0)
+        commit_self = fire & (row.p_status == P_PRECOMMIT)
+        abort_self = fire & (row.p_status == P_PREPARED)
+        row = row.replace(
+            p_timeout=t,
+            p_status=jnp.where(commit_self, P_COMMITTED,
+                               jnp.where(abort_self, P_ABORTED,
+                                         row.p_status)),
+            delivered=jnp.where(commit_self, row.p_value, row.delivered))
+        return row, self.no_emit(self.tick_emit_cap)
+
+
+# ======================================================================
+# Primary-backup replication (alsberg_day.erl + acked/membership variants)
+# ======================================================================
+
+@struct.dataclass
+class PbState:
+    store: jax.Array        # [N, K] replicated key-value store
+    out_valid: jax.Array    # [N, W] outstanding writes at the primary
+    out_key: jax.Array      # [N, W]
+    out_val: jax.Array      # [N, W]
+    out_client: jax.Array   # [N, W]
+    out_acks: jax.Array     # [N, W] collaborate_acks received
+    client_acked: jax.Array  # [N] int32 — writes confirmed back to client
+
+
+class AlsbergDay(ProtocolBase):
+    """alsberg_day.erl: writes route to the primary (membership[0]); the
+    primary applies + fans ``collaborate`` to the backups (:178-219);
+    backups apply + ``collaborate_ack`` (:248-…); the primary confirms to
+    the client once every backup acked (acked variant —
+    ``alsberg_day_acked.erl``; the base variant confirms immediately)."""
+
+    msg_types = ("write_req", "collaborate", "collaborate_ack",
+                 "client_reply", "ctl_write")
+    acked = True
+
+    def __init__(self, cfg: Config, n_keys: int = 4, out_cap: int = 4):
+        self.cfg = cfg
+        self.K = n_keys
+        self.W = out_cap
+        self.data_spec: Dict = {
+            "wkey": ((), jnp.int32),
+            "value": ((), jnp.int32),
+            "client": ((), jnp.int32),
+            "slot": ((), jnp.int32),
+        }
+        self.emit_cap = cfg.n_nodes
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> PbState:
+        n = cfg.n_nodes
+        return PbState(
+            store=jnp.full((n, self.K), -1, jnp.int32),
+            out_valid=jnp.zeros((n, self.W), bool),
+            out_key=jnp.zeros((n, self.W), jnp.int32),
+            out_val=jnp.zeros((n, self.W), jnp.int32),
+            out_client=jnp.zeros((n, self.W), jnp.int32),
+            out_acks=jnp.zeros((n, self.W), jnp.int32),
+            client_acked=jnp.zeros((n,), jnp.int32),
+        )
+
+    def handle_ctl_write(self, cfg, me, row: PbState, m: Msgs, key):
+        """write/3 from any node forwards to the primary (:178-186)."""
+        return row, self.emit(jnp.zeros((1,), jnp.int32),
+                              self.typ("write_req"),
+                              wkey=m.data["wkey"], value=m.data["value"],
+                              client=me)
+
+    def handle_write_req(self, cfg, me, row: PbState, m: Msgs, key):
+        """Primary: apply locally, park outstanding, collaborate with the
+        backups (:178-219)."""
+        k = jnp.clip(m.data["wkey"], 0, self.K - 1)
+        free = ~row.out_valid
+        ok = jnp.any(free)
+        slot = jnp.argmax(free)
+        wr = lambda a, v: a.at[slot].set(jnp.where(ok, v, a[slot]))
+        row = row.replace(
+            store=row.store.at[k].set(jnp.where(ok, m.data["value"],
+                                                row.store[k])),
+            out_valid=wr(row.out_valid, True),
+            out_key=wr(row.out_key, k),
+            out_val=wr(row.out_val, m.data["value"]),
+            out_client=wr(row.out_client, m.data["client"]),
+            out_acks=wr(row.out_acks, 0),
+        )
+        others = jnp.where(self._backups(me) & ok, self._ids(), -1)
+        em = self.emit(others, self.typ("collaborate"),
+                       wkey=k, value=m.data["value"], slot=slot)
+        return row, em
+
+    def _ids(self):
+        return jnp.arange(self.cfg.n_nodes, dtype=jnp.int32)
+
+    def _backups(self, me):
+        return self._ids() != 0
+
+    def handle_collaborate(self, cfg, me, row: PbState, m: Msgs, key):
+        k = jnp.clip(m.data["wkey"], 0, self.K - 1)
+        row = row.replace(store=row.store.at[k].set(m.data["value"]))
+        return row, self.emit(m.src[None], self.typ("collaborate_ack"),
+                              slot=m.data["slot"])
+
+    def handle_collaborate_ack(self, cfg, me, row: PbState, m: Msgs, key):
+        """Primary: all backups acked -> confirm to the client (:221-246)."""
+        s = jnp.clip(m.data["slot"], 0, self.W - 1)
+        acks = row.out_acks.at[s].add(row.out_valid[s].astype(jnp.int32))
+        done = row.out_valid[s] & (acks[s] >= self.cfg.n_nodes - 1)
+        row = row.replace(
+            out_acks=acks,
+            out_valid=row.out_valid.at[s].set(row.out_valid[s] & ~done))
+        rep = self.emit(jnp.where(done, row.out_client[s], -1)[None],
+                        self.typ("client_reply"))
+        return row, rep
+
+    def handle_client_reply(self, cfg, me, row: PbState, m: Msgs, key):
+        return row.replace(client_acked=row.client_acked + 1), self.no_emit()

@@ -1,0 +1,146 @@
+"""Static and client/server peer-service managers — TPU-native rebuilds of
+``src/partisan_static_peer_service_manager.erl`` and
+``src/partisan_client_server_peer_service_manager.erl``.
+
+Both keep an explicit membership set with **no view gossip**: an edge exists
+only because someone joined someone.  The difference is the admission rule
+applied during the join handshake:
+
+  * static: always accept (static :403 handles only data forwarding; joins
+    accumulate into the set unconditionally).
+  * client/server: ``accept_join_with_tag`` (client_server :500-523) —
+    servers accept servers and clients; clients accept only servers, which
+    yields the star topology of the reference's client/server tests
+    (tags set by test support, test/partisan_support.erl:303-317).
+
+Handshake shape mirrors the reference's {connected, Node, TheirTag, _}
+flow (client_server :322-364): the joiner requests with its tag, the peer
+admits by its own rule and replies with *its* tag, and the joiner then
+applies the same rule before adding the peer — membership stays one-sided
+per node exactly as in the reference (each node's set is what IT accepted).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import padded_set as ps
+from ..ops.msg import Msgs
+
+SERVER, CLIENT = 0, 1
+
+
+@struct.dataclass
+class MgrState:
+    members: jax.Array   # [N, C] padded member set (what this node accepted)
+    tag: jax.Array       # [N] int32 — SERVER / CLIENT
+    left: jax.Array      # [N] bool
+
+
+class StaticManager(ProtocolBase):
+    """Static membership: joins accumulate, nothing is gossiped, leaves
+    remove locally and notify the target only."""
+
+    msg_types = ("join_req", "join_ack", "leave_note",
+                 "ctl_join", "ctl_leave")
+
+    def __init__(self, cfg: Config, member_cap: int | None = None):
+        self.cfg = cfg
+        self.C = member_cap or min(cfg.n_nodes - 1, 32)
+        self.data_spec: Dict = {
+            "peer": ((), jnp.int32),
+            "tag": ((), jnp.int32),
+        }
+        self.emit_cap = self.C  # ctl_leave notifies every member
+        self.tick_emit_cap = 1
+
+    # admission rule; overridden by the client/server manager
+    def _accept(self, my_tag: jax.Array, their_tag: jax.Array) -> jax.Array:
+        return jnp.bool_(True)
+
+    def init(self, cfg: Config, key: jax.Array) -> MgrState:
+        n = cfg.n_nodes
+        return MgrState(
+            members=jnp.full((n, self.C), -1, jnp.int32),
+            tag=self.init_tags(cfg),
+            left=jnp.zeros((n,), bool),
+        )
+
+    def init_tags(self, cfg: Config) -> jax.Array:
+        return jnp.zeros((cfg.n_nodes,), jnp.int32)
+
+    def member_mask(self, row: MgrState) -> jax.Array:
+        n = self.cfg.n_nodes
+        m = jnp.zeros((n,), bool)
+        return m.at[jnp.clip(row.members, 0, n - 1)].max(row.members >= 0)
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_ctl_join(self, cfg, me, row: MgrState, m: Msgs, key):
+        peer = m.data["peer"]
+        ok = (peer >= 0) & (peer != me)
+        row = row.replace(left=jnp.where(ok, False, row.left))
+        return row, self.emit(jnp.where(ok, peer, -1)[None],
+                              self.typ("join_req"),
+                              tag=self._my_tag(row, me))
+
+    def _my_tag(self, row: MgrState, me) -> jax.Array:
+        return row.tag  # row is this node's slice; tag is scalar here
+
+    def handle_join_req(self, cfg, me, row: MgrState, m: Msgs, key):
+        mine = self._my_tag(row, me)
+        accept = self._accept(mine, m.data["tag"]) & ~row.left
+        row = row.replace(members=ps.insert(
+            row.members, jnp.where(accept, m.src, -1)))
+        ack = self.emit(jnp.where(accept, m.src, -1)[None],
+                        self.typ("join_ack"), tag=mine)
+        return row, ack
+
+    def handle_join_ack(self, cfg, me, row: MgrState, m: Msgs, key):
+        accept = self._accept(self._my_tag(row, me), m.data["tag"]) \
+            & ~row.left
+        row = row.replace(members=ps.insert(
+            row.members, jnp.where(accept, m.src, -1)))
+        return row, self.no_emit()
+
+    def handle_leave_note(self, cfg, me, row: MgrState, m: Msgs, key):
+        row = row.replace(members=ps.remove(row.members, m.src))
+        return row, self.no_emit()
+
+    def handle_ctl_leave(self, cfg, me, row: MgrState, m: Msgs, key):
+        """Self-leave: notify every member, clear local state (static
+        :248 {stop, normal} on self-removal)."""
+        target = m.data["peer"]
+        self_leave = target == me
+        note = self.emit(jnp.where(self_leave, row.members, -1),
+                         self.typ("leave_note"), cap=self.C)
+        row = row.replace(
+            members=jnp.where(self_leave, -1,
+                              ps.remove(row.members, target)),
+            left=row.left | self_leave)
+        return row, note
+
+
+class ClientServerManager(StaticManager):
+    """Star topology via tag-gated admission (client_server :500-523).
+    ``n_servers`` leading node ids are servers; the rest are clients."""
+
+    def __init__(self, cfg: Config, n_servers: int = 1,
+                 member_cap: int | None = None):
+        super().__init__(cfg, member_cap)
+        self.n_servers = n_servers
+
+    def init_tags(self, cfg: Config) -> jax.Array:
+        ids = jnp.arange(cfg.n_nodes)
+        return jnp.where(ids < self.n_servers, SERVER, CLIENT).astype(
+            jnp.int32)
+
+    def _accept(self, my_tag: jax.Array, their_tag: jax.Array) -> jax.Array:
+        # server accepts everyone; client accepts only servers
+        return (my_tag == SERVER) | (their_tag == SERVER)

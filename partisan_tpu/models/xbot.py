@@ -1,0 +1,179 @@
+"""HyParView + X-BOT topology optimization — TPU-native rebuild of
+``src/partisan_hyparview_xbot_peer_service_manager.erl``.
+
+X-BOT periodically tries to swap a "costly" active edge for a cheaper
+passive candidate via the 4-node handshake (initiator i, candidate c,
+i's old peer o, c's disconnect victim d):
+
+  i --optimization(o)--> c                      (:587-605, 707)
+  c full: c --replace(i, o)--> d                (:1205-1225)
+  d: o better than c? --switch(i, c)--> o       (:1252-1268)
+  o --switch_reply--> d: drop i, add d          (:1295-1316)
+  d --replace_reply--> c: drop c, add o         (:1270-1293)
+  c --optimization_reply--> i: drop d, add i    (:1227-1250)
+  i: drop o, add c                              (:1171-1200)
+
+"Better" in the reference probes live RTT with ``net_adm:ping``
+(:1318-1327).  A round-synchronous simulator has uniform delivery, so the
+cost oracle is an explicit synthetic **latency matrix**: a deterministic
+symmetric cost ``lat(a, b)`` derived from node ids (ring distance by
+default).  This keeps the optimizer's observable behaviour — total active
+edge cost falls while the overlay stays connected — measurable and
+testable, which live RTT would not be.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..ops import padded_set as ps
+from ..ops.msg import Msgs
+from .. import prng
+from .hyparview import HvState, HyParView
+
+
+def ring_latency(a: jax.Array, b: jax.Array, n: int) -> jax.Array:
+    """Default cost oracle: distance on the id ring (nodes far apart in id
+    space are 'far away' in the synthetic network)."""
+    d = jnp.abs(a - b)
+    return jnp.minimum(d, n - d).astype(jnp.int32)
+
+
+class XBotHyParView(HyParView):
+    msg_types = HyParView.msg_types + (
+        "optimization", "optimization_reply", "replace", "replace_reply",
+        "switch", "switch_reply", "disconnect_wait")
+
+    xbot_interval = 9  # reference randomizes 5-65 s (partisan.hrl:61-62)
+
+    def __init__(self, cfg: Config, latency=None):
+        super().__init__(cfg)
+        self.lat = latency or (
+            lambda a, b: ring_latency(a, b, cfg.n_nodes))
+        self.data_spec = dict(self.data_spec)
+        self.data_spec.update({
+            "xb_old": ((), jnp.int32),     # o
+            "xb_init": ((), jnp.int32),    # i
+            "xb_cand": ((), jnp.int32),    # c
+            "xb_disc": ((), jnp.int32),    # d
+        })
+
+    # -- cost helpers --------------------------------------------------------
+
+    def _worst_active(self, me, row: HvState, exclude=None) -> jax.Array:
+        """Highest-latency active peer (the edge worth replacing)."""
+        costs = jax.vmap(lambda p: self.lat(me, p))(row.active)
+        ok = row.active >= 0
+        if exclude is not None:
+            ok = ok & (row.active != exclude)
+        idx = jnp.argmax(jnp.where(ok, costs, -1))
+        return jnp.where(jnp.any(ok), row.active[idx], -1)
+
+    def _better(self, me, new, old) -> jax.Array:
+        """is_better(latency, New, Old) (:1318-1327)."""
+        return (new >= 0) & ((old < 0) | (self.lat(me, new)
+                                          < self.lat(me, old)))
+
+    # -- handshake handlers --------------------------------------------------
+
+    def handle_optimization(self, cfg, me, row: HvState, m: Msgs, key):
+        """Candidate side (:1205-1225): room -> accept directly; full ->
+        delegate to my own worst edge d via replace."""
+        i, o = m.src, m.data["xb_old"]
+        room = ps.size(row.active) < cfg.max_active_size
+        ok = (i >= 0) & ~row.left
+        # direct accept
+        row2, _, _ = self._add_active(cfg, me, row,
+                                      jnp.where(ok & room, i, -1), key)
+        acc = self.emit(jnp.where(ok & room, i, -1)[None],
+                        self.typ("optimization_reply"),
+                        xb_old=o, xb_cand=me, xb_disc=-1)
+        # delegate
+        d = self._worst_active(me, row2, exclude=i)
+        deleg = ok & ~room & (d >= 0)
+        rep = self.emit(jnp.where(deleg, d, -1)[None], self.typ("replace"),
+                        xb_old=o, xb_init=i, xb_cand=me)
+        rej = self.emit(jnp.where(ok & ~room & (d < 0), i, -1)[None],
+                        self.typ("optimization_reply"),
+                        xb_old=o, xb_cand=me, xb_disc=-2)  # -2 = rejected
+        return row2, self.merge(acc, rep, rej)
+
+    def handle_replace(self, cfg, me, row: HvState, m: Msgs, key):
+        """Disconnect-victim side (:1252-1268): is o better for me than my
+        current edge to c?  yes -> ask o to switch; no -> refuse."""
+        c, o, i = m.src, m.data["xb_old"], m.data["xb_init"]
+        better = self._better(me, o, c) & ~row.left
+        sw = self.emit(jnp.where(better, o, -1)[None], self.typ("switch"),
+                       xb_init=i, xb_cand=c)
+        no = self.emit(jnp.where(~better, c, -1)[None],
+                       self.typ("replace_reply"),
+                       xb_old=o, xb_init=i, xb_disc=-2)
+        return row, self.merge(sw, no)
+
+    def handle_switch(self, cfg, me, row: HvState, m: Msgs, key):
+        """Old-peer side (:1295-1316): i is dropping me; adopt d instead."""
+        d, i, c = m.src, m.data["xb_init"], m.data["xb_cand"]
+        ok = ~row.left
+        row = row.replace(active=jnp.where(
+            ok & (row.active == i), -1, row.active))
+        row2, _, _ = self._add_active(cfg, me, row,
+                                      jnp.where(ok, d, -1), key)
+        rep = self.emit(jnp.where(ok, d, -1)[None],
+                        self.typ("switch_reply"), xb_init=i, xb_cand=c)
+        return row2, rep
+
+    def handle_switch_reply(self, cfg, me, row: HvState, m: Msgs, key):
+        """d completes its half (:1270-1293): drop c, keep o (= m.src)."""
+        o, c = m.src, m.data["xb_cand"]
+        row = row.replace(active=jnp.where(row.active == c, -1, row.active))
+        row2, _, _ = self._add_active(cfg, me, row, o, key)
+        rep = self.emit(c[None], self.typ("replace_reply"),
+                        xb_old=o, xb_init=m.data["xb_init"], xb_disc=me)
+        return row2, rep
+
+    def handle_replace_reply(self, cfg, me, row: HvState, m: Msgs, key):
+        """Candidate completes (:1227-1250): drop d, add i, confirm to i."""
+        d, i = m.data["xb_disc"], m.data["xb_init"]
+        ok = d >= 0  # -2 = refusal: nothing happened
+        row = row.replace(active=jnp.where(
+            ok & (row.active == d), -1, row.active))
+        row2, _, _ = self._add_active(cfg, me, row,
+                                      jnp.where(ok, i, -1), key)
+        rep = self.emit(jnp.where(ok, i, -1)[None],
+                        self.typ("optimization_reply"),
+                        xb_old=m.data["xb_old"], xb_cand=me, xb_disc=d)
+        return row2, rep
+
+    def handle_optimization_reply(self, cfg, me, row: HvState, m: Msgs, key):
+        """Initiator completes (:1171-1200): drop o, add c."""
+        c, o, d = m.src, m.data["xb_old"], m.data["xb_disc"]
+        ok = (d != -2) & ~row.left  # not a rejection
+        row = row.replace(active=jnp.where(
+            ok & (row.active == o), -1, row.active))
+        row2, _, _ = self._add_active(cfg, me, row,
+                                      jnp.where(ok, c, -1), key)
+        dw = self.emit(jnp.where(ok, o, -1)[None],
+                       self.typ("disconnect_wait"))
+        return row2, dw
+
+    def handle_disconnect_wait(self, cfg, me, row: HvState, m: Msgs, key):
+        """o finalizes: demote i to passive (:the disconnect_wait leg)."""
+        i = m.src
+        row = row.replace(active=jnp.where(row.active == i, -1, row.active))
+        row = self._add_passive(cfg, me, row, i, key)
+        return row, self.no_emit()
+
+    # -- timer ---------------------------------------------------------------
+
+    def tick(self, cfg, me, row: HvState, rnd, key):
+        row, em = super().tick(cfg, me, row, rnd, key)
+        due = (((rnd + 3 * me) % self.xbot_interval) == 0) & ~row.left
+        cand = ps.random_member(row.passive, prng.decision_key(key, 60))
+        worst = self._worst_active(me, row)
+        go = due & self._better(me, cand, worst) & (worst >= 0)
+        opt = self.emit(jnp.where(go, cand, -1)[None],
+                        self.typ("optimization"),
+                        cap=self.tick_emit_cap, xb_old=worst)
+        return row, self.merge(em, opt, cap=self.tick_emit_cap)

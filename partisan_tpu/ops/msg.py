@@ -15,10 +15,23 @@ Core per-message fields:
   valid    bool   — liveness of the slot
   src/dst  int32  — virtual node ids
   typ      int32  — protocol message tag (per-protocol enum)
-  channel  int32  — logical channel lane (partisan.hrl:17-19)
+  channel  int32  — logical channel index (partisan.hrl:17-19)
+  lane     int32  — connection lane within the channel: the k-way connection
+                    `parallelism` of the reference (partisan.hrl:16), chosen
+                    by partition-key hash or at random (dispatch_pid,
+                    partisan_util.erl:142-201) via :func:`dispatch`
   delay    int32  — rounds to hold before delivery (ingress/egress delay +
                     the '$delay' interposition verb, pluggable :669-764)
+  born     int32  — round the message was emitted (stamped by the engine);
+                    recency for monotonic elision and FIFO ordering under
+                    mixed delays — buffer position alone cannot order
+                    across rounds because held messages sit after new ones
   data     dict   — protocol payload (int32/uint32 arrays, leading dim M)
+
+A (src, dst, channel, lane) quadruple is one *connection*: delivery keeps
+FIFO order within a connection and randomizes order across connections —
+exactly TCP's guarantee, and exactly what the reference's per-connection
+gen_servers provide (SURVEY §2.11).
 """
 
 from __future__ import annotations
@@ -37,7 +50,9 @@ class Msgs:
     dst: jax.Array            # [M] int32
     typ: jax.Array            # [M] int32
     channel: jax.Array        # [M] int32
+    lane: jax.Array           # [M] int32
     delay: jax.Array          # [M] int32
+    born: jax.Array           # [M] int32
     data: Dict[str, jax.Array]  # each [M, ...]
 
     @property
@@ -55,7 +70,7 @@ def empty(cap: int, data_spec: Dict[str, Tuple[Tuple[int, ...], Any]]) -> Msgs:
     z = jnp.zeros((cap,), dtype=jnp.int32)
     return Msgs(
         valid=jnp.zeros((cap,), dtype=bool),
-        src=z, dst=z, typ=z, channel=z, delay=z,
+        src=z, dst=z, typ=z, channel=z, lane=z, delay=z, born=z,
         data={k: jnp.zeros((cap,) + tuple(shape), dtype=dt)
               for k, (shape, dt) in data_spec.items()},
     )
@@ -88,9 +103,78 @@ def compact(m: Msgs, cap: int) -> Tuple[Msgs, jax.Array]:
     return out, dropped
 
 
+def _mix(x: jax.Array) -> jax.Array:
+    """Cheap integer hash (splitmix-style finalizer) for connection keys."""
+    x = jnp.uint32(x) if not jnp.issubdtype(x.dtype, jnp.unsignedinteger) \
+        else x
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def dispatch(m: Msgs, parallelism: int, partition_key: Optional[jax.Array],
+             salt: jax.Array) -> Msgs:
+    """Assign connection lanes — ``partisan_util:dispatch_pid/3``
+    (:142-201): a message with a partition key goes to lane
+    ``key rem parallelism`` (deterministic, order-preserving per key); one
+    without picks a uniform random lane.  No-op when parallelism == 1."""
+    if parallelism <= 1:
+        return m
+    rand = _mix(_mix(jnp.arange(m.cap, dtype=jnp.uint32)) ^ jnp.uint32(salt))
+    lane = (rand % jnp.uint32(parallelism)).astype(jnp.int32)
+    if partition_key is not None:
+        keyed = partition_key >= 0
+        lane = jnp.where(keyed, partition_key % parallelism, lane)
+    return m.replace(lane=lane)
+
+
+def _conn_key(m: Msgs, n_nodes: int, n_channels: int,
+              parallelism: int) -> jax.Array:
+    """Fused connection id for (src, dst, channel, lane).  HASH USE ONLY:
+    wraps in int32 above ~46k nodes, which merely perturbs the delivery
+    shuffle — never index a dense table with this."""
+    c = jnp.clip(m.channel, 0, max(n_channels - 1, 0))
+    l = jnp.clip(m.lane, 0, max(parallelism - 1, 0))
+    return ((jnp.clip(m.src, 0, n_nodes - 1) * n_nodes
+             + jnp.clip(m.dst, 0, n_nodes - 1)) * max(n_channels, 1) + c) \
+        * max(parallelism, 1) + l
+
+
+def monotonic_elide(m: Msgs, n_nodes: int, mono_mask: jax.Array,
+                    n_channels: int = 1, parallelism: int = 1) -> Msgs:
+    """Keep-latest reduction for monotonic channels
+    (``partisan_peer_connection:send/2`` send-elision under backlog,
+    :82-100, 188-202): among this round's messages on the same connection
+    whose channel is monotonic, only the most recently emitted survives.
+    ``mono_mask`` is a [n_channels] bool table."""
+    M = m.cap
+    mono = m.valid & mono_mask[jnp.clip(m.channel, 0, n_channels - 1)]
+    pos = jnp.arange(M)
+    # Sort mono messages into connection groups ordered by recency
+    # (born round, then emission position) and keep only the LAST of each
+    # group.  Sorting on the raw fields — not a dense fused key — keeps
+    # this O(M log M), independent of N, with no int32 key overflow
+    # (src*N alone would wrap above ~46k nodes).
+    order = jnp.lexsort(
+        (pos, m.born, m.lane, m.channel, m.dst, m.src, ~mono))
+    mono_s = mono[order]
+    same_group = ((m.src[order][:-1] == m.src[order][1:])
+                  & (m.dst[order][:-1] == m.dst[order][1:])
+                  & (m.channel[order][:-1] == m.channel[order][1:])
+                  & (m.lane[order][:-1] == m.lane[order][1:])
+                  & mono_s[:-1] & mono_s[1:])
+    # a sorted entry is superseded iff the next entry is the same
+    # connection (the next one is at least as recent by sort order)
+    superseded_s = jnp.concatenate([same_group, jnp.zeros((1,), bool)])
+    keep = jnp.ones((M,), bool).at[order].set(~superseded_s)
+    keep = ~mono | keep
+    return m.replace(valid=m.valid & keep)
+
+
 def build_inbox(
     m: Msgs, n_nodes: int, inbox_cap: int,
     key: Optional[jax.Array] = None,
+    n_channels: int = 1, parallelism: int = 1,
 ) -> Tuple[Msgs, Msgs, jax.Array]:
     """Route a flat buffer into per-node inboxes.
 
@@ -103,23 +187,28 @@ def build_inbox(
     ``key`` randomizes delivery order within the round, modeling the
     reference's nondeterministic network interleaving (the trace orchestrator's
     whole job is taming exactly this, src/partisan_trace_orchestrator.erl);
-    with a fixed key the schedule is deterministic and replayable.
+    with a fixed key the schedule is deterministic and replayable.  Order is
+    randomized ACROSS connections but FIFO WITHIN a (src, dst, channel,
+    lane) connection — TCP's guarantee, which the reference gets from its
+    per-connection gen_server send loops.
     """
     M = m.cap
     deliver = m.valid & (m.delay <= 0)
     held_valid = m.valid & (m.delay > 0)
     held = m.replace(valid=held_valid, delay=jnp.maximum(m.delay - 1, 0))
 
+    sort_key = jnp.where(deliver, m.dst, n_nodes)  # undeliverable -> end
     if key is not None:
-        perm = jax.random.permutation(key, M)
-        ms = _take(m, perm)
-        deliver_s = deliver[perm]
+        salt = jax.random.bits(key, (), jnp.uint32)
+        grand = _mix(jnp.uint32(_conn_key(m, n_nodes, n_channels,
+                                          parallelism)) ^ salt)
     else:
-        ms, deliver_s = m, deliver
-
-    sort_key = jnp.where(deliver_s, ms.dst, n_nodes)  # undeliverable -> end
-    order = jnp.argsort(sort_key, stable=True)
-    ms = _take(ms, order)
+        grand = jnp.zeros((M,), jnp.uint32)
+    # stable lexsort: by destination, then per-connection random, then
+    # emission round + position (stability) => FIFO inside a connection
+    # even when delayed (held) traffic mixes with fresh emissions
+    order = jnp.lexsort((m.born, grand, sort_key))
+    ms = _take(m, order)
     sdst = sort_key[order]
 
     starts = jnp.searchsorted(sdst, jnp.arange(n_nodes), side="left")
@@ -146,6 +235,7 @@ def inject(buf: Msgs, em: Msgs, src) -> Tuple[Msgs, jax.Array]:
     into free slots of the in-flight buffer, stamping ``src``.  Returns
     (new_buffer, n_dropped) — dropped when the buffer has no free slots."""
     k = em.cap
+    em = em.replace(born=jnp.zeros((k,), jnp.int32))
     free_idx, = jnp.nonzero(~buf.valid, size=k, fill_value=0)
     n_free = jnp.sum(~buf.valid)
     rank = jnp.cumsum(em.valid) - 1          # rank among valid entries
@@ -161,6 +251,22 @@ def inject(buf: Msgs, em: Msgs, src) -> Tuple[Msgs, jax.Array]:
     out = jax.tree_util.tree_map(write, buf, em)
     dropped = (jnp.sum(em.valid) - jnp.sum(ok)).astype(jnp.int32)
     return out, dropped
+
+
+def wire_hash(m: Msgs) -> jax.Array:
+    """[M] uint32 content hash of each message's payload fields — the trace
+    entry identity used by record/replay (the reference records full terms;
+    a hash suffices to match schedule entries, SURVEY §5.1)."""
+    h = jnp.zeros((m.cap,), jnp.uint32)
+    for j, name in enumerate(sorted(m.data)):
+        x = m.data[name]
+        flat = x.reshape((m.cap, -1)).astype(jnp.uint32)
+        fold = jnp.zeros((m.cap,), jnp.uint32)
+        for c in range(flat.shape[1]):
+            fold = _mix(fold ^ flat[:, c]
+                        ^ jnp.uint32((c * 0x9E3779B9) & 0xFFFFFFFF))
+        h = _mix(h ^ fold ^ jnp.uint32(((j + 1) * 0x85EBCA6B) & 0xFFFFFFFF))
+    return h
 
 
 def reduce_to_nodes(

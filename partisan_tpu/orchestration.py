@@ -1,0 +1,112 @@
+"""Cloud orchestration (SURVEY §2.8, L9) — rebuild of
+``partisan_orchestration_backend.erl`` + its strategy behaviour
+(``clients/1, servers/1, upload_artifact/3, download_artifact/2``,
+partisan_orchestration_strategy.erl:24-27).
+
+The reference polls an external discovery service (Redis for
+docker-compose, the k8s API for kubernetes), uploads this node's
+membership artifact and joins any peers it discovers.  Here the
+orchestrator runs host-side next to the simulator: each ``poll`` uploads
+the World's membership artifact and issues ``peer_service.join`` commands
+for discovered-but-unknown nodes.
+
+Strategies:
+  * :class:`FileSystemStrategy` — a shared directory as the artifact
+    store; the docker-compose/Redis analog, exercised in CI.
+  * :class:`KubernetesStrategy` — pod discovery via the k8s API; needs
+    cluster credentials, so it is a documented stub here (the image has
+    no egress), same callback surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Protocol
+
+import numpy as np
+
+from .engine import ProtocolBase, World
+from . import events as events_mod
+from . import peer_service
+
+
+class OrchestrationStrategy(Protocol):
+    def upload_artifact(self, name: str, payload: bytes) -> None: ...
+    def download_artifacts(self) -> Dict[str, bytes]: ...
+
+
+class FileSystemStrategy:
+    """Artifacts as files in a shared directory (compose/Redis analog)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def upload_artifact(self, name: str, payload: bytes) -> None:
+        tmp = os.path.join(self.root, f".{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(self.root, name))
+
+    def download_artifacts(self) -> Dict[str, bytes]:
+        out = {}
+        for fn in os.listdir(self.root):
+            if fn.startswith("."):
+                continue
+            with open(os.path.join(self.root, fn), "rb") as f:
+                out[fn] = f.read()
+        return out
+
+
+class KubernetesStrategy:
+    """Pod discovery through the Kubernetes API
+    (partisan_kubernetes_orchestration_strategy.erl).  Requires in-cluster
+    credentials; construction fails fast outside a cluster."""
+
+    def __init__(self) -> None:
+        raise NotImplementedError(
+            "kubernetes discovery needs in-cluster API access; use "
+            "FileSystemStrategy for local/compose deployments")
+
+
+class OrchestrationBackend:
+    """Host-side polling loop (the gen_server timers of
+    partisan_orchestration_backend.erl:38-70 — membership refresh + graph
+    upload — collapsed into an explicit ``poll``)."""
+
+    def __init__(self, strategy: OrchestrationStrategy,
+                 proto: ProtocolBase, my_node: int,
+                 name: Optional[str] = None):
+        self.strategy = strategy
+        self.proto = proto
+        self.my_node = my_node
+        self.name = name or f"node-{my_node}"
+
+    def poll(self, world: World) -> World:
+        """Upload my membership artifact; join any discovered stranger."""
+        mine = events_mod.members(world, self.proto, self.my_node)
+        payload = json.dumps(
+            {"node": self.my_node, "members": mine}).encode()
+        self.strategy.upload_artifact(self.name, payload)
+
+        known = set(mine) | {self.my_node}
+        for _, blob in sorted(self.strategy.download_artifacts().items()):
+            try:
+                art = json.loads(blob)
+            except (ValueError, UnicodeDecodeError):
+                continue
+            peers: List[int] = [int(art.get("node", -1))] + \
+                [int(x) for x in art.get("members", [])]
+            for p in peers:
+                if p >= 0 and p not in known:
+                    known.add(p)
+                    world = peer_service.join(world, self.proto,
+                                              self.my_node, p)
+        return world
+
+    def debug_get_tree(self, world: World) -> Dict[int, List[int]]:
+        """debug_get_tree analog: every node's member list."""
+        n = int(np.asarray(world.alive).shape[0])
+        return {i: events_mod.members(world, self.proto, i)
+                for i in range(n)}

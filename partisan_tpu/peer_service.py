@@ -20,12 +20,18 @@ from .engine import ProtocolBase, World
 from .ops import msg as msgops
 
 
-def _ctl(world: World, proto: ProtocolBase, node: int, typ_name: str,
-         delay: int = 0, **data) -> World:
+def send_ctl(world: World, proto: ProtocolBase, node: int, typ_name: str,
+             delay: int = 0, **data) -> World:
+    """Inject one control message addressed to ``node`` itself — the
+    host-side verb entry point every façade call (and the test harness)
+    goes through."""
     em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
                     cap=1, delay=delay, **data)
     msgs, _ = msgops.inject(world.msgs, em, src=node)
     return world.replace(msgs=msgs)
+
+
+_ctl = send_ctl
 
 
 def join(world: World, proto: ProtocolBase, node: int, peer: int,

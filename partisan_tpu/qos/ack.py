@@ -38,6 +38,8 @@ class AckRow:
     out_age: jax.Array      # [R] rounds since (re)transmission
     next_seq: jax.Array     # scalar — monotone id source
     seen: jax.Array         # [S] delivery counters per origin (test surface)
+    send_dropped: jax.Array  # scalar — ctl_sends lost to a full ring
+                             # (overflow surfaced, never silent)
 
 
 def init_rows(n_nodes: int, ring_cap: int = 8) -> AckRow:
@@ -50,6 +52,7 @@ def init_rows(n_nodes: int, ring_cap: int = 8) -> AckRow:
         out_age=jnp.zeros((n, ring_cap), jnp.int32),
         next_seq=jnp.ones((n,), jnp.int32),
         seen=jnp.zeros((n, n_nodes), jnp.int32),
+        send_dropped=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -106,6 +109,8 @@ class AckedDelivery(ProtocolBase):
     def handle_ctl_send(self, cfg, me, row: AckRow, m: Msgs, key):
         dst = m.data["peer"]
         row, seq, ok = store(row, dst, m.data["payload"])
+        row = row.replace(send_dropped=row.send_dropped
+                          + (~ok).astype(jnp.int32))
         em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("app"),
                        payload=m.data["payload"], seq=seq)
         return row, em

@@ -33,6 +33,7 @@ class RpcRow:
     prom_ref: jax.Array      # [P]
     prom_result: jax.Array   # [P]
     prom_done: jax.Array     # [P] reply arrived
+    call_dropped: jax.Array  # scalar — calls lost to a full promise ring
 
 
 def init_rows(n_nodes: int, promise_cap: int = 8) -> RpcRow:
@@ -43,6 +44,7 @@ def init_rows(n_nodes: int, promise_cap: int = 8) -> RpcRow:
         prom_ref=jnp.zeros((n, promise_cap), jnp.int32),
         prom_result=jnp.zeros((n, promise_cap), jnp.int32),
         prom_done=jnp.zeros((n, promise_cap), bool),
+        call_dropped=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -83,6 +85,8 @@ class Rpc(ProtocolBase):
             prom_valid=wr(row.prom_valid, True),
             prom_ref=wr(row.prom_ref, ref),
             prom_done=wr(row.prom_done, False),
+            call_dropped=row.call_dropped
+            + ((~ok) & (dst >= 0)).astype(jnp.int32),
         )
         em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("rpc_req"),
                        ref=ref, fn=fn, arg=arg)

@@ -1,0 +1,10 @@
+"""Verification harness (SURVEY §2.9, §5.1-5.3): named interposition
+registry, fault models, trace record/replay, dynamic causality analysis and
+the omission-schedule model checker — TPU-native rebuilds of the
+interposition API (partisan_pluggable_peer_service_manager.erl:51-58),
+prop_partisan's fault models, partisan_trace_orchestrator.erl and
+test/filibuster_SUITE.erl."""
+
+from .interposition import Interposition  # noqa: F401
+from . import faults  # noqa: F401
+from .trace import TraceRecorder, TraceEntry  # noqa: F401

@@ -1,0 +1,130 @@
+"""Fault models — the TPU rebuild of prop_partisan's crash / omission
+machinery (test/prop_partisan_crash_fault_model.erl:33-37, 94-140: crash,
+general/send/receive omissions implemented as interposition funs returning
+``undefined``) and the delay faults (``ingress_delay``/``egress_delay``,
+server :85-90, client :88-93).
+
+Each builder returns a pure ``(Msgs, rnd) -> Msgs`` interposition fun for
+:class:`verify.Interposition`; crash/partition faults act on the World's
+fault plane instead (``alive`` / ``partition`` arrays, SURVEY §5.3)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import World
+from ..ops.msg import Msgs
+
+
+def _match(m: Msgs, src, dst, typ) -> jax.Array:
+    hit = m.valid
+    if src is not None:
+        hit = hit & (m.src == src)
+    if dst is not None:
+        hit = hit & (m.dst == dst)
+    if typ is not None:
+        hit = hit & (m.typ == typ)
+    return hit
+
+
+def send_omission(src: Optional[int] = None, dst: Optional[int] = None,
+                  typ: Optional[int] = None,
+                  rounds: Optional[Tuple[int, int]] = None):
+    """Drop matching messages (interposition returning `undefined`,
+    crash_fault_model :116-128).  ``rounds=(lo, hi)`` limits the fault
+    window; None = always."""
+    def fn(m: Msgs, rnd: jax.Array) -> Msgs:
+        hit = _match(m, src, dst, typ)
+        if rounds is not None:
+            hit = hit & (rnd >= rounds[0]) & (rnd < rounds[1])
+        return m.replace(valid=m.valid & ~hit)
+    return fn
+
+
+# receive omission is the same transform applied on the recv hook
+# (crash_fault_model :129-140 distinguishes them only by hook site)
+receive_omission = send_omission
+
+
+def message_delay(extra: int, src: Optional[int] = None,
+                  dst: Optional[int] = None, typ: Optional[int] = None,
+                  rounds: Optional[Tuple[int, int]] = None):
+    """The '$delay' interposition verb / ingress+egress delay sleeps."""
+    def fn(m: Msgs, rnd: jax.Array) -> Msgs:
+        hit = _match(m, src, dst, typ)
+        if rounds is not None:
+            hit = hit & (rnd >= rounds[0]) & (rnd < rounds[1])
+        return m.replace(delay=jnp.where(hit, m.delay + extra, m.delay))
+    return fn
+
+
+def drop_schedule(schedule: Sequence[Tuple[int, int, int, int]]):
+    """Drop an explicit set of (round, src, dst, typ) wire entries — the
+    model checker's omission schedule (filibuster_SUITE execute_schedule
+    :1264).  Duplicate quadruples drop every matching copy that round."""
+    if not schedule:
+        return lambda m, rnd: m
+    sched = jnp.asarray(schedule, jnp.int32)  # [S, 4]
+
+    def fn(m: Msgs, rnd: jax.Array) -> Msgs:
+        hit = ((sched[:, 0][:, None] == rnd)
+               & (sched[:, 1][:, None] == m.src[None, :])
+               & (sched[:, 2][:, None] == m.dst[None, :])
+               & (sched[:, 3][:, None] == m.typ[None, :]))
+        drop = jnp.any(hit, axis=0) & m.valid
+        return m.replace(valid=m.valid & ~drop)
+    return fn
+
+
+def drop_schedule_dynamic(slot: str = "sched"):
+    """Like :func:`drop_schedule` but reads the [S, 4] (round, src, dst,
+    typ) schedule from ``world.aux[slot]`` at run time — rows with
+    ``round < 0`` are inert padding.  One compiled step then replays EVERY
+    schedule of the model checker's enumeration (schedules are data, not
+    code)."""
+    def fn(m: Msgs, rnd: jax.Array, world: World) -> Msgs:
+        sched = world.aux[slot]
+        active = sched[:, 0] >= 0
+        hit = (active[:, None]
+               & (sched[:, 0][:, None] == rnd)
+               & (sched[:, 1][:, None] == m.src[None, :])
+               & (sched[:, 2][:, None] == m.dst[None, :])
+               & (sched[:, 3][:, None] == m.typ[None, :]))
+        drop = jnp.any(hit, axis=0) & m.valid
+        return m.replace(valid=m.valid & ~drop)
+    return fn
+
+
+# ---------------------------------------------------------- world faults
+
+def crash(world: World, nodes: Sequence[int]) -> World:
+    """Crash-stop: the node neither sends nor receives from now on (the
+    ct_slave stop analog; engine masks both directions)."""
+    alive = world.alive
+    for n in nodes:
+        alive = alive.at[n].set(False)
+    return world.replace(alive=alive)
+
+
+def recover(world: World, nodes: Sequence[int]) -> World:
+    alive = world.alive
+    for n in nodes:
+        alive = alive.at[n].set(True)
+    return world.replace(alive=alive)
+
+
+def inject_partition(world: World, groups: Sequence[Sequence[int]]) -> World:
+    """Assign partition ids; cross-partition messages drop (the TTL-flood
+    partition marking of hyparview :1731-1797 collapsed to its effect)."""
+    part = world.partition
+    for gid, members in enumerate(groups, start=1):
+        for n in members:
+            part = part.at[n].set(gid)
+    return world.replace(partition=part)
+
+
+def resolve_partition(world: World) -> World:
+    return world.replace(partition=jnp.zeros_like(world.partition))

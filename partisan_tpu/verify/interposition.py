@@ -1,0 +1,81 @@
+"""Named interposition registry — the TPU analog of the pluggable manager's
+``add_pre/post/interposition_fun`` API
+(partisan_pluggable_peer_service_manager.erl:51-58, 297-334, 640-667).
+
+In the reference, interposition funs are keyed by name on a live gen_server
+and fire on every send/receive: *pre* funs observe, *interposition* funs may
+rewrite a message, drop it (return ``undefined``) or delay it (``'$delay'``);
+*post* funs observe original+rewritten pairs.  Here the registry is built
+BEFORE compiling the step (functions are staged into the jitted program —
+the XLA analog of installing hooks): each fun is a pure
+``(Msgs, rnd) -> Msgs`` transform over the flat wire buffer; drop = clear
+``valid``, delay = bump ``delay``, rewrite = replace fields.  Observation
+(the pre/post role) is served by ``capture_wire`` tracing
+(engine.make_step) rather than callbacks.
+
+Unlike the reference, changing the set of funs requires re-compiling the
+step (~seconds); within a run, funs can still vary behaviour by round
+number, which covers every schedule the fault models need.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..ops.msg import Msgs
+
+InterpFun = Callable[[Msgs, jax.Array], Msgs]
+
+
+class Interposition:
+    """Ordered, named send/recv interposition sets.
+
+    >>> interp = Interposition()
+    >>> interp.add_send("drop-joins", faults.send_omission(typ=3))
+    >>> step = make_step(cfg, proto, **interp.hooks())
+    """
+
+    def __init__(self) -> None:
+        self._send: Dict[str, InterpFun] = {}
+        self._recv: Dict[str, InterpFun] = {}
+
+    # -- registry (add/remove by name, like :297-334) -----------------------
+
+    def add_send(self, name: str, fn: InterpFun) -> "Interposition":
+        self._send[name] = fn
+        return self
+
+    def add_recv(self, name: str, fn: InterpFun) -> "Interposition":
+        self._recv[name] = fn
+        return self
+
+    def remove_send(self, name: str) -> "Interposition":
+        self._send.pop(name, None)
+        return self
+
+    def remove_recv(self, name: str) -> "Interposition":
+        self._recv.pop(name, None)
+        return self
+
+    # -- compilation --------------------------------------------------------
+
+    def _compose(self, funs: Dict[str, InterpFun]) -> Optional[InterpFun]:
+        if not funs:
+            return None
+        ordered = tuple(funs.values())
+
+        def composed(m: Msgs, rnd: jax.Array) -> Msgs:
+            for f in ordered:
+                m = f(m, rnd)
+            return m
+
+        return composed
+
+    def hooks(self) -> Dict[str, Optional[InterpFun]]:
+        """kwargs for :func:`engine.make_step`."""
+        return {
+            "interpose_send": self._compose(self._send),
+            "interpose_recv": self._compose(self._recv),
+        }

@@ -1,0 +1,114 @@
+"""Trace recording + deterministic replay — the TPU rebuild of
+``src/partisan_trace_orchestrator.erl`` / ``src/partisan_trace_file.erl``.
+
+The reference records ``{pre_interposition_fun, {Node, Type, Origin, Msg}}``
+tuples into an ordered trace, persists them via dets, and under
+``REPLAY=true`` blocks every process until its message is next in the trace
+(partial-order replay, :160-202, 476-560).
+
+In the round-synchronous simulator, determinism is already total — fixed
+PRNG keys make every run bit-identical (SURVEY §5.2) — so "replay" needs no
+blocking: re-running with the same Config IS the replay.  What remains of
+the orchestrator's job is (a) capturing the wire for inspection and
+schedule enumeration, and (b) re-running with an *omission schedule*
+applied (faults.drop_schedule), which is exactly what the model checker
+explores.  Traces serialize to JSONL (the dets-file analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import Config
+from ..engine import ProtocolBase, World, init_world, make_step
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEntry:
+    """One wire message (round, src, dst, typ, channel, payload hash)."""
+    rnd: int
+    src: int
+    dst: int
+    typ: int
+    channel: int
+    hash: int
+
+    @property
+    def key(self) -> Tuple[int, int, int, int]:
+        """Schedule-matching identity (round, src, dst, typ) — the drop
+        granularity of faults.drop_schedule."""
+        return (self.rnd, self.src, self.dst, self.typ)
+
+
+class TraceRecorder:
+    """Runs a protocol while dumping each round's wire buffer to host.
+
+    >>> rec = TraceRecorder(cfg, proto)
+    >>> world = rec.run(world, n_rounds=30)
+    >>> rec.entries          # ordered list[TraceEntry]
+    """
+
+    def __init__(self, cfg: Config, proto: ProtocolBase,
+                 interpose_send=None, interpose_recv=None,
+                 randomize_delivery: bool = True):
+        self.cfg = cfg
+        self.proto = proto
+        self.step = make_step(cfg, proto, donate=False,
+                              interpose_send=interpose_send,
+                              interpose_recv=interpose_recv,
+                              randomize_delivery=randomize_delivery,
+                              capture_wire=True)
+        self.entries: List[TraceEntry] = []
+
+    def run(self, world: World, n_rounds: int,
+            on_round: Optional[Callable[[World, Dict], None]] = None
+            ) -> World:
+        for _ in range(n_rounds):
+            world, metrics = self.step(world)
+            valid = np.asarray(metrics["wire_valid"])
+            if valid.any():
+                rnd = int(metrics["round"])
+                src = np.asarray(metrics["wire_src"])
+                dst = np.asarray(metrics["wire_dst"])
+                typ = np.asarray(metrics["wire_typ"])
+                ch = np.asarray(metrics["wire_channel"])
+                h = np.asarray(metrics["wire_hash"])
+                for i in np.flatnonzero(valid):
+                    self.entries.append(TraceEntry(
+                        rnd, int(src[i]), int(dst[i]), int(typ[i]),
+                        int(ch[i]), int(h[i])))
+            if on_round is not None:
+                on_round(world, metrics)
+        return world
+
+    # ------------------------------------------------------------- filtering
+
+    def protocol_entries(self, typs: Iterable[int]) -> List[TraceEntry]:
+        """The membership_strategy_tracing filter (:508-560): keep only the
+        message types worth exploring."""
+        ts = set(typs)
+        return [e for e in self.entries if e.typ in ts]
+
+
+# ------------------------------------------------------------ persistence
+
+def write_trace(path: str, entries: Iterable[TraceEntry]) -> None:
+    """partisan_trace_file:write/2 — one JSON object per line."""
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(dataclasses.asdict(e)) + "\n")
+
+
+def read_trace(path: str) -> List[TraceEntry]:
+    """partisan_trace_file:read/1."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                out.append(TraceEntry(**json.loads(line)))
+    return out

@@ -3,7 +3,10 @@ exercised without TPU hardware (the real chip is reserved for bench.py)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set (not setdefault): the ambient environment pins JAX to the real
+# TPU tunnel, which must stay free for bench.py — and a single chip shared
+# by concurrent test processes crashes its worker.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,4 +15,19 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env setup)
 
+# the TPU plugin's sitecustomize registers itself via jax.config (so the
+# env var alone is a no-op); override the config too and drop any backend
+# set initialized before this conftest ran
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():  # pragma: no cover
+        from jax.extend.backend import clear_backends
+        clear_backends()
+except Exception:  # noqa: BLE001 — best effort; device check below decides
+    pass
+
 jax.config.update("jax_enable_x64", False)
+
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh, got: " + repr(jax.devices()))

@@ -1,0 +1,104 @@
+"""Auxiliary subsystem tests: checkpoint/resume (SURVEY §5.4), membership
+events (partisan_peer_service_events analog), console, and on-device
+metrics (SURVEY §5.5)."""
+
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import checkpoint, events, metrics, peer_service
+from partisan_tpu.models.full_membership import FullMembership
+from partisan_tpu.models.hyparview import HyParView
+
+
+def boot_full(n=8, rounds=0):
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+    proto = FullMembership(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = peer_service.cluster(world, proto,
+                                 [(i, i - 1) for i in range(1, n)])
+    for _ in range(rounds):
+        world, _ = step(world)
+    return cfg, proto, world, step
+
+
+class TestCheckpoint:
+    def test_save_load_resume_bitwise(self, tmp_path):
+        """Resume must continue bit-identically (total checkpoint, unlike
+        the reference's epoch-only persistence)."""
+        cfg, proto, world, step = boot_full(rounds=5)
+        path = str(tmp_path / "ck")
+        checkpoint.save(path, cfg, world)
+
+        # branch A: continue directly
+        wa = world
+        for _ in range(5):
+            wa, _ = step(wa)
+
+        # branch B: restore + continue
+        template = pt.init_world(cfg, proto)
+        wb, manifest = checkpoint.load(path, template)
+        assert manifest["round"] == 5
+        for _ in range(5):
+            wb, _ = step(wb)
+
+        assert (np.asarray(wa.state.adds) == np.asarray(wb.state.adds)).all()
+        assert (np.asarray(wa.msgs.valid) == np.asarray(wb.msgs.valid)).all()
+        assert int(wa.rnd) == int(wb.rnd) == 10
+
+    def test_config_roundtrip(self, tmp_path):
+        cfg, proto, world, _ = boot_full()
+        path = str(tmp_path / "ck")
+        checkpoint.save(path, cfg, world)
+        cfg2 = checkpoint.load_config(path)
+        assert cfg2 == cfg
+
+
+class TestEvents:
+    def test_membership_change_callbacks(self):
+        cfg, proto, world, step = boot_full()
+        ev = events.PeerServiceEvents(proto)
+        fired = []
+        ev.add_sup_callback(lambda node, mask: fired.append(node))
+        ev.update(world)                    # baseline snapshot
+        for _ in range(6):
+            world, _ = step(world)
+        changed = ev.update(world)
+        assert changed > 0 and fired       # joins changed memberships
+        fired.clear()
+        changed = ev.update(world)          # no rounds ran: no changes
+        assert changed == 0 and not fired
+
+    def test_console_format(self):
+        cfg, proto, world, step = boot_full()
+        for _ in range(10):
+            world, _ = step(world)
+        s = events.format_members(world, proto, 0)
+        assert s.startswith("node 0:") and "members" in s
+
+
+class TestMetrics:
+    def test_world_health_converges(self):
+        cfg, proto, world, step = boot_full()
+        h0 = metrics.world_health(world, proto)
+        assert float(h0["convergence"]) < 1.0
+        for _ in range(16):
+            world, _ = step(world)
+        h = metrics.world_health(world, proto)
+        assert float(h["convergence"]) == 1.0
+        assert int(h["alive"]) == 8
+
+    def test_view_stats_and_connectivity(self):
+        cfg = pt.Config(n_nodes=16, inbox_cap=8, shuffle_interval=5)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = peer_service.cluster(world, proto,
+                                     [(i, 0) for i in range(1, 16)])
+        for _ in range(40):
+            world, _ = step(world)
+        vs = metrics.view_stats(world.state.active, world.alive)
+        assert int(vs["isolated"]) == 0
+        assert float(vs["mean_view"]) >= cfg.min_active_size
+        conn = metrics.connectivity(world.state.active, world.alive)
+        assert bool(conn["connected"]) and bool(conn["symmetric"])

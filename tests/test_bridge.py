@@ -1,0 +1,129 @@
+"""Erlang port bridge tests: ETF codec roundtrips (the term_to_binary
+subset), packet-4 framing, the native C++ bulk codec vs the Python
+reference, and a live port_server subprocess session driven exactly like
+the Erlang manager drives it."""
+
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from partisan_tpu.bridge import etf
+from partisan_tpu.bridge.etf import Atom
+from partisan_tpu.bridge import native_loader
+
+
+TERMS = [
+    0, 255, 256, -1, 2**31 - 1, -(2**31), 2**80, -(2**80),
+    1.5, -0.25,
+    Atom("ok"), Atom("error"), True, False, None,
+    b"", b"hello", "unicode✓",
+    (), (Atom("reply"), 1, 2), tuple(range(300)),
+    [], [1, 2, 3], [Atom("a"), (1, [2, [3]])],
+    {Atom("k"): 1, 2: [3, 4]},
+]
+
+
+class TestEtfCodec:
+    @pytest.mark.parametrize("term", TERMS, ids=[repr(t)[:30] for t in TERMS])
+    def test_roundtrip(self, term):
+        got = etf.decode(etf.encode(term))
+        if isinstance(term, str) and not isinstance(term, Atom):
+            assert got == term.encode("utf-8")  # strings ride as binaries
+        elif term is None:
+            assert got == Atom("undefined")    # None <-> 'undefined'
+        else:
+            assert got == term
+            assert type(got) is type(term) or isinstance(term, bool)
+
+    def test_atom_vs_binary_distinct(self):
+        assert etf.encode(Atom("x")) != etf.encode(b"x")
+        assert isinstance(etf.decode(etf.encode(Atom("x"))), Atom)
+        assert isinstance(etf.decode(etf.encode(b"x")), bytes)
+
+    def test_erlang_golden_bytes(self):
+        """Fixed byte strings produced by Erlang's term_to_binary/1."""
+        # term_to_binary(ok) = <<131,119,2,111,107>> (OTP 23+ small utf8)
+        assert etf.decode(bytes([131, 119, 2, 111, 107])) == Atom("ok")
+        # term_to_binary({join, 1, 2}) with legacy ATOM_EXT(100)
+        legacy = bytes([131, 104, 3, 100, 0, 4]) + b"join" + \
+            bytes([97, 1, 97, 2])
+        assert etf.decode(legacy) == (Atom("join"), 1, 2)
+        # term_to_binary([1000]) = <<131,108,0,0,0,1,98,0,0,3,232,106>>
+        assert etf.decode(
+            bytes([131, 108, 0, 0, 0, 1, 98, 0, 0, 3, 232, 106])) == [1000]
+        # STRING_EXT: term_to_binary("ab") = <<131,107,0,2,97,98>>
+        assert etf.decode(bytes([131, 107, 0, 2, 97, 98])) == [97, 98]
+
+    def test_framing(self):
+        buf = io.BytesIO(etf.frame(b"abc") + etf.frame(b""))
+        assert etf.read_frame(buf) == b"abc"
+        assert etf.read_frame(buf) == b""
+        assert struct.unpack(">I", etf.frame(b"abc")[:4])[0] == 3
+
+
+class TestNativeCodec:
+    def test_native_lib_builds(self):
+        assert native_loader.native_lib() is not None, \
+            "g++ is in the image; the native codec must build"
+
+    def test_encode_matches_python(self):
+        vals = np.asarray([0, 1, 255, 256, -1, 2**31 - 1, -(2**31)],
+                          np.int32)
+        native = native_loader.encode_intlist(vals)
+        pyref = etf.encode([int(v) for v in vals])
+        assert native == pyref
+
+    def test_decode_roundtrip_large(self):
+        vals = np.arange(-5000, 5000, dtype=np.int32)
+        data = native_loader.encode_intlist(vals)
+        back = native_loader.decode_intlist(data, cap=vals.size)
+        assert (back == vals).all()
+
+    def test_decode_falls_back_on_structured(self):
+        data = etf.encode([1, Atom("x")])
+        with pytest.raises(Exception):
+            native_loader.decode_intlist(data)
+
+    def test_empty(self):
+        assert native_loader.decode_intlist(
+            native_loader.encode_intlist([])).size == 0
+
+
+@pytest.mark.slow
+class TestPortSession:
+    def test_full_session(self, tmp_path):
+        """Boot a port server, form a 8-node full-membership cluster, check
+        members, checkpoint/restore, crash, stop — the command sequence the
+        Erlang manager issues."""
+        from partisan_tpu.bridge.client import PortClient
+        with PortClient() as pc:
+            assert pc.start("full", n_nodes=8, periodic_interval=2) == \
+                Atom("ok")
+            for i in range(1, 8):
+                assert pc.join(i, i - 1) == Atom("ok")
+            pc.advance(30)
+            ms = pc.members(0)
+            assert ms == list(range(8))
+            h = pc.health()
+            assert h[Atom("alive")] == 8
+            assert h[Atom("convergence")] == pytest.approx(1.0)
+            # checkpoint -> perturb -> restore
+            path = str(tmp_path / "ckpt")
+            assert pc.call((Atom("checkpoint"), path)) == Atom("ok")
+            assert pc.call((Atom("crash"), [3])) == Atom("ok")
+            pc.advance(2)
+            assert pc.health()[Atom("alive")] == 7
+            assert pc.call((Atom("restore"), path)) == Atom("ok")
+            assert pc.health()[Atom("alive")] == 8
+
+    def test_error_handling(self):
+        from partisan_tpu.bridge.client import PortClient
+        with PortClient() as pc:
+            assert pc.call((Atom("members"), 0)) == \
+                (Atom("error"), Atom("not_started"))
+            assert pc.call((Atom("start"), Atom("nope"), [])) == \
+                (Atom("error"), Atom("unknown_manager"))
+            assert pc.call(Atom("garbage")) == \
+                (Atom("error"), Atom("badarg"))

@@ -1,0 +1,142 @@
+"""Channel / parallelism / partition-key / monotonic-channel tests — the
+`with_channels`, `with_monotonic_channels`, `with_parallelism` and
+`with_partition_key` suite groups (test/partisan_SUITE.erl:121-308) as
+engine-level assertions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.engine import ProtocolBase
+from partisan_tpu.ops import msg as msgops
+
+
+def mk(n=4, cap=16, **fields):
+    """Build a small Msgs buffer from dense lists."""
+    spec = {"partition_key": ((), jnp.int32)}
+    m = msgops.empty(cap, spec)
+    k = len(fields.get("dst", []))
+    for name, vals in fields.items():
+        arr = jnp.asarray(vals, jnp.int32)
+        if name == "partition_key":
+            m.data["partition_key"] = m.data["partition_key"].at[:k].set(arr)
+        else:
+            m = m.replace(**{name: getattr(m, name).at[:k].set(
+                arr.astype(getattr(m, name).dtype))})
+    m = m.replace(valid=m.valid.at[:k].set(True))
+    return m
+
+
+class TestDispatch:
+    def test_partition_key_is_deterministic_lane(self):
+        """Same partition key -> same lane, key mod parallelism
+        (partisan_util.erl:190-195)."""
+        m = mk(dst=[1, 1, 1, 1], src=[0, 0, 0, 0],
+               partition_key=[7, 7, 3, 3])
+        out = msgops.dispatch(m, 4, m.data["partition_key"],
+                              salt=jnp.uint32(9))
+        lanes = np.asarray(out.lane[:4])
+        assert lanes[0] == lanes[1] == 7 % 4
+        assert lanes[2] == lanes[3] == 3 % 4
+
+    def test_unkeyed_messages_spread(self):
+        m = mk(dst=[1] * 4, src=[0] * 4, partition_key=[-1] * 4)
+        m = m.replace(valid=jnp.ones_like(m.valid))  # all 16 slots
+        out = msgops.dispatch(m, 4, m.data["partition_key"],
+                              salt=jnp.uint32(1))
+        lanes = np.asarray(out.lane)
+        assert len(set(lanes.tolist())) > 1, "random dispatch never spread"
+        assert (lanes >= 0).all() and (lanes < 4).all()
+
+
+class TestConnectionFifo:
+    def test_fifo_within_connection(self):
+        """Messages on ONE connection (same src/dst/channel/lane) must land
+        in the inbox in emission order regardless of the round key — TCP
+        FIFO (SURVEY §2.11)."""
+        for salt in range(5):
+            m = mk(dst=[2] * 6, src=[1] * 6,
+                   partition_key=[0] * 6)
+            m.data["partition_key"] = m.data["partition_key"].at[:6].set(
+                jnp.arange(6))  # payload proxy: use pk field to tag order
+            inbox, _, _ = msgops.build_inbox(
+                m, 4, 8, key=jax.random.PRNGKey(salt))
+            got = np.asarray(inbox.data["partition_key"][2])
+            vals = got[np.asarray(inbox.valid[2])]
+            assert list(vals) == sorted(vals), f"FIFO violated: {vals}"
+
+    def test_cross_connection_interleaving_varies(self):
+        """Across connections the interleave must depend on the key (the
+        nondeterminism the trace orchestrator tames)."""
+        m = mk(dst=[2] * 6, src=[0, 1, 0, 1, 0, 1],
+               partition_key=list(range(6)))
+        orders = set()
+        for salt in range(8):
+            inbox, _, _ = msgops.build_inbox(
+                m, 4, 8, key=jax.random.PRNGKey(salt))
+            got = tuple(np.asarray(inbox.data["partition_key"][2])[
+                np.asarray(inbox.valid[2])].tolist())
+            orders.add(got)
+        assert len(orders) > 1, "delivery order never varied across keys"
+
+
+class TestMonotonic:
+    def test_keep_latest_per_connection(self):
+        """Three messages on a monotonic channel + one on a regular channel:
+        only the LAST monotonic one and the regular one survive
+        (send-elision, partisan_peer_connection.erl:82-100)."""
+        m = mk(dst=[2, 2, 2, 2], src=[1, 1, 1, 1], channel=[1, 1, 1, 0],
+               partition_key=[10, 11, 12, 13])
+        mono = jnp.asarray([False, True])
+        out = msgops.monotonic_elide(m, 4, mono, n_channels=2)
+        valid = np.asarray(out.valid[:4])
+        assert list(valid) == [False, False, True, True]
+
+    def test_distinct_senders_not_elided(self):
+        """Monotonic elision is per connection, not per destination."""
+        m = mk(dst=[2, 2], src=[0, 1], channel=[1, 1],
+               partition_key=[5, 6])
+        mono = jnp.asarray([False, True])
+        out = msgops.monotonic_elide(m, 4, mono, n_channels=2)
+        assert list(np.asarray(out.valid[:2])) == [True, True]
+
+
+class ChattyProto(ProtocolBase):
+    """Emits `burst` messages per tick on the monotonic channel; counts
+    deliveries — end-to-end check that the engine applies elision."""
+    msg_types = ("chat",)
+
+    def __init__(self, cfg, burst=3):
+        self.cfg = cfg
+        self.burst = burst
+        self.data_spec = {"n": ((), jnp.int32)}
+        self.emit_cap = 1
+        self.tick_emit_cap = burst
+
+    def init(self, cfg, key):
+        return {"got": jnp.zeros((cfg.n_nodes,), jnp.int32)}
+
+    def handle_chat(self, cfg, me, row, m, key):
+        return {"got": row["got"] + 1}, self.no_emit()
+
+    def tick(self, cfg, me, row, rnd, key):
+        dst = (me + 1) % cfg.n_nodes
+        only0 = jnp.where(me == 0, dst, -1)
+        return row, self.emit(
+            jnp.full((self.burst,), 1, jnp.int32) * 0 + only0,
+            self.typ("chat"), cap=self.burst, channel=1,
+            n=jnp.arange(self.burst))
+
+
+def test_engine_monotonic_end_to_end():
+    cfg = pt.Config(n_nodes=2, inbox_cap=8,
+                    channels=("undefined", "mono"),
+                    monotonic_channels=("mono",))
+    proto = ChattyProto(cfg, burst=3)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    for _ in range(4):
+        world, _ = step(world)
+    # 3 rounds of arrivals so far (1-round lag); one survivor per burst
+    assert int(world.state["got"][1]) == 3

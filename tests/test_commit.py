@@ -1,0 +1,128 @@
+"""Commit-protocol workload tests (protocols/lampson_2pc.erl,
+bernstein_ctp.erl, skeen_3pc.erl, alsberg_day.erl rebuilt) — happy paths,
+timeout-abort paths, and the termination sub-protocols under targeted
+omission faults."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.peer_service import send_ctl
+from partisan_tpu.models.commit import (
+    ABORTING, COMMITTING, DONE, P_ABORTED, P_COMMITTED, P_PREPARED,
+    AlsbergDay, BernsteinCTP, Skeen3PC, TwoPhaseCommit)
+from partisan_tpu.ops import msg as msgops
+from partisan_tpu.verify import faults
+
+
+def boot(proto_cls, n=4, interpose=None, **kw):
+    cfg = pt.Config(n_nodes=n, inbox_cap=2 * n)
+    proto = proto_cls(cfg, **kw)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False, interpose_send=interpose)
+    return cfg, proto, world, step
+
+
+class TestTwoPhaseCommit:
+    def test_commit_happy_path(self):
+        cfg, proto, world, step = boot(TwoPhaseCommit)
+        world = send_ctl(world, proto, 0, "ctl_broadcast", value=42)
+        for _ in range(12):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.delivered) == 42).all()
+        assert (np.asarray(st.p_status) == P_COMMITTED).all()
+        assert int(st.c_status[0]) == DONE
+
+    def test_timeout_aborts(self):
+        """All `prepared` votes dropped -> coordinator_timeout -> abort
+        everywhere (lampson_2pc :189-220)."""
+        cfg, proto, world, step = boot(
+            TwoPhaseCommit,
+            interpose=faults.send_omission(typ=1))  # typ 1 = prepared
+        assert proto.typ("prepared") == 1
+        world = send_ctl(world, proto, 0, "ctl_broadcast", value=42)
+        for _ in range(20):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.delivered) == -1).all()
+        assert (np.asarray(st.p_status) == P_ABORTED).all()
+        assert int(st.c_status[0]) == DONE
+
+    def test_dropped_commit_blocks_2pc(self):
+        """Dropping the commit to one participant leaves it PREPARED forever
+        — the blocking weakness 3PC/CTP exist to fix."""
+        cfg, proto, world, step = boot(
+            TwoPhaseCommit,
+            interpose=faults.send_omission(dst=2, typ=proto_typ_commit()))
+        world = send_ctl(world, proto, 0, "ctl_broadcast", value=7)
+        for _ in range(24):
+            world, _ = step(world)
+        st = world.state
+        assert int(st.p_status[2]) == P_PREPARED      # blocked
+        assert int(st.delivered[2]) == -1
+        others = [i for i in range(4) if i != 2]
+        assert (np.asarray(st.p_status)[others] == P_COMMITTED).all()
+
+
+def proto_typ_commit():
+    return TwoPhaseCommit.msg_types.index("commit")
+
+
+class TestBernsteinCTP:
+    def test_cooperative_termination(self):
+        """Same dropped-commit fault: the participant_timeout fires a
+        decision_request and the node adopts the committed decision from a
+        peer (bernstein_ctp :222-278)."""
+        cfg, proto, world, step = boot(
+            BernsteinCTP, interpose=faults.send_omission(
+                dst=2, typ=BernsteinCTP.msg_types.index("commit")))
+        world = send_ctl(world, proto, 0, "ctl_broadcast", value=7)
+        for _ in range(32):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.p_status) == P_COMMITTED).all()
+        assert (np.asarray(st.delivered) == 7).all()
+
+
+class TestSkeen3PC:
+    def test_happy_path(self):
+        cfg, proto, world, step = boot(Skeen3PC)
+        world = send_ctl(world, proto, 0, "ctl_broadcast", value=9)
+        for _ in range(16):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.delivered) == 9).all()
+
+    def test_nonblocking_commit_after_precommit(self):
+        """Every `commit` dropped: all participants reached PRECOMMIT, so
+        the participant_timeout commits unilaterally (skeen_3pc :165-195)."""
+        cfg, proto, world, step = boot(
+            Skeen3PC, interpose=faults.send_omission(
+                typ=Skeen3PC.msg_types.index("commit")))
+        world = send_ctl(world, proto, 0, "ctl_broadcast", value=9)
+        for _ in range(32):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.p_status) == P_COMMITTED).all()
+        assert (np.asarray(st.delivered) == 9).all()
+
+
+class TestAlsbergDay:
+    def test_replicated_write(self):
+        cfg, proto, world, step = boot(AlsbergDay)
+        world = send_ctl(world, proto, 2, "ctl_write", wkey=1, value=77)
+        for _ in range(10):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.store)[:, 1] == 77).all()   # all replicas
+        assert int(st.client_acked[2]) == 1               # client confirmed
+
+    def test_write_from_primary(self):
+        cfg, proto, world, step = boot(AlsbergDay)
+        world = send_ctl(world, proto, 0, "ctl_write", wkey=0, value=5)
+        for _ in range(10):
+            world, _ = step(world)
+        st = world.state
+        assert (np.asarray(st.store)[:, 0] == 5).all()
+        assert int(st.client_acked[0]) == 1

@@ -1,0 +1,78 @@
+"""Static + client/server manager tests (the reference's
+client_server_manager_* and static-membership cases,
+test/partisan_SUITE.erl groups; admission rule client_server :500-523)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.models.managers import (
+    CLIENT, SERVER, ClientServerManager, StaticManager)
+
+
+def run(proto_cls, n, pairs, rounds=8, **kw):
+    cfg = pt.Config(n_nodes=n, inbox_cap=8)
+    proto = proto_cls(cfg, **kw)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = peer_service.cluster(world, proto, pairs)
+    for _ in range(rounds):
+        world, _ = step(world)
+    return cfg, proto, world, step
+
+
+def members_of(world, proto, i):
+    return set(np.flatnonzero(
+        np.asarray(peer_service.members(world, proto, i))).tolist())
+
+
+class TestStatic:
+    def test_join_is_mutual_no_gossip(self):
+        cfg, proto, world, _ = run(StaticManager, 4, [(1, 0), (2, 0)])
+        assert members_of(world, proto, 1) == {0}
+        assert members_of(world, proto, 0) == {1, 2}
+        # no gossip: 1 never learns about 2 (static membership)
+        assert 2 not in members_of(world, proto, 1)
+
+    def test_leave_notifies_members(self):
+        cfg, proto, world, step = run(StaticManager, 4, [(1, 0), (2, 0)])
+        world = peer_service.leave(world, proto, 1)
+        for _ in range(4):
+            world, _ = step(world)
+        assert members_of(world, proto, 0) == {2}
+        assert members_of(world, proto, 1) == set()
+
+
+class TestClientServer:
+    def test_star_topology(self):
+        """2 servers + 4 clients, everyone joins server 0: servers link to
+        everyone, clients only to servers."""
+        n = 6
+        pairs = [(i, 0) for i in range(1, n)]
+        cfg, proto, world, _ = run(ClientServerManager, n, pairs,
+                                   n_servers=2)
+        assert members_of(world, proto, 0) == {1, 2, 3, 4, 5}
+        assert members_of(world, proto, 1) == {0}   # server accepted
+        for c in range(2, n):
+            assert members_of(world, proto, c) == {0}
+
+    def test_client_join_client_refused(self):
+        """accept_join_with_tag(client, client) = false (:511-513)."""
+        cfg, proto, world, _ = run(ClientServerManager, 4,
+                                   [(2, 3)], n_servers=1)
+        assert members_of(world, proto, 2) == set()
+        assert members_of(world, proto, 3) == set()
+
+    def test_server_join_server_accepted(self):
+        cfg, proto, world, _ = run(ClientServerManager, 4,
+                                   [(1, 0)], n_servers=2)
+        assert members_of(world, proto, 1) == {0}
+        assert members_of(world, proto, 0) == {1}
+
+    def test_tags(self):
+        cfg = pt.Config(n_nodes=4)
+        proto = ClientServerManager(cfg, n_servers=2)
+        tags = np.asarray(proto.init_tags(cfg))
+        assert (tags == [SERVER, SERVER, CLIENT, CLIENT]).all()

@@ -1,0 +1,102 @@
+"""OTP-layer tests — the otp_test of the reference suite
+(test/partisan_SUITE.erl:1261) against the gen_server call/cast/monitor
+rebuild (partisan_gen.erl:156-186, partisan_gen_server.erl,
+partisan_monitor.erl)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.peer_service import send_ctl
+from partisan_tpu.ops import msg as msgops
+from partisan_tpu.otp import KvServer
+from partisan_tpu.verify import faults
+
+
+def boot(n=4):
+    cfg = pt.Config(n_nodes=n, inbox_cap=8)
+    proto = KvServer(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    return cfg, proto, world, step
+
+
+def put_req(key, value):
+    return jnp.asarray([1, (key << 8) | value], jnp.int32)
+
+
+def get_req(key):
+    return jnp.asarray([0, key], jnp.int32)
+
+
+class TestGenServer:
+    def test_call_put_then_get(self):
+        cfg, proto, world, step = boot()
+        world = send_ctl(world, proto, 1, "ctl_call", peer=3,
+                         req=put_req(2, 9), timeout=0)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.server[3][2]) == 9     # server applied
+        assert bool(world.state.call_done[1][0])      # reply arrived
+        assert int(world.state.call_reply[1][0][1]) == 9
+        # follow-up get from another node
+        world = send_ctl(world, proto, 2, "ctl_call", peer=3,
+                         req=get_req(2), timeout=0)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.call_reply[2][0][1]) == 9
+
+    def test_cast_is_fire_and_forget(self):
+        cfg, proto, world, step = boot()
+        world = send_ctl(world, proto, 0, "ctl_cast", peer=2,
+                         req=put_req(1, 5))
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.server[2][1]) == 5
+        assert not np.asarray(world.state.call_done[0]).any()
+
+    def test_call_timeout(self):
+        """Call to a crashed node times out (partisan_gen: no monitors,
+        timeout -> exit; here the timed_out flag)."""
+        cfg, proto, world, step = boot()
+        world = faults.crash(world, [3])
+        world = send_ctl(world, proto, 1, "ctl_call", peer=3,
+                         req=get_req(0), timeout=5)
+        for _ in range(10):
+            world, _ = step(world)
+        assert bool(world.state.timed_out[1][0])
+        assert not bool(world.state.call_done[1][0])
+
+    def test_late_reply_after_timeout_ignored(self):
+        """A reply landing after the timeout fired must not mark the call
+        done (the selective-receive drops stale {Ref, Reply})."""
+        cfg, proto, world, step = boot()
+        # delay every reply by 6 rounds; timeout at 3
+        interp = faults.message_delay(6, typ=proto.typ("reply"))
+        step = pt.make_step(cfg, proto, donate=False, interpose_send=interp)
+        world = send_ctl(world, proto, 1, "ctl_call", peer=3,
+                         req=get_req(0), timeout=3)
+        for _ in range(14):
+            world, _ = step(world)
+        assert bool(world.state.timed_out[1][0])
+        assert not bool(world.state.call_done[1][0])
+
+
+class TestMonitor:
+    def test_down_on_crash(self):
+        cfg, proto, world, step = boot()
+        world = send_ctl(world, proto, 0, "ctl_monitor", peer=2)
+        for _ in range(6):
+            world, _ = step(world)
+        assert not bool(world.state.down[0][0])   # alive: heartbeats flow
+        world = faults.crash(world, [2])
+        for _ in range(12):
+            world, _ = step(world)
+        assert bool(world.state.down[0][0])       # silence -> DOWN
+
+    def test_no_down_while_alive(self):
+        cfg, proto, world, step = boot()
+        world = send_ctl(world, proto, 0, "ctl_monitor", peer=2)
+        for _ in range(20):
+            world, _ = step(world)
+        assert not bool(world.state.down[0][0])

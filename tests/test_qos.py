@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import partisan_tpu as pt
+from partisan_tpu.peer_service import send_ctl
 from partisan_tpu import peer_service
 from partisan_tpu.ops import msg as msgops
 from partisan_tpu.qos import vclock
@@ -44,13 +45,6 @@ class TestVClock:
 
 
 # ---------------------------------------------------------------- helpers
-
-def send_ctl(world, proto, node, typ_name, **data):
-    em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
-                    cap=1, **data)
-    msgs, _ = msgops.inject(world.msgs, em, src=node)
-    return world.replace(msgs=msgs)
-
 
 # ---------------------------------------------------------------- causal
 

@@ -84,24 +84,29 @@ class TestV2Specifics:
         assert bool(graph.is_connected(sym, jnp.asarray(alive)))
 
     def test_isolation_resubscribe(self):
-        """An isolated node (empty views, silent peers) re-subscribes after
-        the silence window (scamp_v2 :130-178)."""
+        """A node whose IN-degree silently vanished (nobody pings it any
+        more) detects the silence and re-subscribes through its own partial
+        view (scamp_v2 :130-178).  The in-flight buffer is cleared so no
+        stale walk can mask the resubscription path."""
         n = 8
         cfg, proto, world, step = boot(
             ScampV2, n, 30, cfg_kw={"scamp_message_window": 2})
-        # force-isolate node 3: wipe its views and every reference to it
         st = world.state
-        part = jnp.where(jnp.arange(n)[:, None] == 3, -1, st.partial)
-        part = jnp.where(part == 3, -1, part)
-        world = world.replace(state=st.replace(
-            partial=part,
-            in_view=jnp.where(jnp.arange(n)[:, None] == 3, -1, st.in_view)))
-        for _ in range(cfg.periodic_interval * cfg.scamp_message_window + 40):
+        # erase node 3 from every OTHER node's views (in-degree 0: no
+        # pings will reach it) but keep its own outgoing partial view
+        part = jnp.where(st.partial == 3, -1, st.partial)
+        part = part.at[3].set(st.partial[3])
+        world = world.replace(
+            state=st.replace(
+                partial=part,
+                in_view=jnp.where(st.in_view == 3, -1, st.in_view)),
+            msgs=jax.tree_util.tree_map(jnp.zeros_like, world.msgs))
+        assert int((np.asarray(world.state.partial[3]) >= 0).sum()) > 0
+        for _ in range(cfg.periodic_interval * cfg.scamp_message_window + 60):
             world, _ = step(world)
+        # someone kept node 3's re-subscription: in-degree restored
         adj = graph.adjacency_from_views(world.state.partial, n)
-        sym = adj | adj.T
-        assert bool(sym[3].any() or sym[:, 3].any()), \
-            "isolated node never re-subscribed"
+        assert bool(adj[:, 3].any()), "isolated node never re-subscribed"
 
 
 def test_reference_coin_compat_flag():

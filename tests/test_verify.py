@@ -1,0 +1,144 @@
+"""Verification-harness tests: interposition registry, fault models,
+trace record/replay (partisan_trace_orchestrator analog) and the
+omission-schedule model checker (filibuster_SUITE analog)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.peer_service import send_ctl
+from partisan_tpu.models.commit import (
+    P_ABORTED, P_COMMITTED, TwoPhaseCommit)
+from partisan_tpu.models.demers import DirectMail
+from partisan_tpu.ops import msg as msgops
+from partisan_tpu.qos.ack import AckedDelivery
+from partisan_tpu.verify import Interposition, TraceRecorder, faults
+from partisan_tpu.verify.model_checker import ModelChecker
+from partisan_tpu.verify.trace import read_trace, write_trace
+
+
+class TestInterposition:
+    def test_compose_and_remove(self):
+        interp = Interposition()
+        interp.add_send("a", faults.send_omission(typ=0))
+        interp.add_send("b", faults.message_delay(2, typ=1))
+        hooks = interp.hooks()
+        assert hooks["interpose_send"] is not None
+        assert hooks["interpose_recv"] is None
+        interp.remove_send("a").remove_send("b")
+        assert interp.hooks()["interpose_send"] is None
+
+    def test_engine_integration(self):
+        """A named drop fun installed via the registry suppresses delivery
+        (interposition returning undefined, crash_fault_model :116-128)."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = AckedDelivery(cfg)
+        interp = Interposition().add_send(
+            "drop-app", faults.send_omission(typ=proto.typ("app")))
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False, **interp.hooks())
+        world = send_ctl(world, proto, 0, "ctl_send", peer=2, payload=1)
+        for _ in range(6):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) == 0
+
+
+class TestWorldFaults:
+    def test_partition_heals_with_retransmit(self):
+        """Cross-partition messages drop (hyparview partition semantics
+        :1731-1797); once resolved, the ack backend's retransmit delivers."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=2)
+        proto = AckedDelivery(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = faults.inject_partition(world, [[0, 1], [2, 3]])
+        world = send_ctl(world, proto, 0, "ctl_send", peer=2, payload=1)
+        for _ in range(6):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) == 0
+        world = faults.resolve_partition(world)
+        for _ in range(8):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) >= 1
+
+    def test_crash_and_recover(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=2)
+        proto = AckedDelivery(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = faults.crash(world, [2])
+        world = send_ctl(world, proto, 0, "ctl_send", peer=2, payload=1)
+        for _ in range(6):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) == 0
+        world = faults.recover(world, [2])
+        for _ in range(8):
+            world, _ = step(world)
+        assert int(world.state.seen[2][0]) >= 1
+
+
+class TestTrace:
+    def test_record_and_roundtrip(self, tmp_path):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = DirectMail(cfg)
+        rec = TraceRecorder(cfg, proto)
+        world = pt.init_world(cfg, proto)
+        world = send_ctl(world, proto, 0, "ctl_broadcast", rumor=1)
+        rec.run(world, 4)
+        assert rec.entries, "nothing recorded"
+        mails = [e for e in rec.entries if e.typ == proto.typ("mail")]
+        assert len(mails) == 3  # node 0 mailed everyone else
+        p = os.path.join(tmp_path, "t.trace")
+        write_trace(p, rec.entries)
+        back = read_trace(p)
+        assert back == rec.entries
+
+    def test_replay_determinism(self):
+        """Same config => identical trace (the REPLAY=true guarantee for
+        free, SURVEY §5.2)."""
+        def record():
+            cfg = pt.Config(n_nodes=4, inbox_cap=8)
+            proto = TwoPhaseCommit(cfg)
+            rec = TraceRecorder(cfg, proto)
+            world = pt.init_world(cfg, proto)
+            world = send_ctl(world, proto, 0, "ctl_broadcast", value=3)
+            rec.run(world, 10)
+            return rec.entries
+        assert record() == record()
+
+
+def agreement_and_termination(world) -> bool:
+    """2PC invariant: every participant decided, and no mixed decisions."""
+    status = np.asarray(world.state.p_status)
+    decided = ((status == P_COMMITTED) | (status == P_ABORTED)).all()
+    mixed = (status == P_COMMITTED).any() and (status == P_ABORTED).any()
+    return bool(decided and not mixed)
+
+
+class TestModelChecker:
+    def test_finds_2pc_blocking_schedules(self):
+        """Single-omission sweep over lampson_2pc protocol messages: the
+        checker must find exactly the three blocked-participant schedules
+        (drop `commit` to one node) and pass the rest — our pinned analog
+        of the reference CI's 'lampson_2pc: Passed: 7, Failed: 1'
+        (Makefile:105-106)."""
+        n = 3
+        cfg = pt.Config(n_nodes=n, inbox_cap=2 * n)
+        proto = TwoPhaseCommit(cfg)
+
+        def setup(world):
+            return send_ctl(world, proto, 0, "ctl_broadcast", value=5)
+
+        mc = ModelChecker(cfg, proto, setup, agreement_and_termination,
+                          n_rounds=24)
+        protocol_typs = [proto.typ(t) for t in
+                         ("prepare", "prepared", "commit", "commit_ack")]
+        res = mc.check(candidate_typs=protocol_typs, max_drops=1)
+        assert res.golden.invariant_ok
+        commit_t = proto.typ("commit")
+        failing_typs = {k[3] for (k,) in res.failures}
+        assert failing_typs == {commit_t}, res.failures
+        assert res.failed == n          # one blocked participant per dst
+        assert res.passed == 3 * n      # prepare/prepared/ack drops recover
