@@ -5,11 +5,18 @@ churn.  Target (BASELINE.json): >= 10^6 nodes at >= 1000 gossip rounds/sec on
 TPU v5e-8; this harness runs on whatever jax.devices() offers (the driver
 gives one v5e chip) and reports rounds/sec, with vs_baseline = value / 1000.
 
+The kernel is the shift-rendezvous fast path (models/demers.py: push
+delivery as jnp.roll — streaming HBM-bound rounds instead of serialized
+2M-index scatters).  Each timed trial uses a DIFFERENT initial world: the
+TPU tunnel caches identical (executable, input) executions, so re-timing
+the warmup input reports dispatch latency, not execution.
+
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
 import json
+import statistics
 import sys
 import time
 
@@ -24,19 +31,26 @@ def main() -> None:
     churn = 0.01
     fanout = 2
     rounds = 1000
+    trials = 5
 
-    w = rumor_init(n)
-    # warmup / compile
-    w1 = rumor_run(w, 10, n, fanout, 1, churn)
-    jax.block_until_ready(w1)
-
-    t0 = time.perf_counter()
-    out = rumor_run(w, rounds, n, fanout, 1, churn)
+    # compile with the SAME static round count (a different count would
+    # leave the timed call paying a fresh scan compile)
+    out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn)
     jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
 
-    rps = rounds / dt
-    infected = float(jnp.mean(out.infected))
+    rates = []
+    infected = 0.0
+    for t in range(trials):
+        # distinct, unlikely-reused patient-zero rows so no trial can hit
+        # a stale tunnel cache entry from an earlier process
+        w = rumor_init(n, patient_zero=(7919 * (t + 1)) % n)
+        t0 = time.perf_counter()
+        out = rumor_run(w, rounds, n, fanout, 1, churn)
+        jax.block_until_ready(out)
+        rates.append(rounds / (time.perf_counter() - t0))
+        infected = float(jnp.mean(out.infected))
+
+    rps = statistics.median(rates)
     result = {
         "metric": f"rumor_mongering rounds/sec @ N=1e6, churn={churn}",
         "value": round(rps, 1),
@@ -44,7 +58,8 @@ def main() -> None:
         "vs_baseline": round(rps / 1000.0, 3),
     }
     print(json.dumps(result))
-    print(f"# infected fraction after {rounds} rounds: {infected:.3f}; "
+    print(f"# trials={['%.0f' % r for r in rates]}, infected fraction after "
+          f"{rounds} rounds: {infected:.3f}; "
           f"device={jax.devices()[0].platform}", file=sys.stderr)
 
 

@@ -77,3 +77,21 @@ class TestRumorFastPath:
         b = rumor_run(rumor_init(n), 30, n, 2, 1, 0.01)
         np.testing.assert_array_equal(np.asarray(a.infected),
                                       np.asarray(b.infected))
+
+    def test_variant_parity(self):
+        """The shift-rendezvous fast path must match the exact-uniform
+        transcription on epidemic macro-dynamics: coverage without churn
+        and the endemic equilibrium under churn (models/demers.py
+        make_rumor_step docstring)."""
+        n = 4096
+        for kw, lo, hi in ((dict(fanout=2, stop_k=4, churn=0.0), 0.95, 1.01),
+                           (dict(fanout=2, stop_k=1, churn=0.01), 0.01, 1.0)):
+            u = rumor_run(rumor_init(n), 150, n, kw["fanout"],
+                          kw["stop_k"], kw["churn"], "uniform")
+            s = rumor_run(rumor_init(n), 150, n, kw["fanout"],
+                          kw["stop_k"], kw["churn"], "shift")
+            fu = float(u.infected.mean())
+            fs = float(s.infected.mean())
+            assert lo <= fu <= hi and lo <= fs <= hi, (fu, fs)
+            assert abs(fu - fs) < 0.25, \
+                f"variant dynamics diverged: uniform={fu} shift={fs}"
