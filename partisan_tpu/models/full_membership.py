@@ -33,6 +33,10 @@ from ..ops.msg import Msgs
 class FullState:
     adds: jax.Array   # [N, W] uint32 — grow-only add set
     rems: jax.Array   # [N, W] uint32 — grow-only remove set
+    left: jax.Array   # [N] bool — self-evicted, inert (the {stop, normal}
+                      # shutdown when a node sees itself removed,
+                      # pluggable :1170-1188); rejoining needs a fresh id
+                      # (2P-set semantics, see module docstring)
 
 
 class FullMembership(ProtocolBase):
@@ -74,21 +78,30 @@ class FullMembership(ProtocolBase):
         n, w = cfg.n_nodes, self.W
         me = jnp.arange(n)
         adds = jax.vmap(lambda i: bitset.add(jnp.zeros((w,), jnp.uint32), i))(me)
-        return FullState(adds=adds, rems=jnp.zeros((n, w), jnp.uint32))
+        return FullState(adds=adds, rems=jnp.zeros((n, w), jnp.uint32),
+                         left=jnp.zeros((n,), bool))
 
     def tick(self, cfg, node_id, row, rnd, key):
-        do = (rnd % cfg.periodic_interval) == 0
+        do = ((rnd % cfg.periodic_interval) == 0) & ~row.left
         em = self._gossip_all(row, node_id)
         return row, em.replace(valid=em.valid & do)
 
     def handle_gossip(self, cfg, node_id, row, m, key):
+        # the reference's convergence test is INEQUALITY of the incoming
+        # and local states, not "did my state change" (full :99-116):
+        # a node holding strictly more knowledge than the sender must
+        # re-gossip so the SENDER converges too
+        unequal = jnp.any((m.data["adds"] != row.adds)
+                          | (m.data["rems"] != row.rems))
         adds = row.adds | m.data["adds"]
         rems = row.rems | m.data["rems"]
-        changed = jnp.any((adds != row.adds) | (rems != row.rems))
-        row = row.replace(adds=adds, rems=rems)
+        # seeing myself removed is the self-eviction shutdown
+        # (pluggable :1170-1188): go inert
+        evicted = bitset.contains(rems, node_id)
+        row = row.replace(adds=adds, rems=rems, left=row.left | evicted)
         em = self._gossip_all(row, node_id)
-        # equal state -> convergence, stop re-gossiping (full :99-116)
-        return row, em.replace(valid=em.valid & changed)
+        # a left node is stopped in the reference; it cannot answer
+        return row, em.replace(valid=em.valid & unequal & ~row.left)
 
     def handle_ctl_join(self, cfg, node_id, row, m, key):
         """Control-plane join(peer): merge peer into my view and push my full
@@ -100,7 +113,14 @@ class FullMembership(ProtocolBase):
                               adds=row.adds, rems=row.rems)
 
     def handle_ctl_leave(self, cfg, node_id, row, m, key):
-        """leave(target): rmv mutation gossiped to everyone (full :58-89)."""
+        """leave(target): rmv mutation gossiped to the PRE-removal member
+        list — the reference gossips to MembershipList0, which still
+        includes the target, so the removed node learns of its own
+        eviction (full :58-89).  Self-leave goes inert after this final
+        gossip."""
         target = m.data["peer"]
-        row = row.replace(rems=bitset.add(row.rems, target))
-        return row, self._gossip_all(row, node_id)
+        peers_before = self._peers(row, node_id)
+        row = row.replace(rems=bitset.add(row.rems, target),
+                          left=row.left | (target == node_id))
+        return row, self.emit(peers_before, self.typ("gossip"),
+                              adds=row.adds, rems=row.rems)

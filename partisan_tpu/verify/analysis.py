@@ -1,0 +1,159 @@
+"""Protocol causality analysis — the TPU analog of
+``src/partisan_analysis.erl`` (1237 LoC of Core-Erlang static analysis
+computing which message sends each receive can cause, feeding the
+``analysis/partisan-causality-<mod>`` + ``annotations/…`` files the model
+checker prunes with).
+
+Static analysis of traced-and-compiled JAX has no cerl equivalent, so the
+rebuild infers the same relation *dynamically*: every handler is executed
+(vmapped) over randomized state rows and message payloads, and the types
+observed among its valid emissions form the causality edge set.  Sampling
+makes this an under-approximation of rare branches (more samples tighten
+it) and the random payloads an over-approximation of unreachable ones —
+the same soundness trade the reference's annotations make in practice
+(its README calls the annotations hand-checked).
+
+Output shape mirrors the reference's annotation files: a JSON map
+``{type: [caused types]}`` with the pseudo-sources ``__tick__`` (timer
+emissions, the analog of the reference's periodic sends).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import msg as msgops
+from .. import prng
+
+
+def _random_msgs(proto: ProtocolBase, cfg: Config, typ: int, samples: int,
+                 key: jax.Array) -> msgops.Msgs:
+    """A batch of plausible single messages of one type: node ids in
+    [-1, N), payload fields uniform over a small id-flavored range."""
+    n = cfg.n_nodes
+    m = msgops.empty(samples, proto.data_spec)
+    keys = jax.random.split(key, 2 + len(m.data))
+    m = m.replace(
+        valid=jnp.ones((samples,), bool),
+        src=jax.random.randint(keys[0], (samples,), 0, n),
+        dst=jax.random.randint(keys[1], (samples,), 0, n),
+        typ=jnp.full((samples,), typ, jnp.int32),
+    )
+    for i, name in enumerate(sorted(m.data)):
+        f = m.data[name]
+        m.data[name] = jax.random.randint(
+            keys[2 + i], f.shape, -1, max(n, cfg.arwl + 2)
+        ).astype(f.dtype)
+    return m
+
+
+def infer_causality(cfg: Config, proto: ProtocolBase,
+                    samples: int = 256, seed: int = 0,
+                    rounds_of_state: int = 0) -> Dict[str, List[str]]:
+    """{message type: sorted list of types its handler can emit}.
+
+    ``rounds_of_state`` > 0 seeds the sampled state rows from a briefly
+    simulated world instead of ``proto.init`` (some emissions only occur
+    from populated views)."""
+    key = jax.random.PRNGKey(seed)
+    state = proto.init(cfg, key)
+    if rounds_of_state:
+        from ..engine import init_world, make_step
+        w = init_world(cfg, proto)
+        step = make_step(cfg, proto, donate=False)
+        for _ in range(rounds_of_state):
+            w, _ = step(w)
+        state = w.state
+
+    n = cfg.n_nodes
+
+    def randomize_row(row, k):
+        """Fuzz a state row: guarded branches (e.g. 'all votes in ->
+        commit', reachable only from specific states) need state sampling,
+        not just payload sampling.  Bools lean True so conjunctive guards
+        ('all prepared') have real mass."""
+        leaves, treedef = jax.tree_util.tree_flatten(row)
+        keys = jax.random.split(k, len(leaves))
+        out = []
+        for leaf, lk in zip(leaves, keys):
+            if leaf.dtype == jnp.bool_:
+                out.append(jax.random.bernoulli(lk, 0.7, leaf.shape))
+            elif jnp.issubdtype(leaf.dtype, jnp.integer):
+                out.append(jax.random.randint(
+                    lk, leaf.shape, -1, max(n, 8)).astype(leaf.dtype))
+            else:
+                out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    out: Dict[str, List[str]] = {}
+    handlers = proto.handlers()
+    for t, name in enumerate(proto.msg_types):
+        hkey = jax.random.fold_in(key, t)
+        m = _random_msgs(proto, cfg, t, samples, hkey)
+        me = jax.random.randint(jax.random.fold_in(hkey, 1),
+                                (samples,), 0, n)
+
+        def run_one(j, i, mi, k):
+            row = jax.tree_util.tree_map(lambda x: x[i % n], state)
+            # half the samples run on fuzzed state rows
+            row = jax.lax.cond(
+                j % 2 == 0, lambda r: r,
+                lambda r: randomize_row(r, jax.random.fold_in(k, 99)), row)
+            _, em = handlers[t](cfg, i, row, mi, k)
+            return em
+
+        keys = jax.random.split(jax.random.fold_in(hkey, 2), samples)
+        ems = jax.vmap(run_one)(jnp.arange(samples), me, m, keys)
+        valid = np.asarray(ems.valid)
+        typs = np.asarray(ems.typ)
+        caused: Set[str] = set()
+        for ti in np.unique(typs[valid]):
+            caused.add(proto.msg_types[int(ti)])
+        out[name] = sorted(caused)
+
+    # timer emissions (the periodic/tick pseudo-source)
+    me = jnp.arange(min(samples, n), dtype=jnp.int32)
+    tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(
+        jax.random.split(key, me.shape[0]), 7)
+    rows = jax.tree_util.tree_map(lambda x: x[me % n], state)
+    _, tems = jax.vmap(
+        lambda i, r, k: proto.tick(cfg, i, r, jnp.int32(0), k)
+    )(me, rows, tkeys)
+    tvalid = np.asarray(tems.valid)
+    ttyps = np.asarray(tems.typ)
+    out["__tick__"] = sorted({proto.msg_types[int(t)]
+                              for t in np.unique(ttyps[tvalid])})
+    return out
+
+
+def write_annotations(path: str, causality: Dict[str, List[str]]) -> None:
+    """The annotations/partisan-annotations-<mod> analog (JSON)."""
+    with open(path, "w") as f:
+        json.dump(causality, f, indent=2, sort_keys=True)
+
+
+def read_annotations(path: str) -> Dict[str, List[str]]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def reachable_types(causality: Dict[str, List[str]],
+                    roots: List[str]) -> Set[str]:
+    """Transitive closure — which types can an omission of ``roots`` ever
+    suppress downstream (the model checker's pruning question)."""
+    seen: Set[str] = set()
+    stack = list(roots)
+    while stack:
+        t = stack.pop()
+        if t in seen:
+            continue
+        seen.add(t)
+        stack.extend(causality.get(t, []))
+    return seen

@@ -1,0 +1,244 @@
+"""Property-based cluster testing — the TPU rebuild of
+``test/prop_partisan.erl`` (proper statem, 1162 LoC).
+
+The reference composes three command sources — cluster commands
+(join/leave), fault-model commands (crash, omissions), and pluggable
+system-model commands (:62-104, 302-325) — generates random sequences,
+runs them against a live cluster, and shrinks failures.  Here a command
+sequence is generated from a seeded RNG, applied to a World
+interleaved with simulation rounds, and the system model's assertions run
+after a fault-free settling window (the reference asserts after resolving
+faults too).  Failures shrink by greedy command-deletion (delta
+debugging), which is exactly what proper's shrinking does to statem
+command lists.
+
+System models implement the prop_partisan node-model contract
+(node_commands/node_assertion_functions — prop_partisan.erl:273-460):
+
+  * ``commands(rng, n_nodes) -> list[Command]`` candidate pool
+  * ``assert_ok(world, proto) -> None`` (raise AssertionError on violation)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random as _random
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..engine import ProtocolBase, World, init_world, make_step
+from .. import peer_service
+from . import faults
+
+
+@dataclasses.dataclass(frozen=True)
+class Command:
+    """One abstract cluster/fault command (the statem symbolic call)."""
+    verb: str                 # join | leave | crash | recover | partition |
+                              # resolve_partition | <system-model verb>
+    args: Tuple = ()
+
+    def __repr__(self) -> str:
+        return f"{self.verb}{self.args}"
+
+
+def apply_command(world: World, proto: ProtocolBase,
+                  cmd: Command) -> World:
+    if cmd.verb == "join":
+        return peer_service.join(world, proto, *cmd.args)
+    if cmd.verb == "leave":
+        return peer_service.leave(world, proto, cmd.args[0])
+    if cmd.verb == "crash":
+        return faults.crash(world, [cmd.args[0]])
+    if cmd.verb == "recover":
+        return faults.recover(world, [cmd.args[0]])
+    if cmd.verb == "partition":
+        return faults.inject_partition(world, [list(cmd.args[0])])
+    if cmd.verb == "resolve_partition":
+        return faults.resolve_partition(world)
+    raise ValueError(f"unknown command verb {cmd.verb}")
+
+
+class ClusterCommands:
+    """The cluster + crash-fault command pool (prop_partisan cluster
+    commands + prop_partisan_crash_fault_model :33-37), bounded by a crash
+    ``tolerance`` exactly like the reference's fault model."""
+
+    def __init__(self, n_nodes: int, tolerance: int = 1,
+                 with_partitions: bool = True):
+        self.n = n_nodes
+        self.tolerance = tolerance
+        self.with_partitions = with_partitions
+        self._crashed: set = set()
+
+    def reset(self) -> None:
+        self._crashed = set()
+
+    def next_command(self, rng: _random.Random) -> Command:
+        verbs = ["join", "join", "join", "leave"]
+        if len(self._crashed) < self.tolerance:
+            verbs.append("crash")
+        if self._crashed:
+            verbs.append("recover")
+        if self.with_partitions:
+            verbs += ["partition", "resolve_partition"]
+        v = rng.choice(verbs)
+        if v == "join":
+            a, b = rng.sample(range(self.n), 2)
+            return Command("join", (a, b))
+        if v == "leave":
+            return Command("leave", (rng.randrange(self.n),))
+        if v == "crash":
+            victim = rng.choice(
+                [i for i in range(self.n) if i not in self._crashed])
+            self._crashed.add(victim)
+            return Command("crash", (victim,))
+        if v == "recover":
+            victim = rng.choice(sorted(self._crashed))
+            self._crashed.discard(victim)
+            return Command("recover", (victim,))
+        if v == "partition":
+            k = rng.randrange(1, self.n)
+            return Command("partition",
+                           (tuple(rng.sample(range(self.n), k)),))
+        return Command("resolve_partition")
+
+
+@dataclasses.dataclass
+class Failure:
+    seed: int
+    commands: List[Command]      # shrunk sequence
+    original_len: int
+    error: str
+
+
+@dataclasses.dataclass
+class PropResult:
+    cases: int
+    failures: List[Failure]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+class PropRunner:
+    """prop_sequential (:62-104): random command sequences against a fresh
+    cluster, post-settle assertions, shrinking on failure."""
+
+    def __init__(self, cfg: Config, proto: ProtocolBase,
+                 assert_ok: Callable[[World, ProtocolBase], None],
+                 commands: Optional[ClusterCommands] = None,
+                 rounds_between: int = 3,
+                 settle_rounds: int = 40):
+        self.cfg = cfg
+        self.proto = proto
+        self.assert_ok = assert_ok
+        self.commands = commands or ClusterCommands(cfg.n_nodes)
+        self.rounds_between = rounds_between
+        self.settle_rounds = settle_rounds
+        self.step = make_step(cfg, proto, donate=False)
+
+    # ------------------------------------------------------------- execution
+
+    def _execute(self, cmds: Sequence[Command]) -> None:
+        """Run one case; raises on assertion failure."""
+        import jax.numpy as jnp
+        world = init_world(self.cfg, self.proto)
+        # formation phase: everyone joins via node 0 and the overlay
+        # settles (the reference's support harness clusters first; random
+        # commands then perturb a live cluster)
+        world = peer_service.cluster(
+            world, self.proto,
+            [(i, 0) for i in range(1, self.cfg.n_nodes)], stagger=4)
+        for _ in range(self.settle_rounds):
+            world, _ = self.step(world)
+        for cmd in cmds:
+            world = apply_command(world, self.proto, cmd)
+            for _ in range(self.rounds_between):
+                world, _ = self.step(world)
+        # settle: resolve partitions + recover everyone (the reference
+        # resolves faults before asserting), then let repair run
+        world = faults.resolve_partition(world)
+        world = world.replace(alive=jnp.ones_like(world.alive))
+        for _ in range(self.settle_rounds):
+            world, _ = self.step(world)
+        self.assert_ok(world, self.proto)
+
+    def _generate(self, seed: int, n_commands: int) -> List[Command]:
+        rng = _random.Random(seed)
+        self.commands.reset()
+        return [self.commands.next_command(rng) for _ in range(n_commands)]
+
+    def _shrink(self, cmds: List[Command]) -> List[Command]:
+        """Greedy delta-debugging: drop commands while the case still
+        fails (proper's statem shrinking collapsed to one pass)."""
+        current = list(cmds)
+        improved = True
+        while improved:
+            improved = False
+            for i in range(len(current)):
+                cand = current[:i] + current[i + 1:]
+                try:
+                    self._execute(cand)
+                except AssertionError:
+                    current = cand
+                    improved = True
+                    break
+        return current
+
+    def check(self, n_cases: int = 10, n_commands: int = 12,
+              shrink: bool = True) -> PropResult:
+        failures: List[Failure] = []
+        for seed in range(n_cases):
+            cmds = self._generate(seed, n_commands)
+            try:
+                self._execute(cmds)
+            except AssertionError as e:
+                shrunk = self._shrink(cmds) if shrink else cmds
+                failures.append(Failure(seed, shrunk, len(cmds), str(e)))
+        return PropResult(n_cases, failures)
+
+
+# ------------------------------------------------- stock assertion models
+
+def connectivity_model(view_attr: str = "active"):
+    """The reliable-broadcast/membership system-model assertion: after
+    settling, alive nodes form a connected overlay
+    (prop_partisan_reliable_broadcast + hyparview_membership_check)."""
+    from ..ops import graph
+    import jax.numpy as jnp
+
+    def assert_ok(world: World, proto: ProtocolBase) -> None:
+        views = getattr(world.state, view_attr)
+        n = np.asarray(world.alive).shape[0]
+        left = getattr(world.state, "left", None)
+        active_nodes = np.asarray(world.alive)
+        if left is not None:
+            active_nodes = active_nodes & ~np.asarray(left)
+        if active_nodes.sum() < 2:
+            return
+        adj = graph.adjacency_from_views(views, n)
+        ok = graph.is_connected(adj, jnp.asarray(active_nodes))
+        assert bool(ok), \
+            f"overlay disconnected among alive nodes {np.flatnonzero(active_nodes)}"
+    return assert_ok
+
+
+def convergence_model():
+    """Full-membership convergence assertion: all alive nodes agree."""
+    import jax
+
+    def assert_ok(world: World, proto: ProtocolBase) -> None:
+        masks = np.asarray(jax.vmap(proto.member_mask)(world.state))
+        alive = np.asarray(world.alive)
+        left = getattr(world.state, "left", None)
+        if left is not None:  # departed nodes stopped; their view is moot
+            alive = alive & ~np.asarray(left)
+        rows = masks[alive]
+        if rows.shape[0] == 0:  # everyone left/crashed: vacuously agreed
+            return
+        assert (rows == rows[0]).all(), "membership views diverged"
+    return assert_ok

@@ -64,6 +64,24 @@ def test_leave_propagates():
         np.testing.assert_array_equal(masks[n], [True, True, True, False])
 
 
+def test_remote_leave_reaches_target():
+    """leave(node=0, target=3): the removal gossip goes to the PRE-removal
+    member list, so the evicted node learns its fate, sets `left`, and
+    stops gossiping its stale view (full :58-89 + pluggable :1170-1188)."""
+    cfg = Config(n_nodes=4, periodic_interval=2, inbox_cap=8)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    for n in (1, 2, 3):
+        world = peer_service.join(world, proto, n, 0)
+    world = run_rounds(cfg, proto, world, 8)
+    world = peer_service.leave(world, proto, 0, target=3)
+    world = run_rounds(cfg, proto, world, 10)
+    assert bool(world.state.left[3]), "evicted node never learned it left"
+    masks = np.asarray(jax.vmap(proto.member_mask)(world.state))
+    for n in (0, 1, 2):
+        np.testing.assert_array_equal(masks[n], [True, True, True, False])
+
+
 def test_sixteen_node_convergence_rounds():
     """Convergence in O(diameter) rounds on a chain-join topology."""
     cfg = Config(n_nodes=16, periodic_interval=2, inbox_cap=32)
