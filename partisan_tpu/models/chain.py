@@ -1,0 +1,173 @@
+"""Replicated block-chain test worker — the analog of
+``src/partisan_hbbft_worker.erl`` (chain of blocks, ``submit_transaction``,
+``verify_chain``, :5-14, 101-108), the workload behind
+``prop_partisan_hbbft``.
+
+The reference worker wraps an external HoneyBadgerBFT library; the
+consensus core is not partisan code.  This rebuild supplies the same
+*harness surface* — submit transactions anywhere, blocks form, every
+replica's chain must verify — over a rotating-leader broadcast (leader for
+height h is ``h mod N``), which is what the property/model-checking
+machinery needs a chain workload for.  Byzantine tolerance is out of
+scope exactly as it was a library concern in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase, World
+from ..ops import ring
+from ..ops.msg import Msgs
+
+
+@struct.dataclass
+class ChainState:
+    chain: jax.Array      # [N, H, B] committed txn ids (-1 pad)
+    height: jax.Array     # [N] next height to fill
+    mempool: jax.Array    # [N, M] pending txn ids (-1 free)
+    pend_h: jax.Array     # [N] buffered future block's height (-1 empty)
+    pend_b: jax.Array     # [N, B] its txns (catch-up, see handle_block)
+
+
+class ChainWorker(ProtocolBase):
+    msg_types = ("submit", "block", "fetch", "ctl_submit")
+
+    def __init__(self, cfg: Config, max_height: int = 8,
+                 block_cap: int = 4, mempool_cap: int = 8):
+        self.cfg = cfg
+        self.H, self.B, self.M = max_height, block_cap, mempool_cap
+        self.data_spec: Dict = {
+            "txn": ((), jnp.int32),
+            "bheight": ((), jnp.int32),
+            "btxns": ((block_cap,), jnp.int32),
+        }
+        self.emit_cap = cfg.n_nodes
+        self.tick_emit_cap = cfg.n_nodes
+
+    def init(self, cfg: Config, key: jax.Array) -> ChainState:
+        n = cfg.n_nodes
+        return ChainState(
+            chain=jnp.full((n, self.H, self.B), -1, jnp.int32),
+            height=jnp.zeros((n,), jnp.int32),
+            mempool=jnp.full((n, self.M), -1, jnp.int32),
+            pend_h=jnp.full((n,), -1, jnp.int32),
+            pend_b=jnp.full((n, self.B), -1, jnp.int32),
+        )
+
+    # -- transaction intake (submit_transaction) ----------------------------
+
+    def _leader(self, h: jax.Array) -> jax.Array:
+        return (h % self.cfg.n_nodes).astype(jnp.int32)
+
+    def handle_ctl_submit(self, cfg, me, row: ChainState, m: Msgs, key):
+        """submit_transaction/2: accept anywhere, replicate into every
+        node's pending buffer (hbbft buffers txns at every worker), so
+        whichever node leads a height can include it."""
+        everyone = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        return row, self.emit(everyone, self.typ("submit"),
+                              txn=m.data["txn"])
+
+    def handle_submit(self, cfg, me, row: ChainState, m: Msgs, key):
+        txn = m.data["txn"]
+        dup = jnp.any((row.mempool == txn) & (txn >= 0)) \
+            | jnp.any((row.chain == txn) & (txn >= 0))
+        ok, slot = ring.alloc(row.mempool >= 0)
+        ok = ok & (txn >= 0) & ~dup
+        return row.replace(mempool=ring.masked_set(
+            row.mempool, slot, ok, txn)), self.no_emit()
+
+    # -- block formation ----------------------------------------------------
+
+    def _append(self, row: ChainState, bheight, btxns) -> ChainState:
+        h = jnp.clip(bheight, 0, self.H - 1)
+        accept = bheight == row.height
+        row = row.replace(
+            chain=row.chain.at[h].set(jnp.where(accept, btxns,
+                                                row.chain[h])),
+            height=row.height + accept.astype(jnp.int32))
+        in_block = jnp.any(row.mempool[:, None] == btxns[None, :], axis=1)
+        return row.replace(mempool=jnp.where(accept & in_block, -1,
+                                             row.mempool))
+
+    def handle_block(self, cfg, me, row: ChainState, m: Msgs, key):
+        """Append the block at its height (heights fill in order), then
+        try the buffered future block.  A block AHEAD of my height means I
+        missed one: buffer it and fetch my current height from the sender
+        (the catch-up that keeps a replica from stalling forever after a
+        single lost delivery — the fault schedules of the property harness
+        drop messages on purpose)."""
+        bheight, btxns = m.data["bheight"], m.data["btxns"]
+        future = bheight > row.height
+        row = row.replace(
+            pend_h=jnp.where(future, bheight, row.pend_h),
+            pend_b=jnp.where(future, btxns, row.pend_b))
+        fetch = self.emit(jnp.where(future, m.src, -1)[None],
+                          self.typ("fetch"), bheight=row.height)
+        row = self._append(row, bheight, btxns)
+        # drain the pending slot if it now matches
+        can = row.pend_h == row.height
+        row2 = self._append(row, row.pend_h, row.pend_b)
+        row = row2.replace(pend_h=jnp.where(can, -1, row2.pend_h))
+        return row, fetch
+
+    def handle_fetch(self, cfg, me, row: ChainState, m: Msgs, key):
+        """Serve a committed block to a lagging replica."""
+        h = jnp.clip(m.data["bheight"], 0, self.H - 1)
+        have = (m.data["bheight"] < row.height) & (m.data["bheight"] >= 0)
+        rep = self.emit(jnp.where(have, m.src, -1)[None],
+                        self.typ("block"), bheight=m.data["bheight"],
+                        btxns=row.chain[h])
+        return row, rep
+
+    probe_interval = 5  # rounds between catch-up height probes
+
+    def tick(self, cfg, me, row: ChainState, rnd, key):
+        """The leader for the current height proposes once it holds any
+        pending transactions; every node periodically probes a random peer
+        with its height (a quiet chain otherwise never nudges a replica
+        that missed the final block)."""
+        is_leader = self._leader(row.height) == me
+        have = jnp.sum(row.mempool >= 0) > 0
+        can = is_leader & have & (row.height < self.H)
+        order = jnp.argsort(jnp.where(row.mempool >= 0, 0, 1), stable=True)
+        pool = row.mempool[order]
+        btxns = pool[: self.B]
+        everyone = jnp.arange(cfg.n_nodes, dtype=jnp.int32)
+        em = self.emit(jnp.where(can, everyone, -1), self.typ("block"),
+                       cap=self.tick_emit_cap,
+                       bheight=row.height, btxns=btxns)
+        probe_due = ((rnd + me) % self.probe_interval) == 0
+        peer = jax.random.randint(key, (), 0, cfg.n_nodes)
+        peer = jnp.where(peer == me, (peer + 1) % cfg.n_nodes, peer)
+        probe = self.emit(jnp.where(probe_due, peer, -1)[None],
+                          self.typ("fetch"), cap=self.tick_emit_cap,
+                          bheight=row.height)
+        return row, self.merge(em, probe, cap=self.tick_emit_cap)
+
+
+# ------------------------------------------------------------- assertions
+
+def verify_chain(world: World, proto: ChainWorker,
+                 submitted=None) -> None:
+    """partisan_hbbft_worker:verify_chain analog: every replica holds the
+    same chain prefix, no txn committed twice, and (optionally) every
+    submitted txn landed."""
+    chains = np.asarray(world.state.chain)      # [N, H, B]
+    heights = np.asarray(world.state.height)
+    h = int(heights.min())
+    base = chains[0, :h]
+    for node in range(chains.shape[0]):
+        assert (chains[node, :h] == base).all(), \
+            f"chain divergence at node {node}"
+    flat = base[base >= 0]
+    assert len(set(flat.tolist())) == flat.size, "txn committed twice"
+    if submitted is not None:
+        missing = set(submitted) - set(flat.tolist())
+        assert not missing, f"txns never committed: {missing}"

@@ -35,6 +35,7 @@ from ..engine import ProtocolBase
 from ..ops import bitset
 from ..ops.msg import Msgs
 from .. import prng
+from .stack import UpperProtocol
 
 
 # =========================================================================
@@ -108,6 +109,42 @@ class DirectMailAcked(DirectMail):
     def handle_ack(self, cfg, me, row: MailState, m: Msgs, key):
         r = m.data["rumor"]
         return row.replace(acked=row.acked.at[r].add(1)), self.no_emit()
+
+
+class MailOverMembership(UpperProtocol):
+    """demers_direct_mail as the reference actually runs it in
+    ``gossip_test`` (test/partisan_SUITE.erl:1138): the protocol reads its
+    peer set from the LIVE membership layer (`partisan:membership/0` at
+    broadcast time, demers_direct_mail.erl:94-117) instead of a static
+    mesh — joins and leaves between broadcasts change delivery.  Stack it
+    over FullMembership with models/stack.Stacked."""
+
+    msg_types = ("mail", "ctl_broadcast")
+
+    def __init__(self, cfg: Config, n_rumors: int = 4):
+        self.cfg = cfg
+        self.R = n_rumors
+        self.data_spec: Dict = {"rumor": ((), jnp.int32)}
+        self.emit_cap = cfg.n_nodes
+        self.tick_emit_cap = 1
+
+    def init_upper(self, cfg: Config, key: jax.Array):
+        return jnp.zeros((cfg.n_nodes, self.R), bool)  # seen
+
+    def handle_ctl_broadcast(self, cfg, me, row, m: Msgs, key):
+        r = jnp.clip(m.data["rumor"], 0, self.R - 1)
+        peers = self.active_peers(row)
+        peers = jnp.where(peers == me, -1, peers)
+        seen = row.upper.at[r].set(True)
+        return self.up(row, seen), self.emit(peers, self.typ("mail"),
+                                             rumor=r)
+
+    def handle_mail(self, cfg, me, row, m: Msgs, key):
+        r = jnp.clip(m.data["rumor"], 0, self.R - 1)
+        return self.up(row, row.upper.at[r].set(True)), self.no_emit()
+
+    def tick_upper(self, cfg, me, row, rnd, key):
+        return row, self.no_emit(self.tick_emit_cap)
 
 
 # =========================================================================

@@ -42,15 +42,23 @@ class UpperProtocol(ProtocolBase):
     the full StackState row; use `self.active_peers(row)` for the current
     peer set and return rows via `self.up(row, new_upper)`."""
 
+    _lower_proto: "ProtocolBase | None" = None  # wired by Stacked.__init__
+
     def up(self, row: StackState, new_upper: Any) -> StackState:
         return row.replace(upper=new_upper)
 
     def active_peers(self, row: StackState) -> jax.Array:
-        """Padded peer-id list from the lower layer (HyParView active view /
-        full-membership member list)."""
+        """Padded peer-id list from the lower layer: a partial-view manager
+        exposes its active view directly; otherwise the lower protocol's
+        own member_mask is the source of truth (so its semantics — e.g.
+        eviction handling — propagate to the broadcast layer)."""
         lower = row.lower
         if hasattr(lower, "active"):
             return lower.active
+        if self._lower_proto is not None:
+            mask = self._lower_proto.member_mask(lower)
+            idx, = jnp.nonzero(mask, size=self.emit_cap, fill_value=-1)
+            return idx.astype(jnp.int32)
         raise NotImplementedError(
             "lower protocol exposes no peer set; override active_peers")
 
@@ -74,6 +82,7 @@ class Stacked(ProtocolBase):
             sub._typ_offset = off
             sub.data_spec = spec
             sub.emit_cap = self.emit_cap
+        upper._lower_proto = lower
 
     def typ(self, name: str) -> int:
         return self.msg_types.index(name)

@@ -1,0 +1,138 @@
+"""Performance sweep — the analog of the reference's perf harness
+(``make perf`` + ``bin/perf-suite.sh`` sweeping size/concurrency/RTT into
+``results.csv``, test/partisan_SUITE.erl:1029-1136).
+
+Sweeps the BASELINE configs (BASELINE.md) on whatever device JAX offers,
+timing whole-run-on-device loops (engine.make_run_scan — zero host
+round-trips), and appends one CSV row per config:
+
+    config,n_nodes,rounds,seconds,rounds_per_sec,health
+
+Usage:  python scripts/perf_suite.py [--out results.csv] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import peer_service  # noqa: E402
+from partisan_tpu.engine import make_run_scan, init_world  # noqa: E402
+from partisan_tpu.models.demers import rumor_init, rumor_run  # noqa: E402
+from partisan_tpu.models.full_membership import FullMembership  # noqa: E402
+from partisan_tpu.models.hyparview import HyParView  # noqa: E402
+from partisan_tpu.models.plumtree import Plumtree  # noqa: E402
+from partisan_tpu.models.scamp import ScampV2  # noqa: E402
+from partisan_tpu.models.stack import Stacked  # noqa: E402
+from partisan_tpu.ops import graph  # noqa: E402
+
+
+def time_engine(name, cfg, proto, rounds, health_fn, rows):
+    world = init_world(cfg, proto)
+    world = peer_service.cluster(
+        world, proto, [(i, 0) for i in range(1, cfg.n_nodes)], stagger=8)
+    run = make_run_scan(cfg, proto, rounds)
+    w2, _ = run(world)           # compile + warm
+    jax.block_until_ready(w2.rnd)
+    world2 = init_world(cfg, proto)  # distinct input (tunnel result cache)
+    world2 = peer_service.cluster(
+        world2, proto, [(i, 1 % cfg.n_nodes) for i in range(2, cfg.n_nodes)],
+        stagger=8)
+    t0 = time.perf_counter()
+    w3, _ = run(world2)
+    jax.block_until_ready(w3.rnd)
+    dt = time.perf_counter() - t0
+    health = health_fn(w2)
+    rows.append([name, cfg.n_nodes, rounds, round(dt, 4),
+                 round(rounds / dt, 1), health])
+    print(f"{name:28s} N={cfg.n_nodes:<7d} {rounds/dt:9.1f} rounds/s  "
+          f"({health})")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="results.csv")
+    ap.add_argument("--quick", action="store_true",
+                    help="small round counts (CI smoke)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the image's TPU plugin "
+                         "ignores JAX_PLATFORMS)")
+    ap.add_argument("--only", default=None,
+                    help="run a single config by name substring")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    R = 50 if args.quick else 200
+    rows = []
+    want = lambda name: args.only is None or args.only in name
+
+    if want("full_membership"):
+        # BASELINE #1: full membership, small cluster
+        cfg = pt.Config(n_nodes=16, inbox_cap=32, periodic_interval=2)
+        time_engine("full_membership", cfg, FullMembership(cfg), R,
+                    lambda w: "converged" if bool(
+                        (np.asarray(jax.vmap(FullMembership(cfg).member_mask)(
+                            w.state)).all())) else "partial", rows)
+
+    if want("hyparview"):
+        # BASELINE #2: HyParView N=64
+        cfg = pt.Config(n_nodes=64, inbox_cap=8, shuffle_interval=5)
+        hv = HyParView(cfg)
+        time_engine("hyparview", cfg, hv, R,
+                    lambda w: "connected" if bool(graph.is_connected(
+                        graph.adjacency_from_views(w.state.active, 64)))
+                    else "DISCONNECTED", rows)
+
+    if want("plumtree"):
+        # BASELINE #3: plumtree over hyparview N=64
+        cfg = pt.Config(n_nodes=64, inbox_cap=12, shuffle_interval=5)
+        time_engine("plumtree_over_hyparview", cfg,
+                    Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1)), R,
+                    lambda w: "ok", rows)
+
+    if want("scamp"):
+        # BASELINE #4: SCAMP v2 at 1024
+        cfg = pt.Config(n_nodes=1024, inbox_cap=16, periodic_interval=5)
+        sc = ScampV2(cfg)
+        time_engine("scamp_v2", cfg, sc, R,
+                    lambda w: "connected" if bool(graph.is_connected(
+                        graph.adjacency_from_views(w.state.partial, 1024)))
+                    else "DISCONNECTED", rows)
+
+    if want("rumor"):
+        # BASELINE #5: rumor fast path at 1e6 (the bench.py headline)
+        n, rounds = 1_000_000, 1000
+        out = rumor_run(rumor_init(n, 0), rounds, n, 2, 1, 0.01)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = rumor_run(rumor_init(n, 7919), rounds, n, 2, 1, 0.01)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        rows.append(["rumor_mongering_1e6", n, rounds, round(dt, 4),
+                     round(rounds / dt, 1),
+                     f"infected={float(out.infected.mean()):.2f}"])
+        print(f"{'rumor_mongering_1e6':28s} N={n:<7d} "
+              f"{rounds/dt:9.1f} rounds/s")
+
+    new = not os.path.exists(args.out)
+    with open(args.out, "a", newline="") as f:
+        w = csv.writer(f)
+        if new:
+            w.writerow(["config", "n_nodes", "rounds", "seconds",
+                        "rounds_per_sec", "health"])
+        w.writerows(rows)
+    print(f"appended {len(rows)} rows to {args.out} "
+          f"(device={jax.devices()[0].platform})")
+
+
+if __name__ == "__main__":
+    main()

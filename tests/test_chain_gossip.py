@@ -1,0 +1,103 @@
+"""Chain worker (hbbft-worker analog) + gossip over live membership
+(gossip_test parity) tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.peer_service import send_ctl
+from partisan_tpu.models.chain import ChainWorker, verify_chain
+from partisan_tpu.models.demers import MailOverMembership
+from partisan_tpu.models.full_membership import FullMembership
+from partisan_tpu.models.stack import Stacked
+
+
+class TestChainWorker:
+    def test_submit_and_verify(self):
+        """submit_transaction from several nodes; all replicas converge on
+        one verified chain containing every txn (hbbft_worker :101-108)."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = ChainWorker(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        submitted = []
+        for i, node in enumerate([0, 1, 2, 3, 1, 2]):
+            txn = 100 + i
+            world = send_ctl(world, proto, node, "ctl_submit", txn=txn)
+            submitted.append(txn)
+        for _ in range(24):
+            world, _ = step(world)
+        assert int(np.asarray(world.state.height).min()) >= 1
+        verify_chain(world, proto, submitted)
+
+    def test_catch_up_after_dropped_block(self):
+        """Drop block deliveries to node 2 during an early window; the
+        fetch/pending catch-up must restore chain agreement (the stall a
+        single lost block used to cause)."""
+        from partisan_tpu.verify import faults
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = ChainWorker(cfg, block_cap=2)
+        interp = faults.send_omission(
+            dst=2, typ=proto.typ("block"), rounds=(0, 4))
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=interp)
+        submitted = []
+        for i in range(6):
+            txn = 300 + i
+            world = send_ctl(world, proto, i % 4, "ctl_submit", txn=txn)
+            submitted.append(txn)
+        for _ in range(30):
+            world, _ = step(world)
+        heights = np.asarray(world.state.height)
+        assert heights.min() == heights.max(), heights
+        verify_chain(world, proto, submitted)
+
+    def test_leader_rotates(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = ChainWorker(cfg, block_cap=1)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        for i in range(3):
+            world = send_ctl(world, proto, 0, "ctl_submit", txn=50 + i)
+        for _ in range(30):
+            world, _ = step(world)
+        verify_chain(world, proto, [50, 51, 52])
+        assert int(np.asarray(world.state.height).min()) == 3
+
+
+class TestGossipOverLiveMembership:
+    def boot(self, n=6):
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+        proto = Stacked(FullMembership(cfg), MailOverMembership(cfg))
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = peer_service.cluster(world, proto,
+                                     [(i, 0) for i in range(1, n)])
+        for _ in range(10):
+            world, _ = step(world)
+        return cfg, proto, world, step
+
+    def test_gossip_test_parity(self):
+        """gossip_test (test/partisan_SUITE.erl:1138): broadcast on a live
+        4+-node cluster, assert delivery everywhere within the window."""
+        cfg, proto, world, step = self.boot()
+        world = send_ctl(world, proto, 2, "ctl_broadcast", rumor=1)
+        for _ in range(4):
+            world, _ = step(world)
+        seen = np.asarray(world.state.upper)
+        assert seen[:, 1].all(), "broadcast missed a member"
+
+    def test_departed_member_not_mailed(self):
+        cfg, proto, world, step = self.boot()
+        world = peer_service.leave(world, proto, 4)
+        for _ in range(10):
+            world, _ = step(world)
+        world = send_ctl(world, proto, 0, "ctl_broadcast", rumor=2)
+        for _ in range(4):
+            world, _ = step(world)
+        seen = np.asarray(world.state.upper)
+        others = [0, 1, 2, 3, 5]
+        assert seen[others, 2].all()
+        assert not seen[4, 2], "departed node still receiving broadcasts"
