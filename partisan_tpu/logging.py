@@ -1,0 +1,43 @@
+"""Logging façade — the ``partisan_logger.erl`` / ``partisan_config:trace``
+analog (SURVEY §5.1: a cheap global tracing flag gates protocol logging
+everywhere).
+
+Device code cannot log; host-side orchestration (peer_service verbs,
+bridge commands, verify harness, orchestration polls) logs through here.
+``trace(...)`` is the hot-path guard: a no-op unless the tracing flag is
+on, mirroring ``partisan_config:trace/2`` (partisan_config.erl:172-178).
+For on-device visibility use engine metrics / ``capture_wire`` instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger("partisan_tpu")
+
+_TRACING = os.environ.get("PARTISAN_TRACING", "") in ("1", "true")
+
+
+def set_tracing(on: bool) -> None:
+    """partisan_config:set(tracing, ...)."""
+    global _TRACING
+    _TRACING = on
+
+
+def tracing() -> bool:
+    return _TRACING
+
+
+def trace(msg: str, *args) -> None:
+    """Gated protocol tracing (the lager:info sites behind the flag)."""
+    if _TRACING:
+        logger.info(msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    logger.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    logger.warning(msg, *args)

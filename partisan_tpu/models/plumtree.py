@@ -75,6 +75,33 @@ class Plumtree(UpperProtocol):
         self.emit_cap = 2 * cfg.max_active_size + 1
         self.tick_emit_cap = 1
 
+    # -- the partisan_plumtree_broadcast_handler behaviour (:26-43) ---------
+    # Default implementation = partisan_plumtree_backend's monotonically-
+    # timestamped values; override these four to plug a different handler
+    # (Mod:merge / Mod:is_stale / Mod:graft / Mod:exchange).
+
+    def pt_is_stale(self, up: "PtState", k, seq) -> jax.Array:
+        """Mod:is_stale/1 — have we already delivered this or newer?"""
+        return seq <= up.seq[k]
+
+    def pt_merge(self, up: "PtState", k, seq, val, fresh) -> "PtState":
+        """Mod:merge/2 — deliver/absorb a fresh payload."""
+        return up.replace(
+            seq=up.seq.at[k].set(jnp.where(fresh, seq, up.seq[k])),
+            val=up.val.at[k].set(jnp.where(fresh, val, up.val[k])))
+
+    def pt_graft(self, up: "PtState", k):
+        """Mod:graft/1 — reproduce the stored payload for a re-send."""
+        return up.seq[k], up.val[k]
+
+    def pt_exchange(self, up: "PtState", k, seq, val):
+        """Mod:exchange/1 anti-entropy merge: adopt newer, report whether
+        ours is newer (to reply)."""
+        theirs_newer = seq > up.seq[k]
+        mine_newer = up.seq[k] > seq
+        up = self.pt_merge(up, k, seq, val, theirs_newer)
+        return up, mine_newer
+
     def init_upper(self, cfg: Config, key: jax.Array) -> PtState:
         n = cfg.n_nodes
         return PtState(
@@ -112,14 +139,13 @@ class Plumtree(UpperProtocol):
         up = row.upper
         k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
         seq, val, root = m.data["pt_seq"], m.data["pt_val"], m.data["pt_root"]
-        fresh = seq > up.seq[k]
+        fresh = ~self.pt_is_stale(up, k, seq)
 
         peers = self.active_peers(row)
         up, slot, eager, lazy = self._bucket(up, root, peers)
-        # fresh: deliver, graft sender eager, push round+1 to other eagers,
-        # schedule lazy i_haves (delayed by lazy_tick_period)
-        up = up.replace(seq=up.seq.at[k].set(jnp.where(fresh, seq, up.seq[k])),
-                        val=up.val.at[k].set(jnp.where(fresh, val, up.val[k])))
+        # fresh: deliver (Mod:merge), graft sender eager, push round+1 to
+        # other eagers, schedule lazy i_haves (delayed by lazy_tick_period)
+        up = self.pt_merge(up, k, seq, val, fresh)
         eager_f = ps.insert(eager, jnp.where(fresh, m.src, -1))
         lazy_f = ps.remove(lazy, jnp.where(fresh, m.src, -1))
         # stale: prune sender to lazy (:368-373)
@@ -144,7 +170,7 @@ class Plumtree(UpperProtocol):
     def handle_i_have(self, cfg, me, row: StackState, m: Msgs, key):
         up = row.upper
         k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
-        missing = m.data["pt_seq"] > up.seq[k]
+        missing = ~self.pt_is_stale(up, k, m.data["pt_seq"])
         peers = self.active_peers(row)
         up, slot, eager, lazy = self._bucket(up, m.data["pt_root"], peers)
         eager2 = ps.insert(eager, jnp.where(missing, m.src, -1))
@@ -164,10 +190,11 @@ class Plumtree(UpperProtocol):
         eager2 = ps.insert(eager, m.src)
         lazy2 = ps.remove(lazy, m.src)
         up = self._store(up, slot, eager2, lazy2)
-        # re-send the broadcast we hold for this key (:388-402)
+        # re-send the broadcast we hold for this key (Mod:graft, :388-402)
+        gseq, gval = self.pt_graft(up, k)
         resend = self.emit(m.src[None], self.typ("bcast"),
                            pt_root=m.data["pt_root"], pt_key=k,
-                           pt_seq=up.seq[k], pt_val=up.val[k], pt_round=0)
+                           pt_seq=gseq, pt_val=gval, pt_round=0)
         return self.up(row, up), resend
 
     def handle_prune(self, cfg, me, row: StackState, m: Msgs, key):
@@ -183,16 +210,12 @@ class Plumtree(UpperProtocol):
         newer (seq, val); reply with mine when mine is newer."""
         up = row.upper
         k = jnp.clip(m.data["pt_key"], 0, self.K - 1)
-        theirs_newer = m.data["pt_seq"] > up.seq[k]
-        mine_newer = up.seq[k] > m.data["pt_seq"]
-        up = up.replace(
-            seq=up.seq.at[k].set(jnp.where(theirs_newer, m.data["pt_seq"],
-                                           up.seq[k])),
-            val=up.val.at[k].set(jnp.where(theirs_newer, m.data["pt_val"],
-                                           up.val[k])))
+        up, mine_newer = self.pt_exchange(up, k, m.data["pt_seq"],
+                                          m.data["pt_val"])
+        gseq, gval = self.pt_graft(up, k)  # reply via the payload hook too
         rep = self.emit(jnp.where(mine_newer, m.src, -1)[None],
                         self.typ("exchange"), pt_key=k,
-                        pt_seq=up.seq[k], pt_val=up.val[k])
+                        pt_seq=gseq, pt_val=gval)
         return self.up(row, up), rep
 
     def handle_ctl_pt_broadcast(self, cfg, me, row: StackState, m: Msgs, key):

@@ -82,6 +82,38 @@ class TestGenServer:
         assert not bool(world.state.call_done[1][0])
 
 
+class TestGenFsm:
+    def test_code_lock_transitions(self):
+        """gen_fsm state_functions: feed the code digit-by-digit via
+        sync_send_event (ctl_call); wrong digit resets; full code
+        unlocks; the next event relocks (partisan_gen_fsm :218-307)."""
+        from partisan_tpu.otp import LockFsm
+        cfg = pt.Config(n_nodes=2, inbox_cap=8)
+        proto = LockFsm(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+
+        def press(world, digit):
+            world = send_ctl(world, proto, 0, "ctl_call", peer=1,
+                             req=jnp.asarray([digit, 0], jnp.int32),
+                             timeout=0)
+            for _ in range(4):
+                world, _ = step(world)
+            # completed calls free their ring slot, so every call reuses
+            # slot 0; its reply stays readable until reallocation
+            return world, int(world.state.call_reply[0][0][0])
+
+        world, r = press(world, 9)             # wrong digit
+        assert r == 0
+        world, r = press(world, 1)             # code[0]
+        assert r == 0
+        world, r = press(world, 2)             # code[1] -> unlocked
+        assert r == 1
+        assert int(world.state.server["fsm"][1]) == 1
+        world, r = press(world, 0)             # any event relocks
+        assert int(world.state.server["fsm"][1]) == 0
+
+
 class TestMonitor:
     def test_down_on_crash(self):
         cfg, proto, world, step = boot()
