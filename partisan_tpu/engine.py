@@ -187,29 +187,56 @@ def make_step(
         mono_mask = jnp.asarray(
             [c in cfg.monotonic_channels for c in cfg.channels], dtype=bool)
 
-    def noop_handler(node_id, row, m, key):
-        return row, proto.no_emit()
+    def _sel_where(sel, new, old):
+        """Per-node select with broadcast over trailing dims."""
+        return jax.tree_util.tree_map(
+            lambda b, a: jnp.where(
+                sel.reshape((N,) + (1,) * (b.ndim - 1)), b, a), new, old)
 
-    def node_deliver(node_id, row, inbox_row, key):
-        embuf = msgops.empty(K * E, proto.data_spec)
+    def deliver_batch(state, inbox, dkeys, node_ids):
+        """Process inbox slot k for every node, slot-sequentially (Erlang
+        mailbox order), but dispatch per TYPE with a global emptiness
+        gate: ``vmap(lax.switch)`` lowers to evaluate-every-branch, so the
+        naive form pays K x (all handlers) per round; hoisting the slot
+        loop out of vmap lets ``lax.cond`` genuinely skip the (slot, type)
+        pairs that carry no messages — in steady state nearly all of them.
+        Per (node, slot) there is ONE message, so applying present types
+        one after another touches disjoint node rows and preserves the
+        per-node sequential semantics exactly."""
+        embuf = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((N, K * E) + x.shape[1:], x.dtype),
+            msgops.empty(1, proto.data_spec))
 
-        def body(k, carry):
-            row, embuf = carry
-            m = jax.tree_util.tree_map(lambda x: x[k], inbox_row)
-            hkey = prng.decision_key(key, 1000 + k)
-            branches = tuple(
-                (lambda h: lambda r: h(cfg, node_id, r, m, hkey))(h)
-                for h in handlers
-            ) + ((lambda r: noop_handler(node_id, r, m, hkey)),)
-            idx = jnp.where(m.valid, jnp.clip(m.typ, 0, n_types - 1), n_types)
-            row, em = jax.lax.switch(idx, branches, row)
+        def slot_body(k, carry):
+            state, embuf = carry
+            mk = jax.tree_util.tree_map(lambda x: x[:, k], inbox)
+            kkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(
+                dkeys, 1000 + k)
+            em_slot = msgops.empty(1, proto.data_spec)
+            em_slot = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((N, E) + x.shape[1:], x.dtype), em_slot)
+
+            for t, h in enumerate(handlers):
+                sel = mk.valid & (mk.typ == t)
+
+                def run(op, h=h, sel=sel):
+                    state, em_slot = op
+                    st2, em2 = jax.vmap(
+                        lambda i, r, m, hk: h(cfg, i, r, m, hk)
+                    )(node_ids, state, mk, kkeys)
+                    state = _sel_where(sel, st2, state)
+                    em_slot = _sel_where(sel, em2, em_slot)
+                    return state, em_slot
+
+                state, em_slot = jax.lax.cond(
+                    jnp.any(sel), run, lambda op: op, (state, em_slot))
+
             embuf = jax.tree_util.tree_map(
-                lambda b, e: jax.lax.dynamic_update_slice_in_dim(b, e, k * E, 0),
-                embuf, em)
-            return row, embuf
+                lambda b, e: jax.lax.dynamic_update_slice_in_dim(
+                    b, e, k * E, 1), embuf, em_slot)
+            return state, embuf
 
-        row, embuf = jax.lax.fori_loop(0, K, body, (row, embuf))
-        return row, embuf
+        return jax.lax.fori_loop(0, K, slot_body, (state, embuf))
 
     def step(world: World) -> Tuple[World, Dict[str, jax.Array]]:
         state, msgs, rnd = world.state, world.msgs, world.rnd
@@ -254,9 +281,9 @@ def make_step(
             now, N, K, key=route_key,
             n_channels=cfg.n_channels, parallelism=cfg.parallelism)
 
-        # -- deliver (per-node sequential, batched over N)
+        # -- deliver (per-node sequential, batched over N, type-gated)
         dkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 1)
-        state, demits = jax.vmap(node_deliver)(node_ids, state, inbox, dkeys)
+        state, demits = deliver_batch(state, inbox, dkeys, node_ids)
 
         # -- tick (timer phase)
         tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 2)
@@ -285,6 +312,13 @@ def make_step(
             "sent": out.count(),
             "inbox_overflow": overflow,
             "out_dropped": dropped,
+            # a message whose typ matches no handler (e.g. rewritten by an
+            # interposition fun) is ignored like the reference's unhandled-
+            # message log sites — but counted, never silent
+            "unhandled": jnp.sum(inbox.valid
+                                 & ((inbox.typ < 0)
+                                    | (inbox.typ >= n_types))
+                                 ).astype(jnp.int32),
         }
         if capture_wire:
             metrics.update(
