@@ -1,0 +1,58 @@
+"""Multi-host scaling (SURVEY §5.8) — the DCN half of the distributed
+communication backend.
+
+The reference scales across machines with per-peer TCP connections; the
+TPU rebuild scales across hosts with JAX's multi-controller runtime: every
+host runs the SAME program, ``jax.distributed.initialize`` wires the
+coordination service, and a global ``Mesh`` over ``jax.devices()`` (all
+hosts' devices) makes the node-axis sharding span slices — XLA routes
+intra-slice traffic over ICI and cross-slice traffic over DCN with no code
+changes to the simulator (the whole point of the mesh design in mesh.py).
+
+Single-host virtual testing: the driver validates the sharded program on
+an ``xla_force_host_platform_device_count`` CPU mesh
+(``__graft_entry__.dryrun_multichip``); this module only adds the
+initialization ceremony a real multi-host deployment needs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import NODE_AXIS, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` wrapper.  With no arguments, JAX
+    auto-detects the environment (TPU pods populate it from metadata);
+    pass explicit values for manual clusters.  Call ONCE per process,
+    before any device use."""
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+
+
+def global_mesh() -> Mesh:
+    """1-D node-axis mesh over EVERY device of EVERY host.  On a multi-
+    slice TPU deployment the axis order keeps slice-local devices adjacent
+    so most gossip traffic (node-local shards) rides ICI and only the
+    shard-boundary all-to-all crosses DCN."""
+    return make_mesh(devices=jax.devices())
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def hosts() -> int:
+    return jax.process_count()

@@ -84,6 +84,17 @@ def outstanding(row: AckRow) -> jax.Array:
     return jnp.sum(row.out_valid).astype(jnp.int32)
 
 
+def retransmit_due(valid: jax.Array, age: jax.Array,
+                   interval: int) -> Tuple[jax.Array, jax.Array]:
+    """The shared retransmit-timer step (pluggable :905-942): ages valid
+    slots, fires those at the interval, resets fired ages.  Returns
+    (new_age, due).  Used by AckedDelivery and CausalAcked so the timer
+    logic exists exactly once."""
+    age = jnp.where(valid, age + 1, 0)
+    due = valid & (age >= interval)
+    return jnp.where(due, 0, age), due
+
+
 class AckedDelivery(ProtocolBase):
     """``ctl_send`` ships an app message expecting an ack; unacked messages
     are re-sent every ``retransmit_interval`` rounds (pluggable :905-942).
@@ -129,9 +140,9 @@ class AckedDelivery(ProtocolBase):
     def tick(self, cfg, me, row: AckRow, rnd, key):
         """Retransmit timer: re-emit every outstanding slot whose age hits
         the interval; age resets on retransmission."""
-        age = jnp.where(row.out_valid, row.out_age + 1, 0)
-        due = row.out_valid & (age >= cfg.retransmit_interval)
-        row = row.replace(out_age=jnp.where(due, 0, age))
+        age, due = retransmit_due(row.out_valid, row.out_age,
+                                  cfg.retransmit_interval)
+        row = row.replace(out_age=age)
         em = self.emit(jnp.where(due, row.out_dst, -1),
                        self.typ("app"), cap=self.tick_emit_cap,
                        payload=row.out_payload, seq=row.out_seq)

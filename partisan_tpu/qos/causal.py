@@ -37,6 +37,7 @@ from ..config import Config
 from ..engine import ProtocolBase
 from ..ops import ring
 from ..ops.msg import Msgs
+from . import ack as ack_mod
 from . import vclock
 
 
@@ -51,6 +52,13 @@ class CausalRow:
     pend_dep: jax.Array    # [B, A] dependency clock
     pend_has_dep: jax.Array  # [B] bool
     pend_clock: jax.Array  # [B, A] message clock
+    pend_seq: jax.Array    # [B] sender-scoped wire seq (0 = unsequenced)
+    last_seq: jax.Array    # [A] highest seq delivered per sender — valid
+                           # dedup identity because delivery per
+                           # (src -> me) stream is FIFO (each message's
+                           # dep is the previous send to me), unlike a
+                           # clock-descends check which transitive clock
+                           # advancement via third nodes defeats
     log: jax.Array         # [L] first L delivered payloads, delivery order
     log_src: jax.Array     # [L] their senders
     log_n: jax.Array       # scalar int32 TOTAL delivered count (may exceed L;
@@ -73,6 +81,8 @@ def init_rows(n_nodes: int, buf_cap: int = 8, log_cap: int = 16) -> CausalRow:
         pend_dep=jnp.zeros((n, buf_cap, a), jnp.int32),
         pend_has_dep=jnp.zeros((n, buf_cap), bool),
         pend_clock=jnp.zeros((n, buf_cap, a), jnp.int32),
+        pend_seq=jnp.zeros((n, buf_cap), jnp.int32),
+        last_seq=jnp.zeros((n, a), jnp.int32),
         log=jnp.full((n, log_cap), -1, jnp.int32),
         log_src=jnp.full((n, log_cap), -1, jnp.int32),
         log_n=jnp.zeros((n,), jnp.int32),
@@ -96,12 +106,18 @@ def emit(row: CausalRow, me: jax.Array, dst: jax.Array
     return row, dep, has_dep, clock
 
 
-def receive(row: CausalRow, src, payload, dep, has_dep, clock
-            ) -> Tuple[CausalRow, jax.Array]:
+def receive(row: CausalRow, src, payload, dep, has_dep, clock,
+            seq=None) -> Tuple[CausalRow, jax.Array]:
     """Buffer an incoming causal message (:143-154).  Returns (row',
     dropped) — dropped is True when the pending ring is full (the reference
-    buffers unboundedly; fixed shapes force an explicit overflow signal)."""
+    buffers unboundedly; fixed shapes force an explicit overflow signal).
+    ``seq`` > 0 enables retransmission dedup (CausalAcked); an
+    already-delivered seq is ignored without counting as a drop."""
+    seq = jnp.int32(0) if seq is None else seq
+    dup = (seq > 0) & (seq <= row.last_seq[jnp.clip(
+        src, 0, row.last_seq.shape[0] - 1)])
     ok, slot = ring.alloc(row.pend_valid)
+    ok = ok & ~dup
     wr = lambda a, v: ring.masked_set(a, slot, ok, v)
     row = row.replace(
         pend_valid=wr(row.pend_valid, True),
@@ -110,9 +126,11 @@ def receive(row: CausalRow, src, payload, dep, has_dep, clock
         pend_dep=wr(row.pend_dep, dep),
         pend_has_dep=wr(row.pend_has_dep, has_dep),
         pend_clock=wr(row.pend_clock, clock),
-        pend_dropped=row.pend_dropped + (~ok).astype(jnp.int32),
+        pend_seq=wr(row.pend_seq, seq),
+        pend_dropped=row.pend_dropped
+        + (~ok & ~dup).astype(jnp.int32),
     )
-    return row, ~ok
+    return row, ~ok & ~dup
 
 
 def drain(row: CausalRow, me: jax.Array) -> Tuple[CausalRow, jax.Array]:
@@ -126,6 +144,14 @@ def drain(row: CausalRow, me: jax.Array) -> Tuple[CausalRow, jax.Array]:
 
     def try_slot(i, carry):
         row, n = carry
+        # retransmission dedup (sequenced messages only): a pending entry
+        # whose seq was already delivered for its sender is a duplicate
+        # that crossed its ack — drop without delivering or counting
+        src_i = jnp.clip(row.pend_src[i], 0, row.last_seq.shape[0] - 1)
+        dup = row.pend_valid[i] & (row.pend_seq[i] > 0) \
+            & (row.pend_seq[i] <= row.last_seq[src_i])
+        row = row.replace(pend_valid=row.pend_valid.at[i].set(
+            row.pend_valid[i] & ~dup))
         deliverable = row.pend_valid[i] & (
             ~row.pend_has_dep[i]
             | vclock.dominates(row.vc, row.pend_dep[i]))
@@ -141,6 +167,10 @@ def drain(row: CausalRow, me: jax.Array) -> Tuple[CausalRow, jax.Array]:
             log_src=row.log_src.at[li].set(jnp.where(
                 record, row.pend_src[i], row.log_src[li])),
             log_n=row.log_n + deliverable.astype(jnp.int32),
+            last_seq=row.last_seq.at[src_i].set(jnp.where(
+                deliverable,
+                jnp.maximum(row.last_seq[src_i], row.pend_seq[i]),
+                row.last_seq[src_i])),
         )
         return row, n + deliverable.astype(jnp.int32)
 
@@ -148,6 +178,25 @@ def drain(row: CausalRow, me: jax.Array) -> Tuple[CausalRow, jax.Array]:
     row, n = jax.lax.fori_loop(0, B, try_slot, (row, n0))
     row, n = jax.lax.fori_loop(0, B, try_slot, (row, n))
     return row, n
+
+
+@struct.dataclass
+class CausalAckedRow:
+    causal: CausalRow
+    # reemit storage: the wire copy of every unacked causal message
+    # (causality_backend stores each emitted message for reemit :107-113,
+    # 134-136; the manager's retransmit loop re-sends it, pluggable
+    # :905-942)
+    out_valid: jax.Array   # [R]
+    out_dst: jax.Array     # [R]
+    out_payload: jax.Array  # [R]
+    out_dep: jax.Array     # [R, A]
+    out_has_dep: jax.Array  # [R]
+    out_clock: jax.Array   # [R, A]
+    out_seq: jax.Array     # [R]
+    out_age: jax.Array     # [R]
+    next_seq: jax.Array    # scalar
+    send_dropped: jax.Array  # scalar — full-ring losses, surfaced
 
 
 class CausalDelivery(ProtocolBase):
@@ -193,3 +242,100 @@ class CausalDelivery(ProtocolBase):
     def tick(self, cfg, me, row: CausalRow, rnd, key):
         row, _ = drain(row, me)
         return row, self.no_emit(self.tick_emit_cap)
+
+
+class CausalAcked(CausalDelivery):
+    """The `with_causal_send_and_ack` suite-group composition
+    (test/partisan_SUITE.erl groups; pluggable :693-741): causal messages
+    are also parked for acknowledgement and the retransmit timer REEMITS
+    the stored wire copy — byte-identical dependency clock and message
+    clock, which is why the backend stores emitted messages instead of
+    re-stamping (causality_backend reemit :107-113).  At-least-once +
+    causal order: duplicates are buffered again but their clocks are
+    already dominated, so delivery stays exactly-once per clock."""
+
+    msg_types = ("causal", "causal_ack", "ctl_csend")
+
+    def __init__(self, cfg: Config, buf_cap: int = 8, log_cap: int = 16,
+                 ring_cap: int = 8):
+        super().__init__(cfg, buf_cap, log_cap)
+        self.R = ring_cap
+        self.data_spec = dict(self.data_spec)
+        self.data_spec["seq"] = ((), jnp.int32)
+        self.tick_emit_cap = ring_cap
+
+    def init(self, cfg: Config, key: jax.Array) -> CausalAckedRow:
+        n, a, r = cfg.n_nodes, cfg.n_nodes, self.R
+        return CausalAckedRow(
+            causal=super().init(cfg, key),
+            out_valid=jnp.zeros((n, r), bool),
+            out_dst=jnp.zeros((n, r), jnp.int32),
+            out_payload=jnp.zeros((n, r), jnp.int32),
+            out_dep=jnp.zeros((n, r, a), jnp.int32),
+            out_has_dep=jnp.zeros((n, r), bool),
+            out_clock=jnp.zeros((n, r, a), jnp.int32),
+            out_seq=jnp.zeros((n, r), jnp.int32),
+            out_age=jnp.zeros((n, r), jnp.int32),
+            next_seq=jnp.ones((n,), jnp.int32),
+            send_dropped=jnp.zeros((n,), jnp.int32),
+        )
+
+    def handle_ctl_csend(self, cfg, me, row: CausalAckedRow, m: Msgs, key):
+        dst = m.data["peer"]
+        # allocate the reemit slot FIRST: on a full ring the send must not
+        # happen at all — stamping the clock/order-buffer for a message
+        # that never reaches the wire would wedge every later message to
+        # this destination behind an unsatisfiable dependency
+        ok, slot = ring.alloc(row.out_valid)
+        crow, dep, has_dep, clock = emit(row.causal, me, dst)
+        crow = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), crow, row.causal)
+        seq = row.next_seq
+        wr = lambda a_, v: ring.masked_set(a_, slot, ok, v)
+        row = row.replace(
+            causal=crow,
+            out_valid=wr(row.out_valid, True),
+            out_dst=wr(row.out_dst, dst),
+            out_payload=wr(row.out_payload, m.data["payload"]),
+            out_dep=wr(row.out_dep, dep),
+            out_has_dep=wr(row.out_has_dep, has_dep),
+            out_clock=wr(row.out_clock, clock),
+            out_seq=wr(row.out_seq, seq),
+            out_age=wr(row.out_age, 0),
+            next_seq=seq + ok.astype(jnp.int32),
+            send_dropped=row.send_dropped + (~ok).astype(jnp.int32),
+        )
+        em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("causal"),
+                       payload=m.data["payload"], dep=dep,
+                       has_dep=has_dep.astype(jnp.int32), clock=clock,
+                       seq=seq, delay=m.data["cdelay"])
+        return row, em
+
+    def handle_causal(self, cfg, me, row: CausalAckedRow, m: Msgs, key):
+        # seq-based dedup lives in receive()/drain(); a message LOST to a
+        # full pending ring must NOT be acked — the sender's reemit timer
+        # is the recovery path for exactly that case
+        crow, dropped = receive(row.causal, m.src, m.data["payload"],
+                                m.data["dep"], m.data["has_dep"] > 0,
+                                m.data["clock"], seq=m.data["seq"])
+        ack_rep = self.emit(jnp.where(dropped, -1, m.src)[None],
+                            self.typ("causal_ack"), seq=m.data["seq"])
+        return row.replace(causal=crow), ack_rep
+
+    def handle_causal_ack(self, cfg, me, row: CausalAckedRow, m: Msgs, key):
+        hit = row.out_valid & (row.out_seq == m.data["seq"])
+        return row.replace(out_valid=row.out_valid & ~hit), self.no_emit()
+
+    def tick(self, cfg, me, row: CausalAckedRow, rnd, key):
+        crow, _ = drain(row.causal, me)
+        row = row.replace(causal=crow)
+        # reemit the stored wire copies of unacked messages
+        age, due = ack_mod.retransmit_due(row.out_valid, row.out_age,
+                                          cfg.retransmit_interval)
+        row = row.replace(out_age=age)
+        em = self.emit(jnp.where(due, row.out_dst, -1),
+                       self.typ("causal"), cap=self.tick_emit_cap,
+                       payload=row.out_payload, dep=row.out_dep,
+                       has_dep=row.out_has_dep.astype(jnp.int32),
+                       clock=row.out_clock, seq=row.out_seq)
+        return row, em

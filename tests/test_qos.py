@@ -100,6 +100,75 @@ class TestCausal:
         assert int(world.state.log_n[2]) == 1
 
 
+class TestCausalAcked:
+    """with_causal_send_and_ack: causal order + retransmission together."""
+
+    def _world(self, drop_rounds=0, retransmit_interval=3):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8,
+                        retransmit_interval=retransmit_interval)
+        from partisan_tpu.qos.causal import CausalAcked
+        proto = CausalAcked(cfg)
+        interpose = None
+        if drop_rounds:
+            def interpose(m, rnd):
+                drop = (m.typ == proto.typ("causal")) & (rnd < drop_rounds)
+                return m.replace(valid=m.valid & ~drop)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=interpose)
+        return cfg, proto, world, step
+
+    def test_causal_order_through_omission(self):
+        """Both messages' first transmissions dropped; reemit must deliver
+        them IN ORDER (the stored wire copy keeps the original dependency
+        clock, causality_backend reemit :107-113)."""
+        cfg, proto, world, step = self._world(drop_rounds=4)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=1, cdelay=0)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=2, cdelay=0)
+        for _ in range(20):
+            world, _ = step(world)
+        c = world.state.causal
+        assert int(c.log_n[2]) == 2
+        assert list(np.asarray(c.log[2])[:2]) == [1, 2]
+        # ring cleared after acks
+        assert not np.asarray(world.state.out_valid[0]).any()
+
+    def test_no_duplicate_delivery(self):
+        """Retransmissions that cross their ack must not double-deliver
+        (per-stream seq dedup); interval 1 guarantees a crossing reemit."""
+        cfg, proto, world, step = self._world(retransmit_interval=1)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=7, cdelay=0)
+        for _ in range(12):
+            world, _ = step(world)
+        assert int(world.state.causal.log_n[2]) == 1
+
+    def test_transitive_clock_advance_not_marked_duplicate(self):
+        """The reviewer's repro for the clock-descends dedup bug: r's
+        clock advances transitively (via t) past m2's clock before m2 can
+        deliver; m2 and the delayed m1 must still deliver, in order."""
+        cfg, proto, world, step = self._world()
+        s, t, r = 0, 1, 2
+        world = send_ctl(world, proto, s, "ctl_csend", peer=r,
+                         payload=1, cdelay=10)            # m1 delayed
+        world = send_ctl(world, proto, s, "ctl_csend", peer=r,
+                         payload=2, cdelay=0)             # m2 pends on m1
+        world = send_ctl(world, proto, s, "ctl_csend", peer=t,
+                         payload=3, cdelay=0)             # m3 -> t
+        for _ in range(4):
+            world, _ = step(world)
+        world = send_ctl(world, proto, t, "ctl_csend", peer=r,
+                         payload=4, cdelay=0)             # m4 advances r
+        for _ in range(20):
+            world, _ = step(world)
+        c = world.state.causal
+        assert int(c.log_n[r]) == 3, int(c.log_n[r])
+        log = list(np.asarray(c.log[r])[:3])
+        assert log.index(1) < log.index(2), log  # m1 before m2
+
+
 # ------------------------------------------------------------------- ack
 
 class TestAck:

@@ -118,6 +118,57 @@ def agreement_and_termination(world) -> bool:
 
 
 class TestModelChecker:
+    def test_ctp_termination_fixes_2pc_blocking(self):
+        """The same single-omission sweep that fails 2PC three times must
+        pass ENTIRELY for Bernstein CTP: the cooperative-termination
+        sub-protocol recovers every dropped commit (the reason the
+        reference model-checks ctp separately — 'bernstein_ctp Passed: 11'
+        Makefile:108)."""
+        from partisan_tpu.models.commit import BernsteinCTP
+        n = 3
+        cfg = pt.Config(n_nodes=n, inbox_cap=2 * n)
+        proto = BernsteinCTP(cfg)
+
+        def setup(world):
+            return send_ctl(world, proto, 0, "ctl_broadcast", value=5)
+
+        mc = ModelChecker(cfg, proto, setup, agreement_and_termination,
+                          n_rounds=44)
+        typs = [proto.typ(t) for t in
+                ("prepare", "prepared", "commit", "commit_ack")]
+        res = mc.check(candidate_typs=typs, max_drops=1)
+        assert res.golden.invariant_ok
+        assert res.failed == 0, res.failures
+        assert res.passed == 4 * n
+
+    def test_3pc_uncertainty_window_found(self):
+        """3PC fixes 2PC's *blocking* (dropped `commit` recovers via the
+        unilateral precommit timeout) but the checker must find the
+        classical Skeen inconsistency instead: drop a `precommit` and the
+        still-PREPARED participant unilaterally aborts while precommitted
+        peers unilaterally commit — mixed decisions.  The reference CI
+        expects failing schedules for skeen_3pc too (Makefile:111-113)."""
+        from partisan_tpu.models.commit import Skeen3PC
+        n = 3
+        cfg = pt.Config(n_nodes=n, inbox_cap=2 * n)
+        proto = Skeen3PC(cfg)
+
+        def setup(world):
+            return send_ctl(world, proto, 0, "ctl_broadcast", value=5)
+
+        mc = ModelChecker(cfg, proto, setup, agreement_and_termination,
+                          n_rounds=44)
+        typs = [proto.typ(t) for t in
+                ("prepare", "prepared", "precommit", "precommit_ack",
+                 "commit", "commit_ack")]
+        res = mc.check(candidate_typs=typs, max_drops=1)
+        assert res.golden.invariant_ok
+        precommit_t = proto.typ("precommit")
+        failing_typs = {k[3] for (k,) in res.failures}
+        assert failing_typs == {precommit_t}, res.failures
+        assert res.failed == n       # one uncertainty window per dst
+        assert res.passed == 5 * n   # incl. dropped commits: 3PC unblocks
+
     def test_finds_2pc_blocking_schedules(self):
         """Single-omission sweep over lampson_2pc protocol messages: the
         checker must find exactly the three blocked-participant schedules
