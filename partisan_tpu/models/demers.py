@@ -291,13 +291,24 @@ def make_rumor_step(n: int, fanout: int = 2, stop_k: int = 1,
         new_hot = w.hot | newly
 
         # -- feedback: pushing to an already-infected peer kills interest
-        #    w.p. 1/stop_k (evaluated on the first lane, as one push-ack)
-        coin = jax.random.uniform(k_coin, (n,)) < (1.0 / stop_k)
-        new_hot = new_hot & ~(dup & coin)
+        #    w.p. 1/stop_k (evaluated on the first lane, as one push-ack);
+        #    stop_k == 1 is a sure coin — no draw needed
+        if stop_k <= 1:
+            new_hot = new_hot & ~dup
+        else:
+            coin = jax.random.bits(k_coin, (n,), jnp.uint16) \
+                < min(max(1, round(65536 / stop_k)), 65535)
+            new_hot = new_hot & ~(dup & coin)
 
-        # -- churn: replace a fraction of rows with fresh susceptible nodes
+        # -- churn: replace a fraction of rows with fresh susceptible
+        #    nodes; drawn as uint16 lanes (half the threefry words of a
+        #    float32 draw — the single heaviest op of the round) with
+        #    churn quantized to m/65536 (error < 8e-6 absolute)
         if churn > 0.0:
-            reborn = jax.random.uniform(k_churn, (n,)) < churn
+            # clamp below 2^16: a threshold of exactly 65536 would wrap
+            # against the uint16 lanes and disable churn outright
+            thresh = min(max(1, round(churn * 65536)), 65535)
+            reborn = jax.random.bits(k_churn, (n,), jnp.uint16) < thresh
             new_infected = new_infected & ~reborn
             new_hot = new_hot & ~reborn
 

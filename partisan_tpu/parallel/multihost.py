@@ -22,7 +22,7 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh
 
-from .mesh import NODE_AXIS, make_mesh
+from .mesh import make_mesh
 
 
 def initialize(coordinator_address: Optional[str] = None,

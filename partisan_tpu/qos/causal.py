@@ -52,13 +52,15 @@ class CausalRow:
     pend_dep: jax.Array    # [B, A] dependency clock
     pend_has_dep: jax.Array  # [B] bool
     pend_clock: jax.Array  # [B, A] message clock
-    pend_seq: jax.Array    # [B] sender-scoped wire seq (0 = unsequenced)
-    last_seq: jax.Array    # [A] highest seq delivered per sender — valid
-                           # dedup identity because delivery per
-                           # (src -> me) stream is FIFO (each message's
-                           # dep is the previous send to me), unlike a
-                           # clock-descends check which transitive clock
-                           # advancement via third nodes defeats
+    pend_seq: jax.Array    # [B] per-STREAM wire seq (0 = unsequenced)
+    last_seq: jax.Array    # [A] last seq delivered per sender.  Seqs are
+                           # allocated per (sender -> dst) stream, so they
+                           # are CONTIGUOUS here, and drain() delivers
+                           # sequenced messages strictly in seq order —
+                           # dependency dominance alone does NOT give
+                           # per-stream FIFO (a third node's clock can
+                           # satisfy m2's dep before m1 arrives), and a
+                           # clock-descends dup check fails the same way
     log: jax.Array         # [L] first L delivered payloads, delivery order
     log_src: jax.Array     # [L] their senders
     log_n: jax.Array       # scalar int32 TOTAL delivered count (may exceed L;
@@ -152,7 +154,12 @@ def drain(row: CausalRow, me: jax.Array) -> Tuple[CausalRow, jax.Array]:
             & (row.pend_seq[i] <= row.last_seq[src_i])
         row = row.replace(pend_valid=row.pend_valid.at[i].set(
             row.pend_valid[i] & ~dup))
-        deliverable = row.pend_valid[i] & (
+        # sequenced messages additionally deliver in exact stream order
+        # (seq == last+1): dominance alone would let a successor overtake
+        # a delayed predecessor via transitive clock advancement
+        in_order = (row.pend_seq[i] == 0) \
+            | (row.pend_seq[i] == row.last_seq[src_i] + 1)
+        deliverable = row.pend_valid[i] & in_order & (
             ~row.pend_has_dep[i]
             | vclock.dominates(row.vc, row.pend_dep[i]))
         new_vc = vclock.increment(vclock.merge(row.vc, row.pend_clock[i]), me)
@@ -195,7 +202,8 @@ class CausalAckedRow:
     out_clock: jax.Array   # [R, A]
     out_seq: jax.Array     # [R]
     out_age: jax.Array     # [R]
-    next_seq: jax.Array    # scalar
+    next_seq_to: jax.Array  # [A] per-destination stream seq source (so
+                            # seqs per (me -> dst) stream are contiguous)
     send_dropped: jax.Array  # scalar — full-ring losses, surfaced
 
 
@@ -276,7 +284,7 @@ class CausalAcked(CausalDelivery):
             out_clock=jnp.zeros((n, r, a), jnp.int32),
             out_seq=jnp.zeros((n, r), jnp.int32),
             out_age=jnp.zeros((n, r), jnp.int32),
-            next_seq=jnp.ones((n,), jnp.int32),
+            next_seq_to=jnp.ones((n, a), jnp.int32),
             send_dropped=jnp.zeros((n,), jnp.int32),
         )
 
@@ -290,7 +298,8 @@ class CausalAcked(CausalDelivery):
         crow, dep, has_dep, clock = emit(row.causal, me, dst)
         crow = jax.tree_util.tree_map(
             lambda new, old: jnp.where(ok, new, old), crow, row.causal)
-        seq = row.next_seq
+        d = jnp.clip(dst, 0, row.next_seq_to.shape[0] - 1)
+        seq = row.next_seq_to[d]
         wr = lambda a_, v: ring.masked_set(a_, slot, ok, v)
         row = row.replace(
             causal=crow,
@@ -302,7 +311,7 @@ class CausalAcked(CausalDelivery):
             out_clock=wr(row.out_clock, clock),
             out_seq=wr(row.out_seq, seq),
             out_age=wr(row.out_age, 0),
-            next_seq=seq + ok.astype(jnp.int32),
+            next_seq_to=row.next_seq_to.at[d].add(ok.astype(jnp.int32)),
             send_dropped=row.send_dropped + (~ok).astype(jnp.int32),
         )
         em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("causal"),

@@ -146,10 +146,12 @@ class TestCausalAcked:
         assert int(world.state.causal.log_n[2]) == 1
 
     def test_transitive_clock_advance_not_marked_duplicate(self):
-        """The reviewer's repro for the clock-descends dedup bug: r's
-        clock advances transitively (via t) past m2's clock before m2 can
-        deliver; m2 and the delayed m1 must still deliver, in order."""
-        cfg, proto, world, step = self._world()
+        """Transitive-dominance repro: r's clock advances via t past m2's
+        clock before m1 arrives.  Per-stream seq ordering must hold m2
+        until the delayed m1 delivers, and m1 must never be treated as a
+        duplicate.  retransmit_interval is long so a reemit cannot mask
+        the loss."""
+        cfg, proto, world, step = self._world(retransmit_interval=50)
         s, t, r = 0, 1, 2
         world = send_ctl(world, proto, s, "ctl_csend", peer=r,
                          payload=1, cdelay=10)            # m1 delayed
