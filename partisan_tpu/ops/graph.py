@@ -48,6 +48,41 @@ def is_connected(adj: jax.Array, alive: jax.Array | None = None) -> jax.Array:
     return jnp.all(pair_ok)
 
 
+def build_tree(n: int, arity: int, root: int = 0) -> jax.Array:
+    """Deterministic n-ary spanning tree over the node-id table — the
+    ``partisan_util:build_tree/3`` primitive (:47-63, duplicated in
+    partisan_plumtree_util; the no-``cycles`` mode — leaf back-edges are
+    not reproduced).  A static relay topology for tree-forwarding over
+    the member list; note the reference's own ``do_tree_forward`` takes
+    its outlinks from the live plumtree eager set, not from this.
+
+    Returns ``[n, arity]`` children ids (-1 pad): ids are arranged in
+    breadth-first heap order rotated so ``root`` is the tree root — every
+    node's children are ``root + arity*k + 1 .. + arity`` in rotated id
+    space, the shape the reference builds by folding the sorted member
+    list.  ``arity >= 1``.
+    """
+    if arity < 1:
+        raise ValueError(f"arity must be >= 1, got {arity}")
+    ids = jnp.arange(n)
+    pos = (ids - root) % n                      # heap position of each id
+    child_pos = pos[:, None] * arity + jnp.arange(1, arity + 1)[None, :]
+    ok = child_pos < n
+    children = (jnp.clip(child_pos, 0, n - 1) + root) % n
+    return jnp.where(ok, children, -1).astype(jnp.int32)
+
+
+def tree_parent(n: int, arity: int, root: int = 0) -> jax.Array:
+    """[n] parent ids (-1 for the root) of the same tree; ``arity >= 1``."""
+    if arity < 1:
+        raise ValueError(f"arity must be >= 1, got {arity}")
+    ids = jnp.arange(n)
+    pos = (ids - root) % n
+    ppos = (pos - 1) // arity
+    parent = (ppos + root) % n
+    return jnp.where(pos == 0, -1, parent).astype(jnp.int32)
+
+
 def is_symmetric(adj: jax.Array, alive: jax.Array | None = None) -> jax.Array:
     """Active-view symmetry: i in active(j) iff j in active(i)
     (partisan_SUITE:2083-2109)."""

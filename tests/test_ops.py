@@ -209,3 +209,27 @@ class TestGraph:
         adj = graph.adjacency_from_views(views, 4)
         alive = jnp.asarray([True, True, False, False])
         assert bool(graph.is_connected(adj, alive))
+
+
+class TestBuildTree:
+    """partisan_util:build_tree/3 analog (ops/graph.py)."""
+
+    def test_spanning_and_acyclic(self):
+        n, arity, root = 13, 3, 5
+        ch = np.asarray(graph.build_tree(n, arity, root))
+        par = np.asarray(graph.tree_parent(n, arity, root))
+        assert par[root] == -1
+        # every non-root has exactly one parent, and parent/child agree
+        seen = set()
+        for p in range(n):
+            for c in ch[p]:
+                if c >= 0:
+                    assert par[c] == p
+                    assert c not in seen
+                    seen.add(int(c))
+        assert seen == set(range(n)) - {root}
+
+    def test_arity_bound(self):
+        ch = np.asarray(graph.build_tree(16, 2, 0))
+        assert ((ch >= 0).sum(axis=1) <= 2).all()
+        assert (ch >= 0).sum() == 15
