@@ -46,9 +46,10 @@ class Execution:
 class CheckResult:
     passed: int
     failed: int
-    pruned: int
+    pruned: int                       # naive combinations never generated
     failures: List[Tuple[Key, ...]]   # failing schedules
     golden: Execution
+    pruned_independent: int = 0       # extensions skipped by annotations
 
     @property
     def explored(self) -> int:
@@ -99,10 +100,19 @@ class ModelChecker:
 
     def check(self, candidate_typs: Optional[Iterable[int]] = None,
               max_drops: int = 1,
-              max_schedules: int = 1000) -> CheckResult:
+              max_schedules: int = 1000,
+              annotations: Optional[Dict[str, list]] = None) -> CheckResult:
         """Enumerate and replay omission schedules up to ``max_drops``
         simultaneous omissions (the powerset walk of :697-930, breadth
-        first, causally pruned)."""
+        first, causally pruned).
+
+        ``annotations`` (a causality map from verify/analysis.py) enables
+        the reference's independence pruning (:697-930 prune via the
+        annotation files): a schedule extension whose type is causally
+        UNRELATED to every already-scheduled omission explores a redundant
+        combination — the faults compose independently, so the pair's
+        outcome is implied by the singletons — and is skipped (counted in
+        ``pruned_independent``)."""
         golden = self.execute(())
         if not golden.invariant_ok:
             return CheckResult(0, 1, 0, [()], golden)
@@ -117,7 +127,22 @@ class ModelChecker:
                     out.append(k)
             return out
 
-        passed = failed = pruned = 0
+        # independence pruning setup: map typ index <-> name, precompute
+        # per-type causal neighborhoods (related = one can reach the other)
+        related = None
+        if annotations is not None:
+            from .analysis import reachable_types
+            names = list(self.proto.msg_types)
+            reach = {t: reachable_types(annotations, [t]) for t in names}
+            # proto.typ() (not names.index) so _typ_offset-bearing
+            # protocols key `related` by their actual wire tags
+            related = {
+                (self.proto.typ(a), self.proto.typ(b))
+                for a in names for b in names
+                if a in reach.get(b, ()) or b in reach.get(a, ())}
+
+        passed = failed = 0
+        pruned_indep = 0
         failures: List[Tuple[Key, ...]] = []
         # frontier: schedule -> execution whose wire feeds its children
         frontier: List[Tuple[Tuple[Key, ...], Execution]] = [((), golden)]
@@ -132,6 +157,10 @@ class ModelChecker:
                         continue
                     # only extend forward in time to avoid permuted dupes
                     if sched and k <= max(sched):
+                        continue
+                    if related is not None and sched and not any(
+                            (k[3], s[3]) in related for s in sched):
+                        pruned_indep += 1
                         continue
                     if budget <= 0:
                         break
@@ -153,5 +182,9 @@ class ModelChecker:
         all_keys = cands(golden.wire_keys)
         for d in range(1, max_drops + 1):
             naive += sum(1 for _ in itertools.combinations(all_keys, d))
+        # `pruned` counts golden-trace combinations never generated;
+        # `pruned_indep` counts skipped extensions drawn from (possibly
+        # divergent) CHILD traces — different universes, reported apart
         pruned = max(naive - (passed + failed), 0)
-        return CheckResult(passed, failed, pruned, failures, golden)
+        return CheckResult(passed, failed, pruned, failures, golden,
+                           pruned_independent=pruned_indep)
