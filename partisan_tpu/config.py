@@ -88,6 +88,16 @@ class Config:
     # (per-handler emission caps live on each protocol class, which alone
     # knows its fan-out; only the shared routing cap lives here)
     inbox_cap: int = 16                # max messages a node processes per round
+    deliver_gather_cap: Optional[int] = None
+    # ^ sparse-delivery gather width G: when set (and < n_nodes), each
+    #   (inbox-slot, msg-type) dispatch gathers only the <= G receiving node
+    #   rows and runs the handler over those, falling back to the dense
+    #   full-batch path when more than G nodes hold that type this slot.
+    #   Steady-state gossip touches few nodes per type per round, so this
+    #   turns the deliver phase from O(N · handlers-present) into
+    #   O(G · handlers-present) — the big-N engine knob (BASELINE round-1
+    #   notes).  None = always dense (bit-identical results either way;
+    #   handlers see the same per-node PRNG keys on both paths).
 
     # --- determinism --------------------------------------------------------
     seed: int = 1                      # per-node keys derive from this (support :163-166)

@@ -1,0 +1,116 @@
+"""Echo throughput workload — the reference's ``performance_test``
+(test/partisan_SUITE.erl:1029-1136) rebuilt: two nodes exchange ``total``
+echo messages of ``size_words`` payload each, over ``concurrency``
+independent sender/receiver streams, optionally across ``cfg.parallelism``
+connection lanes and with an emulated round-trip delay (the ``tc netem``
+RTT axis of bin/perf-suite.sh).
+
+Mapping:
+  * one stream  = one sender/receiver pair of the reference (CONCURRENCY);
+    all streams live as lanes of the two nodes' state rows — one batched
+    step drives every stream at once;
+  * SIZE        = ``size_words`` int32 words of payload carried by each
+    ping/pong (the reference sends binaries of SIZE KB);
+  * RTT         = ``rtt`` simulated rounds of delay stamped on each hop
+    (the engine holds delayed messages exactly ``delay`` rounds);
+  * a stream keeps ONE message in flight (the reference's echo loop:
+    send, block for the echo, send the next — :1047-1075).
+
+Throughput = streams-completed-messages / wall-time, reported by
+scripts/perf_suite.py as the ``results.csv`` analog.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops.msg import Msgs
+
+
+@struct.dataclass
+class EchoState:
+    started: jax.Array      # [N] bool — ctl_start received (sender only)
+    sent: jax.Array         # [N, C] completed echoes per stream
+    outstanding: jax.Array  # [N, C] bool — ping in flight per stream
+    checksum: jax.Array     # [N] uint32 — payload integrity fold
+
+
+class Echo(ProtocolBase):
+    """Node 0 drives ``concurrency`` echo streams against node 1."""
+
+    msg_types = ("ping", "pong", "ctl_start")
+
+    def __init__(self, cfg: Config, concurrency: int = 1,
+                 size_words: int = 256, total: int = 100, rtt: int = 0):
+        self.cfg = cfg
+        self.C = concurrency
+        self.S = size_words
+        self.total = total
+        self.rtt = rtt
+        self.data_spec: Dict = {
+            "payload": ((size_words,), jnp.int32),
+            "stream": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+            # stream id doubles as the partition key, pinning each stream
+            # to one connection lane under cfg.parallelism > 1 (the
+            # reference's partition-key dispatch, partisan_util.erl:190-195)
+            "partition_key": ((), jnp.int32),
+        }
+        self.emit_cap = 1               # each ping answers with one pong
+        self.tick_emit_cap = concurrency
+
+    def init(self, cfg: Config, key: jax.Array) -> EchoState:
+        n = cfg.n_nodes
+        return EchoState(
+            started=jnp.zeros((n,), bool),
+            sent=jnp.zeros((n, self.C), jnp.int32),
+            outstanding=jnp.zeros((n, self.C), bool),
+            checksum=jnp.zeros((n,), jnp.uint32),
+        )
+
+    def done(self, world) -> jax.Array:
+        return (world.state.sent[0] >= self.total).all()
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_ctl_start(self, cfg, me, row: EchoState, m: Msgs, key):
+        return row.replace(started=jnp.asarray(True)), self.no_emit()
+
+    def handle_ping(self, cfg, me, row: EchoState, m: Msgs, key):
+        """Receiver side: fold the payload into a checksum (forces the
+        bytes to be read, like the reference's binary round-trip) and echo
+        it back on the same stream/lane."""
+        ck = row.checksum + jnp.sum(
+            m.data["payload"].astype(jnp.uint32)) + jnp.uint32(1)
+        em = self.emit(m.src[None], self.typ("pong"),
+                       delay=self.rtt,
+                       payload=m.data["payload"], stream=m.data["stream"],
+                       partition_key=m.data["stream"])
+        return row.replace(checksum=ck), em
+
+    def handle_pong(self, cfg, me, row: EchoState, m: Msgs, key):
+        s = m.data["stream"]
+        row = row.replace(
+            sent=row.sent.at[s].add(1),
+            outstanding=row.outstanding.at[s].set(False))
+        return row, self.no_emit()
+
+    # ------------------------------------------------------------------ timer
+
+    def tick(self, cfg, me, row: EchoState, rnd, key):
+        is_sender = (me == 0) & row.started
+        c = jnp.arange(self.C, dtype=jnp.int32)
+        fire = is_sender & ~row.outstanding & (row.sent < self.total)
+        payload = (jnp.arange(self.S, dtype=jnp.int32)[None, :]
+                   + c[:, None] + rnd)
+        em = self.emit(jnp.where(fire, 1, -1), self.typ("ping"),
+                       cap=self.C, delay=self.rtt,
+                       stream=c, payload=payload, partition_key=c)
+        row = row.replace(outstanding=row.outstanding | fire)
+        return row, em

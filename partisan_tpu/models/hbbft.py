@@ -1,0 +1,336 @@
+"""HBBFT-style chain worker — TPU-native rebuild of the reference's
+``src/partisan_hbbft_worker.erl`` test workload (SURVEY §2.9).
+
+The reference worker wraps an external ``hbbft`` library (threshold-crypto
+asynchronous common subset) and exposes a small surface the property tests
+drive: ``submit_transaction/2``, ``get_blocks/1``, ``get_buf/1``,
+``get_status/1``, chain catch-up via ``sync/2`` + ``fetch_from/2``, and the
+host-side ``verify_chain/2`` validator (partisan_hbbft_worker.erl:36-108).
+What the tests actually assert is the *contract*: correct nodes build the
+same chain of blocks, every block links to its predecessor, committed
+transactions come from submitted ones, and nodes that fall behind catch up.
+
+This rebuild keeps that contract but replaces the (external, crypto-heavy)
+ACS with a round-native atomic broadcast that vectorizes over all N nodes:
+
+  * epochs are a STATIC schedule: epoch ``e = round // epoch_len`` with
+    leader ``e mod N`` (the reference's ``start_on_demand`` trigger becomes
+    this fixed cadence — every epoch starts on schedule);
+  * phase 0: the leader broadcasts ``propose(epoch, batch)`` drawn from its
+    transaction buffer (``hbbft:input`` buffering);
+  * on receipt every node stores the batch and broadcasts
+    ``echo(epoch, digest)`` — one echo per node per epoch;
+  * a node COMMITS the epoch's block once it holds the batch and ``N - f``
+    echoes (``f = (N-1) div 3``), writing ``(digest, batch)`` into an
+    epoch-indexed ledger and dropping the batch's transactions from its
+    buffer (the reference removes block transactions from ``buf`` on every
+    ``new_epoch``);
+  * blocks are chained by a running hash fold over committed epochs — the
+    ``prev_hash`` link of the reference's ``#block{}`` record — recomputed
+    by :func:`verify_chain`;
+  * catch-up: a periodic anti-entropy tick walks the node's lowest absent
+    epoch and asks a random peer ``fetch(epoch)``; a peer holding that
+    block answers ``sync(epoch, digest, batch)`` (the reference's
+    ``fetch_from``/``sync`` pair, :39-44).
+
+Safety note (crash faults, the fault model of prop_partisan_hbbft): only
+the scheduled leader proposes for its epoch, so at most ONE block can ever
+gain a quorum per epoch — per-epoch agreement degenerates to
+committed-or-absent, absence is repaired by anti-entropy, and forks are
+impossible without equivocation.  Byzantine equivocation is out of scope
+exactly as it is for the reference worker (the library handles it there).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase, World
+from ..ops.msg import Msgs
+
+
+@struct.dataclass
+class HbbftState:
+    buf: jax.Array            # [N, B] pending txn ids (-1 free slot)
+    cur_epoch: jax.Array      # [N] epoch this node is currently running
+    cur_digest: jax.Array     # [N] digest of the stored proposal (0 = none)
+    cur_batch: jax.Array      # [N, Bk] stored proposal batch (-1 pad)
+    have_batch: jax.Array     # [N] bool — propose received this epoch
+    echoed: jax.Array         # [N] bool — echo already sent this epoch
+    votes: jax.Array          # [N] echo count for (cur_epoch, cur_digest)
+    ledger_digest: jax.Array  # [N, E] committed digest per epoch (0 = absent)
+    ledger_batch: jax.Array   # [N, E, Bk] committed batch per epoch
+    fetch_cursor: jax.Array   # [N] next epoch the anti-entropy walk probes
+
+
+def _digest(batch: jax.Array) -> jax.Array:
+    """uint-mix fold over the batch — the block content hash.  -1 pads are
+    folded too (they are part of the canonical fixed-shape block)."""
+    h = jnp.uint32(0x9E3779B9)
+    x = batch.astype(jnp.uint32)
+    for i in range(batch.shape[-1]):
+        h = h ^ (x[..., i] + jnp.uint32(0x85EBCA6B) + (h << 6) + (h >> 2))
+        h = (h ^ (h >> 16)) * jnp.uint32(0x7FEB352D)
+    # digest 0 is the sentinel for "absent"; avoid colliding with it
+    return jnp.maximum(h.astype(jnp.int32) & 0x7FFFFFFF, 1)
+
+
+class HbbftWorker(ProtocolBase):
+    """Rotating-leader quorum-echo atomic broadcast over the engine."""
+
+    msg_types = ("propose", "echo", "fetch", "sync", "ctl_submit")
+
+    def __init__(self, cfg: Config, batch_size: int = 4, buf_cap: int = 16,
+                 max_epochs: int = 32, epoch_len: int = 6,
+                 ae_interval: int = 2):
+        assert epoch_len >= 4, "propose/echo/commit needs 4 rounds"
+        self.cfg = cfg
+        self.Bk = batch_size
+        self.B = buf_cap
+        self.E = max_epochs
+        self.L = epoch_len
+        self.ae_interval = ae_interval
+        n = cfg.n_nodes
+        self.f = (n - 1) // 3
+        self.quorum = n - self.f
+        self.data_spec: Dict = {
+            "epoch": ((), jnp.int32),
+            "digest": ((), jnp.int32),
+            "batch": ((batch_size,), jnp.int32),
+            "txn": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+        }
+        self.emit_cap = n          # echo broadcast fans to everyone
+        self.tick_emit_cap = n + 1  # propose fan + one anti-entropy fetch
+
+    # ------------------------------------------------------------------ state
+
+    def init(self, cfg: Config, key: jax.Array) -> HbbftState:
+        n = cfg.n_nodes
+        return HbbftState(
+            buf=jnp.full((n, self.B), -1, jnp.int32),
+            cur_epoch=jnp.full((n,), -1, jnp.int32),
+            cur_digest=jnp.zeros((n,), jnp.int32),
+            cur_batch=jnp.full((n, self.Bk), -1, jnp.int32),
+            have_batch=jnp.zeros((n,), bool),
+            echoed=jnp.zeros((n,), bool),
+            votes=jnp.zeros((n,), jnp.int32),
+            ledger_digest=jnp.zeros((n, self.E), jnp.int32),
+            ledger_batch=jnp.full((n, self.E, self.Bk), -1, jnp.int32),
+            fetch_cursor=jnp.zeros((n,), jnp.int32),
+        )
+
+    def _everyone(self) -> jax.Array:
+        return jnp.arange(self.cfg.n_nodes, dtype=jnp.int32)
+
+    def _drop_from_buf(self, buf: jax.Array, batch: jax.Array) -> jax.Array:
+        """Remove committed transactions from the pending buffer
+        (partisan_hbbft_worker: buffer pruning on new_epoch)."""
+        hit = ((buf[:, None] == batch[None, :]) & (batch[None, :] >= 0)).any(-1)
+        return jnp.where(hit, -1, buf)
+
+    def _install(self, row: HbbftState, epoch, digest, batch,
+                 ok) -> HbbftState:
+        """Write a committed block into the epoch ledger (idempotent)."""
+        e = jnp.clip(epoch, 0, self.E - 1)
+        fresh = ok & (epoch >= 0) & (epoch < self.E) \
+            & (row.ledger_digest[e] == 0)
+        ld = row.ledger_digest.at[e].set(
+            jnp.where(fresh, digest, row.ledger_digest[e]))
+        lb = row.ledger_batch.at[e].set(
+            jnp.where(fresh, batch, row.ledger_batch[e]))
+        buf = jnp.where(fresh, self._drop_from_buf(row.buf, batch), row.buf)
+        return row.replace(ledger_digest=ld, ledger_batch=lb, buf=buf)
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_ctl_submit(self, cfg, me, row: HbbftState, m: Msgs, key):
+        """submit_transaction/2 (:37-38): append to the pending buffer,
+        deduplicating against both the buffer and the committed ledger."""
+        txn = m.data["txn"]
+        dup = (row.buf == txn).any() | \
+            ((row.ledger_batch == txn).any() & (txn >= 0))
+        free = jnp.argmax(row.buf < 0)
+        can = (txn >= 0) & ~dup & (row.buf[free] < 0)
+        return row.replace(buf=row.buf.at[free].set(
+            jnp.where(can, txn, row.buf[free]))), self.no_emit()
+
+    def handle_propose(self, cfg, me, row: HbbftState, m: Msgs, key):
+        """Store the leader's batch for the current epoch and echo its
+        digest to everyone (the RBC 'echo' role collapsed to one phase)."""
+        epoch, batch = m.data["epoch"], m.data["batch"]
+        ok = (epoch == row.cur_epoch) & ~row.have_batch
+        d = _digest(batch)
+        row = row.replace(
+            have_batch=row.have_batch | ok,
+            cur_digest=jnp.where(ok, d, row.cur_digest),
+            cur_batch=jnp.where(ok, batch, row.cur_batch))
+        do_echo = ok & ~row.echoed
+        row = row.replace(echoed=row.echoed | do_echo)
+        em = self.emit(jnp.where(do_echo, self._everyone(), -1),
+                       self.typ("echo"), epoch=epoch, digest=d)
+        return row, em
+
+    def handle_echo(self, cfg, me, row: HbbftState, m: Msgs, key):
+        """Count echoes for this epoch's digest; senders echo at most once
+        per epoch so the count is over distinct nodes."""
+        ok = (m.data["epoch"] == row.cur_epoch) \
+            & (m.data["digest"] == row.cur_digest) & row.have_batch
+        return row.replace(votes=row.votes + ok.astype(jnp.int32)), \
+            self.no_emit()
+
+    def handle_fetch(self, cfg, me, row: HbbftState, m: Msgs, key):
+        """fetch_from/2: answer with the block for the asked epoch if we
+        have it (:39-44)."""
+        e = jnp.clip(m.data["epoch"], 0, self.E - 1)
+        have = (m.data["epoch"] >= 0) & (m.data["epoch"] < self.E) \
+            & (row.ledger_digest[e] != 0)
+        em = self.emit(jnp.where(have, m.src, -1)[None], self.typ("sync"),
+                       cap=1, epoch=m.data["epoch"],
+                       digest=row.ledger_digest[e],
+                       batch=row.ledger_batch[e])
+        return row, em
+
+    def handle_sync(self, cfg, me, row: HbbftState, m: Msgs, key):
+        """sync/2: install a caught-up block into the ledger."""
+        row = self._install(row, m.data["epoch"], m.data["digest"],
+                            m.data["batch"], m.data["digest"] != 0)
+        return row, self.no_emit()
+
+    # ------------------------------------------------------------------ timer
+
+    def tick(self, cfg, me, row: HbbftState, rnd, key):
+        epoch = rnd // self.L
+        phase = rnd % self.L
+        leader = (epoch % cfg.n_nodes) == me
+
+        # phase 0: roll into the new epoch (reset per-epoch scratch) and,
+        # if leader with pending work, broadcast the proposal
+        is_new = (phase == 0) & (epoch != row.cur_epoch)
+        row = row.replace(
+            cur_epoch=jnp.where(is_new, epoch, row.cur_epoch),
+            cur_digest=jnp.where(is_new, 0, row.cur_digest),
+            cur_batch=jnp.where(is_new, -1, row.cur_batch),
+            have_batch=row.have_batch & ~is_new,
+            echoed=row.echoed & ~is_new,
+            votes=jnp.where(is_new, 0, row.votes))
+        # batch = first Bk pending txns (hbbft batch_size)
+        order = jnp.argsort(jnp.where(row.buf >= 0, 0, 1), stable=True)
+        batch = row.buf[order][: self.Bk]
+        propose = is_new & leader & (batch[0] >= 0)
+        pr = self.emit(jnp.where(propose, self._everyone(), -1),
+                       self.typ("propose"), cap=self.cfg.n_nodes,
+                       epoch=epoch, batch=batch)
+
+        # commit once quorum echoes are in (possible from phase 3 on)
+        can_commit = (phase >= 3) & row.have_batch \
+            & (row.votes >= self.quorum)
+        row = self._install(row, row.cur_epoch, row.cur_digest,
+                            row.cur_batch, can_commit)
+
+        # anti-entropy: probe one absent past epoch at a random peer
+        # (staggered per node so fetch load spreads over the epoch)
+        ae_due = ((rnd + me) % self.ae_interval) == 0
+        cursor = row.fetch_cursor % jnp.maximum(epoch, 1)
+        absent = row.ledger_digest[jnp.clip(cursor, 0, self.E - 1)] == 0
+        peer = jax.random.randint(key, (), 0, cfg.n_nodes)
+        ask = ae_due & absent & (epoch > 0) & (peer != me)
+        fq = self.emit(jnp.where(ask, peer, -1)[None], self.typ("fetch"),
+                       cap=1, epoch=cursor)
+        row = row.replace(fetch_cursor=jnp.where(ae_due, cursor + 1,
+                                                 row.fetch_cursor))
+        return row, self.merge(pr, fq, cap=self.tick_emit_cap)
+
+
+# -------------------------------------------------------------------- host API
+
+def get_blocks(world: World, proto: HbbftWorker,
+               node: int) -> List[Tuple[int, int, List[int]]]:
+    """get_blocks/1: [(epoch, digest, txns)] of the node's committed chain."""
+    ld = np.asarray(world.state.ledger_digest[node])
+    lb = np.asarray(world.state.ledger_batch[node])
+    return [(int(e), int(ld[e]), [int(t) for t in lb[e] if t >= 0])
+            for e in np.nonzero(ld)[0]]
+
+
+def get_buf(world: World, proto: HbbftWorker, node: int) -> List[int]:
+    """get_buf/1: pending (uncommitted) transactions."""
+    return [int(t) for t in np.asarray(world.state.buf[node]) if t >= 0]
+
+
+def get_status(world: World, proto: HbbftWorker, node: int) -> Dict[str, int]:
+    """get_status/1: epoch / chain length / buffer depth."""
+    return {
+        "epoch": int(world.state.cur_epoch[node]),
+        "chain_len": int((np.asarray(
+            world.state.ledger_digest[node]) != 0).sum()),
+        "buf_len": len(get_buf(world, proto, node)),
+    }
+
+
+def chain_hash(blocks: List[Tuple[int, int, List[int]]]) -> int:
+    """The prev_hash fold: each block's hash mixes its predecessor's —
+    the #block{prev_hash} chain link of the reference, genesis linking to
+    the empty hash (verify_chain's genesis clause, :59-69)."""
+    h = 0
+    for epoch, digest, _txns in blocks:
+        h = ((h * 0x01000193) ^ (epoch * 0x9E3779B9) ^ digest) & 0xFFFFFFFF
+    return h
+
+
+def verify_chain(world: World, proto: HbbftWorker,
+                 submitted: List[int] | None = None) -> Dict[str, object]:
+    """verify_chain/2 (:59-108) over every live node: per-epoch agreement
+    (equal digest+batch wherever two nodes both committed), digest
+    integrity (stored digest recomputes from the batch), no transaction in
+    two epochs, and — when ``submitted`` is given — inclusion-only-of
+    submitted transactions.  Returns {'ok': bool, ...detail}."""
+    alive = np.asarray(world.alive)
+    ld = np.asarray(world.state.ledger_digest)
+    lb = np.asarray(world.state.ledger_batch)
+    live = np.nonzero(alive)[0]
+    problems: List[str] = []
+
+    # agreement + integrity
+    for e in range(proto.E):
+        committed = [i for i in live if ld[i, e] != 0]
+        ds = {int(ld[i, e]) for i in committed}
+        bs = {tuple(lb[i, e].tolist()) for i in committed}
+        if len(ds) > 1 or len(bs) > 1:
+            problems.append(f"epoch {e}: divergent blocks {ds}")
+        for i in committed[:1]:
+            want = int(jax.device_get(_digest(jnp.asarray(lb[i, e]))))
+            if want != int(ld[i, e]):
+                problems.append(f"epoch {e}: digest mismatch on node {i}")
+
+    # txn uniqueness + inclusion, over the union chain
+    seen: Dict[int, int] = {}
+    for e in range(proto.E):
+        for i in live:
+            if ld[i, e] != 0:
+                for t in lb[i, e]:
+                    t = int(t)
+                    if t < 0:
+                        continue
+                    if seen.setdefault(t, e) != e:
+                        problems.append(
+                            f"txn {t} in epochs {seen[t]} and {e}")
+                    if submitted is not None and t not in submitted:
+                        problems.append(f"txn {t} never submitted")
+                break
+    chains = {int(i): chain_hash(get_blocks(world, proto, int(i)))
+              for i in live}
+    return {"ok": not problems, "problems": problems, "chains": chains}
+
+
+def submit_transaction(world: World, proto: HbbftWorker, node: int,
+                       txn: int) -> World:
+    """submit_transaction/2 — host verb (the test harness entry point)."""
+    from .. import peer_service
+    return peer_service.send_ctl(world, proto, node, "ctl_submit", txn=txn)

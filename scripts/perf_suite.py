@@ -108,6 +108,41 @@ def main() -> None:
                         graph.adjacency_from_views(w.state.partial, 1024)))
                     else "DISCONNECTED", rows)
 
+    if want("echo"):
+        # the reference's performance_test proper: SIZE x CONCURRENCY x RTT
+        # echo streams between 2 nodes (partisan_SUITE.erl:1029-1136); one
+        # row per swept point, value = completed echoes/sec
+        from partisan_tpu.models.echo import Echo
+        from partisan_tpu.peer_service import send_ctl
+        sweep = [(1, 256, 0), (8, 256, 0), (8, 4096, 0), (8, 256, 3)] \
+            if args.quick else \
+            [(c, s, r) for c in (1, 4, 8) for s in (256, 4096) for r in (0, 3)]
+        for conc, words, rtt in sweep:
+            total = 100
+            cfg = pt.Config(n_nodes=2, inbox_cap=2 * conc + 2)
+            proto = Echo(cfg, concurrency=conc, size_words=words,
+                         total=total, rtt=rtt)
+            rounds = (total + 2) * 2 * (1 + rtt)
+            run = make_run_scan(cfg, proto, rounds)
+            w0 = send_ctl(init_world(cfg, proto), proto, 0, "ctl_start",
+                          peer=0)
+            w1, _ = run(w0)
+            jax.block_until_ready(w1.rnd)           # compile + warm
+            w0 = send_ctl(init_world(cfg, proto), proto, 0, "ctl_start",
+                          peer=0)
+            t0 = time.perf_counter()
+            w1, _ = run(w0)
+            jax.block_until_ready(w1.rnd)
+            dt = time.perf_counter() - t0
+            msgs = int(np.asarray(w1.state.sent[0]).sum())
+            name = f"echo_c{conc}_w{words}_rtt{rtt}"
+            # rate column stays rounds/sec like every other row; the
+            # echoes/sec figure goes in the health column (unit differs)
+            rows.append([name, 2, rounds, round(dt, 4),
+                         round(rounds / dt, 1),
+                         f"echoes={msgs},echoes_per_sec={msgs/dt:.1f}"])
+            print(f"{name:28s} N=2       {msgs/dt:9.1f} echoes/s")
+
     if want("rumor"):
         # BASELINE #5: rumor fast path at 1e6 (the bench.py headline)
         n, rounds = 1_000_000, 1000

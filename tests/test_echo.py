@@ -1,0 +1,51 @@
+"""Echo workload tests — the performance_test harness contract
+(test/partisan_SUITE.erl:1029-1136): every stream completes its quota, the
+payload actually crosses the wire (checksum), and the emulated RTT slows
+completion accordingly."""
+
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.models.echo import Echo
+from partisan_tpu.peer_service import send_ctl
+
+
+def boot(concurrency=4, total=5, rtt=0, parallelism=1):
+    cfg = pt.Config(n_nodes=2, inbox_cap=2 * concurrency + 2,
+                    parallelism=parallelism)
+    proto = Echo(cfg, concurrency=concurrency, size_words=32, total=total,
+                 rtt=rtt)
+    world = pt.init_world(cfg, proto)
+    world = send_ctl(world, proto, 0, "ctl_start", peer=0)
+    step = pt.make_step(cfg, proto, donate=False)
+    return cfg, proto, world, step
+
+
+def run_until_done(proto, world, step, limit):
+    for r in range(limit):
+        world, _ = step(world)
+        if bool(proto.done(world)):
+            return world, r + 1
+    return world, limit
+
+
+class TestEcho:
+    def test_all_streams_complete(self):
+        cfg, proto, world, step = boot()
+        world, rounds = run_until_done(proto, world, step, 40)
+        assert (np.asarray(world.state.sent[0]) == proto.total).all()
+        assert int(world.state.checksum[1]) != 0   # payload was read
+        assert not np.asarray(world.state.outstanding[0]).any()
+
+    def test_rtt_slows_completion(self):
+        _, p0, w0, s0 = boot(rtt=0)
+        _, p3, w3, s3 = boot(rtt=3)
+        _, r0 = run_until_done(p0, w0, s0, 80)
+        _, r3 = run_until_done(p3, w3, s3, 80)
+        # each hop waits rtt extra rounds -> ~(1+rtt)x the round count
+        assert r3 > 2 * r0
+
+    def test_parallel_lanes(self):
+        cfg, proto, world, step = boot(concurrency=6, parallelism=3)
+        world, _ = run_until_done(proto, world, step, 40)
+        assert (np.asarray(world.state.sent[0]) == proto.total).all()

@@ -1,0 +1,103 @@
+"""HBBFT-style chain worker tests — the contract the reference's
+prop_partisan_hbbft drives against partisan_hbbft_worker.erl: submitted
+transactions end up in exactly one block, correct nodes agree on the chain,
+and nodes that fall behind catch up via sync/fetch (SURVEY §2.9)."""
+
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.models.hbbft import (
+    HbbftWorker, get_blocks, get_buf, get_status, submit_transaction,
+    verify_chain)
+from partisan_tpu.verify import faults
+
+
+def boot(n=7, **kw):
+    cfg = pt.Config(n_nodes=n, inbox_cap=n + 4)
+    proto = HbbftWorker(cfg, **kw)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    return cfg, proto, world, step
+
+
+def run(world, step, rounds):
+    for _ in range(rounds):
+        world, _ = step(world)
+    return world
+
+
+class TestHappyPath:
+    def test_chain_builds_and_agrees(self):
+        cfg, proto, world, step = boot()
+        txns = list(range(100, 112))
+        # spread submissions over the first epochs' leaders
+        for i, t in enumerate(txns):
+            world = submit_transaction(world, proto, i % cfg.n_nodes, t)
+        world = run(world, step, proto.L * 9)
+
+        res = verify_chain(world, proto, submitted=txns)
+        assert res["ok"], res["problems"]
+        # every node ends on the same chain hash
+        assert len(set(res["chains"].values())) == 1
+        committed = {t for e, d, b in get_blocks(world, proto, 0) for t in b}
+        assert committed, "no blocks committed"
+        assert committed <= set(txns)
+        # committed txns left every buffer
+        for i in range(cfg.n_nodes):
+            assert not committed & set(get_buf(world, proto, i))
+
+    def test_status_surface(self):
+        cfg, proto, world, step = boot(n=4)
+        world = submit_transaction(world, proto, 0, 55)
+        world = run(world, step, proto.L * 3)
+        st = get_status(world, proto, 0)
+        assert st["epoch"] >= 2
+        assert st["chain_len"] >= 1
+
+
+class TestFaults:
+    def test_crashed_leader_epochs_are_empty_but_chain_agrees(self):
+        cfg, proto, world, step = boot()
+        for i, t in enumerate(range(200, 212)):
+            world = submit_transaction(world, proto, i % cfg.n_nodes, t)
+        world = faults.crash(world, [1])  # leader of epochs 1, 1+N, ...
+        world = run(world, step, proto.L * 9)
+        res = verify_chain(world, proto)
+        assert res["ok"], res["problems"]
+        live = [i for i in range(cfg.n_nodes) if i != 1]
+        hashes = {res["chains"][i] for i in live}
+        assert len(hashes) == 1
+        # node 1's epochs produced no blocks
+        ld = np.asarray(world.state.ledger_digest)
+        for e in (1, 1 + cfg.n_nodes):
+            assert (ld[live, e] == 0).all()
+        # but other leaders' epochs did
+        assert (ld[live] != 0).any()
+
+    def test_f_crashes_tolerated(self):
+        """quorum = N - f: with f nodes down commits still happen."""
+        cfg, proto, world, step = boot()
+        assert proto.f == 2
+        world = faults.crash(world, [5, 6])
+        for i, t in enumerate(range(300, 306)):
+            world = submit_transaction(world, proto, i % 4, t)
+        world = run(world, step, proto.L * 6)
+        assert get_status(world, proto, 0)["chain_len"] >= 1
+        assert verify_chain(world, proto)["ok"]
+
+    def test_partitioned_node_catches_up_via_sync(self):
+        cfg, proto, world, step = boot()
+        for i, t in enumerate(range(400, 408)):
+            world = submit_transaction(world, proto, i % 4, t)
+        # node 6 alone on the far side of a partition while blocks commit
+        world = faults.inject_partition(world, [[6]])
+        world = run(world, step, proto.L * 5)
+        behind = get_status(world, proto, 6)["chain_len"]
+        ahead = get_status(world, proto, 0)["chain_len"]
+        assert ahead >= 1 and behind < ahead
+        # heal; anti-entropy fetch/sync backfills the ledger
+        world = faults.resolve_partition(world)
+        world = run(world, step, proto.L * 8)
+        assert get_status(world, proto, 6)["chain_len"] == \
+            get_status(world, proto, 0)["chain_len"]
+        assert verify_chain(world, proto)["ok"]

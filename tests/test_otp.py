@@ -132,3 +132,22 @@ class TestMonitor:
         for _ in range(20):
             world, _ = step(world)
         assert not bool(world.state.down[0][0])
+
+    def test_demonitor_suppresses_down(self):
+        """demonitor then crash: no DOWN is raised, and the target's
+        watcher slot is freed (partisan_monitor.erl:35-44, 63-68)."""
+        cfg, proto, world, step = boot()
+        world = send_ctl(world, proto, 0, "ctl_monitor", peer=2)
+        for _ in range(6):
+            world, _ = step(world)
+        assert int(world.state.watching[0][0]) == 2
+        assert (np.asarray(world.state.watchers[2]) == 0).any()
+        world = send_ctl(world, proto, 0, "ctl_demonitor", peer=2)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.watching[0][0]) == -1
+        assert not (np.asarray(world.state.watchers[2]) == 0).any()
+        world = faults.crash(world, [2])
+        for _ in range(12):
+            world, _ = step(world)
+        assert not np.asarray(world.state.down[0]).any()
