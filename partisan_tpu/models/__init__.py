@@ -1,7 +1,10 @@
+from .chain import ChainWorker
 from .commit import AlsbergDay, BernsteinCTP, Skeen3PC, TwoPhaseCommit
 from .demers import (AntiEntropy, DirectMail, DirectMailAcked, rumor_init,
                      rumor_run)
+from .echo import Echo
 from .full_membership import FullMembership
+from .hbbft import HbbftWorker
 from .hyparview import HyParView
 from .managers import ClientServerManager, StaticManager
 from .plumtree import Plumtree

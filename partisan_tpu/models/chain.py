@@ -1,15 +1,15 @@
-"""Replicated block-chain test worker — the analog of
-``src/partisan_hbbft_worker.erl`` (chain of blocks, ``submit_transaction``,
-``verify_chain``, :5-14, 101-108), the workload behind
-``prop_partisan_hbbft``.
+"""Replicated block-chain test workload (simple variant): submit
+transactions anywhere, blocks form via an unguarded rotating-leader
+broadcast (leader for height h is ``h mod N``), every replica's chain must
+verify — the minimal chain workload the property/model-checking machinery
+drives (cf. ``src/partisan_hbbft_worker.erl:5-14, 101-108``).
 
-The reference worker wraps an external HoneyBadgerBFT library; the
-consensus core is not partisan code.  This rebuild supplies the same
-*harness surface* — submit transactions anywhere, blocks form, every
-replica's chain must verify — over a rotating-leader broadcast (leader for
-height h is ``h mod N``), which is what the property/model-checking
-machinery needs a chain workload for.  Byzantine tolerance is out of
-scope exactly as it was a library concern in the reference.
+For the fuller ``partisan_hbbft_worker`` API parity — quorum-echo commit
+tolerating f = (N-1)/3 crashes, ``get_status``/``get_buf``, the
+``sync``/``fetch_from`` catch-up pair — see :mod:`.hbbft`.  This simpler
+worker commits on receipt (no quorum), which is exactly what makes it a
+good *model-checking* target: dropped block messages surface as chain
+divergence for the checker to find.
 """
 
 from __future__ import annotations

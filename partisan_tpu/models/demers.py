@@ -33,6 +33,7 @@ from flax import struct
 from ..config import Config
 from ..engine import ProtocolBase
 from ..ops import bitset
+from ..ops.bitset import mix32
 from ..ops.msg import Msgs
 from .. import prng
 from .stack import UpperProtocol
@@ -290,25 +291,32 @@ def make_rumor_step(n: int, fanout: int = 2, stop_k: int = 1,
         newly = new_infected & ~w.infected
         new_hot = w.hot | newly
 
+        # Per-node Bernoulli masks (feedback coin, churn) come from a
+        # salted splitmix finalizer over the node index instead of a bulk
+        # threefry draw: threefry at [N] lanes was the single heaviest op
+        # of the round (~20% at N=1e6), while the hash is a handful of
+        # VPU multiplies.  The salt is one scalar threefry draw per round,
+        # so rounds stay independent; quantization is m/2^32.
+        iota = jnp.arange(n, dtype=jnp.uint32)
+
+        def bernoulli_hash(key, p):
+            salt = jax.random.bits(key, (), jnp.uint32)
+            thresh = jnp.uint32(min(max(1, round(p * 4294967296)),
+                                    4294967295))
+            return mix32(iota ^ salt) < thresh
+
         # -- feedback: pushing to an already-infected peer kills interest
         #    w.p. 1/stop_k (evaluated on the first lane, as one push-ack);
         #    stop_k == 1 is a sure coin — no draw needed
         if stop_k <= 1:
             new_hot = new_hot & ~dup
         else:
-            coin = jax.random.bits(k_coin, (n,), jnp.uint16) \
-                < min(max(1, round(65536 / stop_k)), 65535)
+            coin = bernoulli_hash(k_coin, 1.0 / stop_k)
             new_hot = new_hot & ~(dup & coin)
 
-        # -- churn: replace a fraction of rows with fresh susceptible
-        #    nodes; drawn as uint16 lanes (half the threefry words of a
-        #    float32 draw — the single heaviest op of the round) with
-        #    churn quantized to m/65536 (error < 8e-6 absolute)
+        # -- churn: replace a fraction of rows with fresh susceptible nodes
         if churn > 0.0:
-            # clamp below 2^16: a threshold of exactly 65536 would wrap
-            # against the uint16 lanes and disable churn outright
-            thresh = min(max(1, round(churn * 65536)), 65535)
-            reborn = jax.random.bits(k_churn, (n,), jnp.uint16) < thresh
+            reborn = bernoulli_hash(k_churn, churn)
             new_infected = new_infected & ~reborn
             new_hot = new_hot & ~reborn
 
@@ -329,11 +337,97 @@ def make_rumor_step(n: int, fanout: int = 2, stop_k: int = 1,
     return step
 
 
+class RumorWorldPacked(NamedTuple):
+    infected: jax.Array   # [N/32] uint32 bitset
+    hot: jax.Array        # [N/32] uint32
+    alive: jax.Array      # [N/32] uint32
+    rnd: jax.Array        # scalar int32
+
+
+def rumor_pack(w: RumorWorld) -> RumorWorldPacked:
+    return RumorWorldPacked(
+        infected=bitset.from_mask(w.infected),
+        hot=bitset.from_mask(w.hot),
+        alive=bitset.from_mask(w.alive), rnd=w.rnd)
+
+
+def rumor_unpack(w: RumorWorldPacked, n: int) -> RumorWorld:
+    return RumorWorld(
+        infected=bitset.to_mask(w.infected, n),
+        hot=bitset.to_mask(w.hot, n),
+        alive=bitset.to_mask(w.alive, n), rnd=w.rnd)
+
+
+def make_rumor_step_packed(n: int, fanout: int = 2, stop_k: int = 1,
+                           churn: float = 0.0, seed: int = 1):
+    """The ``"shift"`` round on uint32-packed bitsets: 32x less HBM
+    traffic (the shift variant is bandwidth/launch-overhead-bound at
+    N >= 10^6) with identical epidemic dynamics.  Rolls become word-rolls
+    with bit carries (bitset.roll_bits); Bernoulli masks come packed from
+    bitset.biased_bits.  With stop_k == 1 and churn == 0 the trajectory
+    is BIT-IDENTICAL to the unpacked shift variant (same threefry draws);
+    the packed Bernoulli generator quantizes p slightly differently, so
+    churn/coin runs match distributionally instead (variant-parity test).
+    """
+    assert n % bitset.WORD == 0, "packed rumor wants n % 32 == 0"
+    W = n // bitset.WORD
+    base = jax.random.PRNGKey(seed)
+
+    def step(w: RumorWorldPacked, _):
+        k = jax.random.fold_in(base, w.rnd)
+        k_tgt, k_coin, k_churn = jax.random.split(k, 3)
+
+        send = w.hot & w.alive
+        shifts = jax.random.randint(k_tgt, (fanout,), 1, n)
+        hit = jnp.zeros_like(send)
+        for j in range(fanout):
+            hit = hit | bitset.roll_bits(send, shifts[j], n)
+        new_infected = w.infected | (hit & w.alive)
+        dup = bitset.roll_bits(w.infected, n - shifts[0], n) & send
+        newly = new_infected & ~w.infected
+        new_hot = w.hot | newly
+
+        if stop_k <= 1:
+            new_hot = new_hot & ~dup
+        else:
+            coin = bitset.biased_bits(k_coin, 1.0 / stop_k, W)
+            new_hot = new_hot & ~(dup & coin)
+
+        if churn > 0.0:
+            reborn = bitset.biased_bits(k_churn, churn, W)
+            new_infected = new_infected & ~reborn
+            new_hot = new_hot & ~reborn
+
+        dead = ~jnp.any((new_hot & w.alive) != 0)
+        k_pz = jax.random.fold_in(k, 7)
+        pz = jax.random.randint(k_pz, (), 0, n)
+        wi, bi = pz // bitset.WORD, jnp.uint32(pz % bitset.WORD)
+        bit = jnp.where(dead, jnp.uint32(1) << bi, jnp.uint32(0))
+        new_infected = new_infected.at[wi].set(new_infected[wi] | bit)
+        new_hot = new_hot.at[wi].set(new_hot[wi] | bit)
+
+        return RumorWorldPacked(infected=new_infected, hot=new_hot,
+                                alive=w.alive, rnd=w.rnd + 1), None
+
+    return step
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
 def rumor_run(w: RumorWorld, n_rounds: int, n: int, fanout: int = 2,
               stop_k: int = 1, churn: float = 0.0,
               variant: str = "shift") -> RumorWorld:
-    """n_rounds of rumor mongering fully on device (lax.scan)."""
+    """n_rounds of rumor mongering fully on device (lax.scan), or — for
+    ``variant="pallas"`` — in a single fused kernel launch
+    (ops/rumor_kernel.py; TPU only, n must be a multiple of 4096)."""
+    if variant == "pallas":
+        from ..ops.rumor_kernel import rumor_run_fused
+        out = rumor_run_fused(rumor_pack(w), n_rounds, n, fanout,
+                              stop_k, churn)
+        return rumor_unpack(out, n)
+    if variant == "packed":
+        step = make_rumor_step_packed(n, fanout, stop_k, churn)
+        out, _ = jax.lax.scan(step, rumor_pack(w), None, length=n_rounds)
+        return rumor_unpack(out, n)
     step = make_rumor_step(n, fanout, stop_k, churn, variant=variant)
     out, _ = jax.lax.scan(step, w, None, length=n_rounds)
     return out

@@ -19,6 +19,18 @@ import jax.numpy as jnp
 WORD = 32
 
 
+def mix32(x: jax.Array) -> jax.Array:
+    """Cheap 32-bit integer hash (splitmix-style finalizer) — THE shared
+    non-cryptographic hash of the package (connection keys in ops/msg.py,
+    Bernoulli masks here and in models/demers.py).  One definition so the
+    constants can never desynchronize."""
+    x = jnp.uint32(x) if not jnp.issubdtype(x.dtype, jnp.unsignedinteger) \
+        else x
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
 def n_words(n: int) -> int:
     return (n + WORD - 1) // WORD
 
@@ -72,3 +84,58 @@ def from_mask(mask: jax.Array) -> jax.Array:
     pad = pad.reshape(w, WORD)
     shifts = jnp.arange(WORD, dtype=jnp.uint32)
     return jnp.sum(pad << shifts, axis=1, dtype=jnp.uint32)
+
+
+def roll_bits(bs: jax.Array, s: jax.Array, n: int) -> jax.Array:
+    """Circular bit-roll of an n-bit set: bit j of the result is bit
+    (j - s) mod n of the input (the packed analog of ``jnp.roll`` on the
+    unpacked mask).  Requires ``n % WORD == 0``.  One word-roll plus a
+    carry from the neighbouring word — O(n/32) instead of O(n) traffic,
+    the point of running epidemics on packed state."""
+    assert n % WORD == 0 and bs.shape[0] == n // WORD
+    s = jnp.asarray(s, jnp.int32) % n
+    q = s // WORD
+    r = (s % WORD).astype(jnp.uint32)
+    xw = jnp.roll(bs, q)
+    prev = jnp.roll(bs, q + 1)
+    # r == 0 would make the carry shift (WORD - r) == WORD, which XLA
+    # leaves undefined — select the unshifted word instead
+    carry = prev >> jnp.where(r == 0, jnp.uint32(1), jnp.uint32(WORD) - r)
+    return jnp.where(r == 0, xw, (xw << r) | carry)
+
+
+def biased_bits(key: jax.Array, p: float, w: int,
+                rel_err: float = 0.005, max_depth: int = 20) -> jax.Array:
+    """[w] uint32 of (approximately) independent Bernoulli(p) bits.
+
+    Built from the binary expansion of p: an AND-prefix chain of cheap
+    hash words has density 2^-d after d terms, and OR-ing the chains at
+    the expansion's set depths sums the densities to p within ``rel_err``
+    relative error.  Cost is <= max_depth splitmix hashes per word —
+    ~d/32 hash ops per output *bit*, versus one bulk threefry lane per
+    bit for an unpacked draw.  Randomness is a salted splitmix over the
+    word index: adequate for simulation masks (churn, gossip coins), not
+    for cryptography or statistics-grade sampling."""
+    assert 0.0 < p < 1.0
+    # truncation depth: 2^-D <= p * rel_err (each bit position of u is one
+    # uniform random word; we realize the event "u < p" bit-serially)
+    D = 1
+    while 2.0 ** -D > p * rel_err and D < max_depth:
+        D += 1
+    salt = jax.random.bits(key, (), jnp.uint32)
+    iota = jnp.arange(w, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    # u < p iff at the first differing bit position u has 0 and p has 1;
+    # eq tracks lanes whose u-prefix still equals p's prefix
+    eq = jnp.full((w,), 0xFFFFFFFF, jnp.uint32)
+    out = jnp.zeros((w,), jnp.uint32)
+    frac = p
+    for d in range(1, D + 1):
+        u = mix32(iota ^ salt ^ jnp.uint32((d * 0x9E3779B9) & 0xFFFFFFFF))
+        frac *= 2.0
+        if frac >= 1.0:              # p's bit at depth d is 1
+            frac -= 1.0
+            out = out | (eq & ~u)
+            eq = eq & u
+        else:                        # p's bit is 0
+            eq = eq & ~u
+    return out

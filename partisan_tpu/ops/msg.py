@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from .bitset import mix32 as _mix  # shared splitmix hash (one definition)
+
 
 @struct.dataclass
 class Msgs:
@@ -101,15 +103,6 @@ def compact(m: Msgs, cap: int) -> Tuple[Msgs, jax.Array]:
     out = out.replace(valid=keep_valid)
     dropped = jnp.maximum(n_valid - cap, 0).astype(jnp.int32)
     return out, dropped
-
-
-def _mix(x: jax.Array) -> jax.Array:
-    """Cheap integer hash (splitmix-style finalizer) for connection keys."""
-    x = jnp.uint32(x) if not jnp.issubdtype(x.dtype, jnp.unsignedinteger) \
-        else x
-    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
-    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
-    return x ^ (x >> 16)
 
 
 def dispatch(m: Msgs, parallelism: int, partition_key: Optional[jax.Array],

@@ -1,0 +1,189 @@
+"""Pallas TPU mega-kernel for the rumor-mongering benchmark (BASELINE #5,
+``protocols/demers_rumor_mongering.erl`` at >= 10^6 nodes, 1%/round churn).
+
+The XLA fast paths (models/demers.py ``"shift"``/``"packed"`` variants) are
+bound by per-round kernel-launch overhead: one simulated round lowers to
+~20-40 XLA kernels, costing ~100+ us/round at N = 10^6 regardless of how
+small the data gets.  This kernel runs the ENTIRE multi-round simulation as
+ONE ``pallas_call``: grid = (rounds,), node state packed as a [R, 128]
+uint32 bitset (bit j of word w = node w*32 + j, matching ops/bitset.py)
+resident in VMEM for the whole run, per-round randomness from the on-core
+PRNG (``pltpu.prng_seed`` / ``prng_random_bits``), and the epidemic's
+shift-rendezvous delivery (see the "shift" variant rationale in
+models/demers.py) as dynamic circular rotations (``pltpu.roll``).
+
+Per round, mirroring demers_rumor_mongering.erl:39, 89-145 semantics:
+  send   = hot & alive
+  hit    = OR over `fanout` random shifts s_j of roll_bits(send, s_j)
+  infect = infected | (hit & alive)
+  dup    = roll_bits(infected, -s_0) & send       (push-ack feedback)
+  hot    = (hot | newly) & ~dup                   (stop_k == 1 sure coin)
+  churn  : Bernoulli(churn) bits clear infected+hot (fresh susceptibles)
+  restart: if no hot sender remains, a random patient zero reseeds the
+           rumor (sustained-gossip workload, not one-shot broadcast)
+
+Layout: n must be a multiple of 4096 (= 32 bits x 128 lanes); rows
+R = n / 4096.  A flat word-roll by q decomposes into a row roll (q // 128),
+an in-row lane rotation (q % 128), and a row-borrow select on the first
+q % 128 lanes; the bit-level remainder is an elementwise shift with a
+carry from the (flat) previous word.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+WORD = 32
+CELL = LANES * WORD  # node bits per row
+
+
+def _flat_word_roll(x: jax.Array, q: jax.Array) -> jax.Array:
+    """Circular roll of the flattened word sequence of a [R, 128] array:
+    out_flat[w] = x_flat[(w - q) mod W]."""
+    R = x.shape[0]
+    qr = q // LANES
+    ql = q % LANES
+    y = pltpu.roll(x, qr, axis=0)       # whole-row part
+    y = pltpu.roll(y, ql, axis=1)       # in-row lane rotation
+    # lanes < ql wrapped within their row; flat semantics take them from
+    # the previous row's rotation instead
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane < ql, pltpu.roll(y, 1, axis=0), y)
+
+
+def _flat_bit_roll(x: jax.Array, s: jax.Array, n: int) -> jax.Array:
+    """roll_bits (ops/bitset.py) on the [R, 128] word layout: bit j of the
+    result is bit (j - s) mod n of x."""
+    s = s % n
+    q = s // WORD
+    r = (s % WORD).astype(jnp.uint32)
+    xw = _flat_word_roll(x, q)
+    prev = _flat_word_roll(xw, 1)
+    carry = prev >> jnp.where(r == 0, jnp.uint32(1), jnp.uint32(WORD) - r)
+    return jnp.where(r == 0, xw, (xw << r) | carry)
+
+
+def _bernoulli_words(p: float, shape, rel_err: float = 0.005,
+                     max_depth: int = 20) -> jax.Array:
+    """Packed Bernoulli(p) bits from the on-core PRNG — the bit-serial
+    "u < p" comparison of ops/bitset.biased_bits, one fresh uint32 draw
+    per expansion depth."""
+    D = 1
+    while 2.0 ** -D > p * rel_err and D < max_depth:
+        D += 1
+    eq = jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
+    out = jnp.zeros(shape, jnp.uint32)
+    frac = p
+    for _ in range(D):
+        u = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+        frac *= 2.0
+        if frac >= 1.0:
+            frac -= 1.0
+            out = out | (eq & ~u)
+            eq = eq & u
+        else:
+            eq = eq & ~u
+    return out
+
+
+def _round_body(i, seed, inf, hot, alive, n, fanout, stop_k, churn):
+    """One epidemic round on packed state; returns (infected', hot')."""
+    pltpu.prng_seed(seed, i)
+    sbits = pltpu.bitcast(
+        pltpu.prng_random_bits((8, LANES)), jnp.uint32)
+
+    send = hot & alive
+    hit = jnp.zeros_like(send)
+    shift0 = jnp.int32(0)
+    for j in range(fanout):
+        s = 1 + (sbits[0, j] % jnp.uint32(n - 1)).astype(jnp.int32)
+        if j == 0:
+            shift0 = s
+        hit = hit | _flat_bit_roll(send, s, n)
+    new_inf = inf | (hit & alive)
+    dup = _flat_bit_roll(inf, n - shift0, n) & send
+    newly = new_inf & ~inf
+    new_hot = hot | newly
+
+    if stop_k <= 1:
+        new_hot = new_hot & ~dup
+    else:
+        coin = _bernoulli_words(1.0 / stop_k, inf.shape)
+        new_hot = new_hot & ~(dup & coin)
+
+    if churn > 0.0:
+        reborn = _bernoulli_words(churn, inf.shape)
+        new_inf = new_inf & ~reborn
+        new_hot = new_hot & ~reborn
+
+    # sustained gossip: reseed a random patient zero when the rumor died
+    dead = jnp.sum((new_hot & alive).astype(jnp.int32)) == 0
+    pz = (sbits[1, 0] % jnp.uint32(n)).astype(jnp.int32)
+    wi, bi = pz // WORD, (pz % WORD).astype(jnp.uint32)
+    row = jax.lax.broadcasted_iota(jnp.int32, inf.shape, 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, inf.shape, 1)
+    at_pz = (row == wi // LANES) & (lane == wi % LANES)
+    bit = jnp.where(at_pz & dead, jnp.uint32(1) << bi, jnp.uint32(0))
+    return new_inf | bit, new_hot | bit
+
+
+def _kernel(seed_ref, inf0, hot0, alive0, inf_out, hot_out,
+            *, n, fanout, stop_k, churn):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        inf_out[:] = inf0[:]
+        hot_out[:] = hot0[:]
+
+    new_inf, new_hot = _round_body(
+        i, seed_ref[0], inf_out[:], hot_out[:], alive0[:],
+        n, fanout, stop_k, churn)
+    inf_out[:] = new_inf
+    hot_out[:] = new_hot
+
+
+@functools.partial(jax.jit,
+                   static_argnums=(1, 2, 3, 4, 5, 6))
+def rumor_run_fused(packed, n_rounds: int, n: int, fanout: int = 2,
+                    stop_k: int = 1, churn: float = 0.0,
+                    interpret: bool = False):
+    """Run ``n_rounds`` of rumor mongering in one kernel launch.
+
+    ``packed`` is a models.demers.RumorWorldPacked (uint32 words); returns
+    the same type.  ``n`` must be a multiple of 4096 — for the 10^6-node
+    benchmark use n = 2^20 = 1,048,576.
+    """
+    assert n % CELL == 0, f"n must be a multiple of {CELL}"
+    assert n_rounds >= 1, "grid=(0,) would skip the init copy entirely"
+    R = n // CELL
+    shape2 = (R, LANES)
+    re2 = lambda x: x.reshape(shape2)
+    seed = jnp.asarray([packed.rnd + 12345], jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_rounds,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+    )
+    kern = functools.partial(_kernel, n=n, fanout=fanout, stop_k=stop_k,
+                             churn=churn)
+    inf, hot = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(shape2, jnp.uint32)] * 2,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(seed, re2(packed.infected), re2(packed.hot), re2(packed.alive))
+    from ..models.demers import RumorWorldPacked
+    return RumorWorldPacked(
+        infected=inf.reshape(-1), hot=hot.reshape(-1),
+        alive=packed.alive, rnd=packed.rnd + n_rounds)

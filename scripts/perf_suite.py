@@ -42,14 +42,14 @@ def time_engine(name, cfg, proto, rounds, health_fn, rows):
         world, proto, [(i, 0) for i in range(1, cfg.n_nodes)], stagger=8)
     run = make_run_scan(cfg, proto, rounds)
     w2, _ = run(world)           # compile + warm
-    jax.block_until_ready(w2.rnd)
+    int(w2.rnd)                  # scalar readback = real sync (bench.py notes)
     world2 = init_world(cfg, proto)  # distinct input (tunnel result cache)
     world2 = peer_service.cluster(
         world2, proto, [(i, 1 % cfg.n_nodes) for i in range(2, cfg.n_nodes)],
         stagger=8)
     t0 = time.perf_counter()
     w3, _ = run(world2)
-    jax.block_until_ready(w3.rnd)
+    int(w3.rnd)                  # readback inside the timed region
     dt = time.perf_counter() - t0
     health = health_fn(w2)
     rows.append([name, cfg.n_nodes, rounds, round(dt, 4),
@@ -68,6 +68,9 @@ def main() -> None:
                          "ignores JAX_PLATFORMS)")
     ap.add_argument("--only", default=None,
                     help="run a single config by name substring")
+    ap.add_argument("--gather", type=int, default=None,
+                    help="deliver_gather_cap for the engine configs "
+                         "(sparse dispatch; see Config)")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -77,7 +80,8 @@ def main() -> None:
 
     if want("full_membership"):
         # BASELINE #1: full membership, small cluster
-        cfg = pt.Config(n_nodes=16, inbox_cap=32, periodic_interval=2)
+        cfg = pt.Config(n_nodes=16, inbox_cap=32, periodic_interval=2,
+                        deliver_gather_cap=args.gather)
         time_engine("full_membership", cfg, FullMembership(cfg), R,
                     lambda w: "converged" if bool(
                         (np.asarray(jax.vmap(FullMembership(cfg).member_mask)(
@@ -85,7 +89,8 @@ def main() -> None:
 
     if want("hyparview"):
         # BASELINE #2: HyParView N=64
-        cfg = pt.Config(n_nodes=64, inbox_cap=8, shuffle_interval=5)
+        cfg = pt.Config(n_nodes=64, inbox_cap=8, shuffle_interval=5,
+                        deliver_gather_cap=args.gather)
         hv = HyParView(cfg)
         time_engine("hyparview", cfg, hv, R,
                     lambda w: "connected" if bool(graph.is_connected(
@@ -94,14 +99,16 @@ def main() -> None:
 
     if want("plumtree"):
         # BASELINE #3: plumtree over hyparview N=64
-        cfg = pt.Config(n_nodes=64, inbox_cap=12, shuffle_interval=5)
+        cfg = pt.Config(n_nodes=64, inbox_cap=12, shuffle_interval=5,
+                        deliver_gather_cap=args.gather)
         time_engine("plumtree_over_hyparview", cfg,
                     Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1)), R,
                     lambda w: "ok", rows)
 
     if want("scamp"):
         # BASELINE #4: SCAMP v2 at 1024
-        cfg = pt.Config(n_nodes=1024, inbox_cap=16, periodic_interval=5)
+        cfg = pt.Config(n_nodes=1024, inbox_cap=16, periodic_interval=5,
+                        deliver_gather_cap=args.gather)
         sc = ScampV2(cfg)
         time_engine("scamp_v2", cfg, sc, R,
                     lambda w: "connected" if bool(graph.is_connected(
@@ -127,14 +134,18 @@ def main() -> None:
             w0 = send_ctl(init_world(cfg, proto), proto, 0, "ctl_start",
                           peer=0)
             w1, _ = run(w0)
-            jax.block_until_ready(w1.rnd)           # compile + warm
+            int(np.asarray(w1.state.sent[0]).sum())  # compile + real sync
+            # distinct input bytes (peer is unused by the handler) so the
+            # TPU tunnel's (executable, input) result cache can't replay
+            # the warmup, and a scalar readback INSIDE the timed region —
+            # block_until_ready alone can return early through the tunnel
+            # (see bench.py measurement notes)
             w0 = send_ctl(init_world(cfg, proto), proto, 0, "ctl_start",
-                          peer=0)
+                          peer=1)
             t0 = time.perf_counter()
             w1, _ = run(w0)
-            jax.block_until_ready(w1.rnd)
-            dt = time.perf_counter() - t0
             msgs = int(np.asarray(w1.state.sent[0]).sum())
+            dt = time.perf_counter() - t0
             name = f"echo_c{conc}_w{words}_rtt{rtt}"
             # rate column stays rounds/sec like every other row; the
             # echoes/sec figure goes in the health column (unit differs)

@@ -90,8 +90,25 @@ class TestRumorFastPath:
                           kw["stop_k"], kw["churn"], "uniform")
             s = rumor_run(rumor_init(n), 150, n, kw["fanout"],
                           kw["stop_k"], kw["churn"], "shift")
+            p = rumor_run(rumor_init(n), 150, n, kw["fanout"],
+                          kw["stop_k"], kw["churn"], "packed")
             fu = float(u.infected.mean())
             fs = float(s.infected.mean())
-            assert lo <= fu <= hi and lo <= fs <= hi, (fu, fs)
+            fp = float(p.infected.mean())
+            assert lo <= fu <= hi and lo <= fs <= hi and lo <= fp <= hi, \
+                (fu, fs, fp)
             assert abs(fu - fs) < 0.25, \
                 f"variant dynamics diverged: uniform={fu} shift={fs}"
+            assert abs(fs - fp) < 0.25, \
+                f"packed dynamics diverged: shift={fs} packed={fp}"
+
+    def test_packed_bit_parity(self):
+        """With a sure stop coin and no churn the packed trajectory is
+        bit-identical to the shift variant (same threefry draws,
+        make_rumor_step_packed docstring)."""
+        n = 2048
+        a = rumor_run(rumor_init(n, 5), 60, n, 2, 1, 0.0, "shift")
+        b = rumor_run(rumor_init(n, 5), 60, n, 2, 1, 0.0, "packed")
+        np.testing.assert_array_equal(np.asarray(a.infected),
+                                      np.asarray(b.infected))
+        np.testing.assert_array_equal(np.asarray(a.hot), np.asarray(b.hot))

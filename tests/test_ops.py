@@ -266,3 +266,21 @@ class TestSparseDelivery:
             np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
         np.testing.assert_array_equal(np.asarray(a.msgs.valid.sum()),
                                       np.asarray(b.msgs.valid.sum()))
+
+
+class TestBitsetRolls:
+    def test_roll_bits_matches_mask_roll(self):
+        k = jax.random.PRNGKey(1)
+        m = jax.random.bernoulli(k, 0.3, (512,))
+        bs = bitset.from_mask(m)
+        for s in (0, 1, 31, 32, 33, 300, 511):
+            got = bitset.to_mask(bitset.roll_bits(bs, jnp.int32(s), 512), 512)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.roll(np.asarray(m), s))
+
+    def test_biased_bits_density(self):
+        k = jax.random.PRNGKey(2)
+        for p in (0.01, 0.3, 0.9):
+            bits = bitset.biased_bits(k, p, 31250)
+            dens = float(jnp.sum(jnp.bitwise_count(bits))) / (31250 * 32)
+            assert abs(dens - p) < max(0.02 * p, 5e-4), (p, dens)
