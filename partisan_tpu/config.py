@@ -82,12 +82,21 @@ class Config:
     #   dead/one-sided active edges are detected by keepalive expiry instead
     #   of socket death.
     broadcast: bool = False            # tree-based transitive relay when disconnected
+    distance_enabled: bool = False     # ?DISTANCE_ENABLED (partisan.hrl:40)
     distance_interval: int = 10        # ping/pong distance metrics (pluggable :852-873)
 
     # --- simulator capacities (fixed shapes; SURVEY §7.3 "dynamic sparsity")
     # (per-handler emission caps live on each protocol class, which alone
     # knows its fan-out; only the shared routing cap lives here)
     inbox_cap: int = 16                # max messages a node processes per round
+    node_emit_cap: Optional[int] = None
+    # ^ per-node emission budget per round: when set, each node's K x E
+    #   handler-emission slots are compacted to this many BEFORE the
+    #   global collect, so the flat-buffer sort handles N*node_emit_cap
+    #   candidates instead of N*K*E (SCAMP at N=1024 carries ~1.4M mostly
+    #   empty slots through that sort — the dominant engine cost there).
+    #   Per-node overflow is counted in the out_dropped metric, never
+    #   silent.  None = no pre-compaction.
     deliver_gather_cap: Optional[int] = None
     # ^ sparse-delivery gather width G: when set (and < n_nodes), each
     #   (inbox-slot, msg-type) dispatch gathers only the <= G receiving node

@@ -85,6 +85,13 @@ class ProtocolBase:
         # protocol's tags index into the combined handler table
         return self.msg_types.index(name) + getattr(self, "_typ_offset", 0)
 
+    def _rewire(self, spec, emit_cap, offset) -> None:
+        """Called by models/stack.Stacked: emit in the combined message
+        space (unioned payload spec, shared emission cap, tag offset)."""
+        self._typ_offset = offset
+        self.data_spec = spec
+        self.emit_cap = emit_cap
+
     def handlers(self) -> Tuple[Callable, ...]:
         return tuple(getattr(self, "handle_" + t) for t in self.msg_types)
 
@@ -230,11 +237,15 @@ def make_step(
             for t, h in enumerate(handlers):
                 sel = mk.valid & (mk.typ == t)
 
-                def dense(op, h=h, sel=sel):
+                # normalize narrower emissions (e.g. a cap=1 reply) to the
+                # full emit width — see msgops.pad_to
+                def call(i, r, m, hk, h=h):
+                    r2, em = h(cfg, i, r, m, hk)
+                    return r2, msgops.pad_to(em, E)
+
+                def dense(op, call=call, sel=sel):
                     state, em_slot = op
-                    st2, em2 = jax.vmap(
-                        lambda i, r, m, hk: h(cfg, i, r, m, hk)
-                    )(node_ids, state, mk, kkeys)
+                    st2, em2 = jax.vmap(call)(node_ids, state, mk, kkeys)
                     state = _sel_where(sel, st2, state)
                     em_slot = _sel_where(sel, em2, em_slot)
                     return state, em_slot
@@ -244,17 +255,16 @@ def make_step(
                         jnp.any(sel), dense, lambda op: op, (state, em_slot))
                     continue
 
-                def sparse(op, h=h, sel=sel):
+                def sparse(op, call=call, sel=sel):
                     state, em_slot = op
                     # fill slots index N: clipped for the gather, dropped
                     # (mode="drop") on the scatter back
                     idx, = jnp.nonzero(sel, size=G, fill_value=N)
                     ic = jnp.minimum(idx, N - 1).astype(jnp.int32)
                     take = lambda x: x[ic]
-                    st2, em2 = jax.vmap(
-                        lambda i, r, m, hk: h(cfg, i, r, m, hk)
-                    )(ic, jax.tree_util.tree_map(take, state),
-                      jax.tree_util.tree_map(take, mk), kkeys[ic])
+                    st2, em2 = jax.vmap(call)(
+                        ic, jax.tree_util.tree_map(take, state),
+                        jax.tree_util.tree_map(take, mk), kkeys[ic])
                     put = lambda s, v: s.at[idx].set(v, mode="drop")
                     state = jax.tree_util.tree_map(put, state, st2)
                     em_slot = jax.tree_util.tree_map(put, em_slot, em2)
@@ -333,13 +343,26 @@ def make_step(
             return out.replace(src=src,
                                born=jnp.full((N * per,), rnd, jnp.int32))
 
-        new = msgops.concat(flat(demits, K * E), flat(temits, T))
+        # optional per-node pre-compaction: rows stay grouped by node (a
+        # stable per-row sort), so src stamping by position still holds
+        # and per-connection FIFO order is unchanged
+        node_dropped = jnp.int32(0)
+        if cfg.node_emit_cap is not None and cfg.node_emit_cap < K * E:
+            demits, per_node_drops = jax.vmap(
+                lambda m: msgops.compact(m, cfg.node_emit_cap))(demits)
+            node_dropped = jnp.sum(per_node_drops).astype(jnp.int32)
+            d_per = cfg.node_emit_cap
+        else:
+            d_per = K * E
+
+        new = msgops.concat(flat(demits, d_per), flat(temits, T))
         alive_src = world.alive[jnp.clip(new.src, 0, N - 1)]
         new = new.replace(valid=new.valid & alive_src)
         if interpose_send is not None:
             new = _interp(interpose_send, new, rnd, world)  # once, at send
         out = msgops.concat(new, held)
         out, dropped = msgops.compact(out, out_cap)
+        dropped = dropped + node_dropped
 
         metrics = {
             "round": rnd,

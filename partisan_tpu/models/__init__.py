@@ -2,6 +2,7 @@ from .chain import ChainWorker
 from .commit import AlsbergDay, BernsteinCTP, Skeen3PC, TwoPhaseCommit
 from .demers import (AntiEntropy, DirectMail, DirectMailAcked, rumor_init,
                      rumor_run)
+from .distance import Distance
 from .echo import Echo
 from .full_membership import FullMembership
 from .hbbft import HbbftWorker

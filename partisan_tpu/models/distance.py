@@ -1,0 +1,95 @@
+"""Distance metrics — the pluggable manager's ping/pong RTT measurement
+(src/partisan_pluggable_peer_service_manager.erl:852-873, 1111-1151,
+gated by ``distance_enabled``, include/partisan.hrl:40) as a stackable
+upper protocol.
+
+Every ``cfg.distance_interval`` rounds a node stamps ``dist_ping`` with
+the current round and sends it to every peer of the lower membership
+layer; the peer echoes the stamp in ``dist_pong``; the origin records
+round-trip time (in rounds — the simulator's clock) per peer.  Under the
+engine's delay machinery (ingress/egress delay, '$delay' interposition)
+the measured RTT grows accordingly, which is exactly what the reference
+uses the numbers for (XBOT-style topology preferences, operator
+observability)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import Config
+from ..engine import World
+from ..ops.msg import Msgs
+from .stack import StackState, UpperProtocol
+
+
+@struct.dataclass
+class DistState:
+    peer: jax.Array      # [N, P] measured-peer ids (-1 free)
+    rtt: jax.Array       # [N, P] last RTT in rounds (-1 unknown)
+    last_rnd: jax.Array  # [N] round counter mirror (ticked every round)
+
+
+class Distance(UpperProtocol):
+    """Stack over any membership manager: Stacked(HyParView(cfg), Distance(cfg))."""
+
+    msg_types = ("dist_ping", "dist_pong")
+
+    def __init__(self, cfg: Config, peer_cap: int = 8):
+        self.cfg = cfg
+        self.P = peer_cap
+        self.data_spec: Dict = {"stamp": ((), jnp.int32)}
+        self.emit_cap = max(peer_cap, 4)
+        self.tick_emit_cap = peer_cap
+
+    def init_upper(self, cfg: Config, key: jax.Array) -> DistState:
+        n = cfg.n_nodes
+        return DistState(
+            peer=jnp.full((n, self.P), -1, jnp.int32),
+            rtt=jnp.full((n, self.P), -1, jnp.int32),
+            last_rnd=jnp.zeros((n,), jnp.int32),
+        )
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_dist_ping(self, cfg, me, row: StackState, m: Msgs, key):
+        """Echo the stamp back — the pong half (:1111-1122)."""
+        return row, self.emit(m.src[None], self.typ("dist_pong"), cap=1,
+                              stamp=m.data["stamp"])
+
+    def handle_dist_pong(self, cfg, me, row: StackState, m: Msgs, key):
+        """Record RTT for the echoing peer (:1123-1151).  Delivery happens
+        before this round's tick, so "now" is last_rnd + 1."""
+        up = row.upper
+        rtt = (up.last_rnd + 1) - m.data["stamp"]
+        hit = up.peer == m.src
+        slot = jnp.where(hit.any(), jnp.argmax(hit), jnp.argmax(up.peer < 0))
+        ok = hit.any() | (up.peer[slot] < 0)
+        up = up.replace(
+            peer=up.peer.at[slot].set(jnp.where(ok, m.src, up.peer[slot])),
+            rtt=up.rtt.at[slot].set(jnp.where(ok, rtt, up.rtt[slot])))
+        return self.up(row, up), self.no_emit()
+
+    # ------------------------------------------------------------------ timer
+
+    def tick_upper(self, cfg, me, row: StackState, rnd, key):
+        up = row.upper.replace(last_rnd=rnd)
+        due = cfg.distance_enabled \
+            & (((rnd + me) % cfg.distance_interval) == 0)
+        peers = self.active_peers(row)[: self.P]
+        em = self.emit(jnp.where(due, peers, -1), self.typ("dist_ping"),
+                       cap=self.tick_emit_cap, stamp=rnd)
+        return self.up(row, up), em
+
+
+def distances(world: World, node: int) -> Dict[int, int]:
+    """Host accessor: measured RTTs (rounds) by peer id for one node —
+    the `partisan_peer_service_console`-style observability surface."""
+    up = world.state.upper
+    peers = np.asarray(up.peer[node])
+    rtts = np.asarray(up.rtt[node])
+    return {int(p): int(r) for p, r in zip(peers, rtts) if p >= 0 and r >= 0}

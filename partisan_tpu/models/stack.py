@@ -51,12 +51,18 @@ class UpperProtocol(ProtocolBase):
         """Padded peer-id list from the lower layer: a partial-view manager
         exposes its active view directly; otherwise the lower protocol's
         own member_mask is the source of truth (so its semantics — e.g.
-        eviction handling — propagate to the broadcast layer)."""
-        lower = row.lower
-        if hasattr(lower, "active"):
-            return lower.active
+        eviction handling — propagate to the broadcast layer).  Nested
+        stacks unwrap to the innermost membership layer."""
+        innermost = row.lower
+        while isinstance(innermost, StackState):
+            innermost = innermost.lower
+        if hasattr(innermost, "active"):
+            return innermost.active
         if self._lower_proto is not None:
-            mask = self._lower_proto.member_mask(lower)
+            # member_mask expects the lower protocol's OWN state shape
+            # (a Stacked lower takes the StackState, not the unwrapped
+            # innermost row)
+            mask = self._lower_proto.member_mask(row.lower)
             idx, = jnp.nonzero(mask, size=self.emit_cap, fill_value=-1)
             return idx.astype(jnp.int32)
         raise NotImplementedError(
@@ -78,14 +84,23 @@ class Stacked(ProtocolBase):
         self.tick_emit_cap = lower.tick_emit_cap + upper.tick_emit_cap
         self.ctl_peer_field = lower.ctl_peer_field
         # rewire both sub-protocols to emit in the stacked message space
+        # (recursively: a lower that is itself a Stacked propagates the
+        # unioned spec/caps down to ITS sub-protocols, so three-layer
+        # stacks emit structurally identical Msgs)
         for sub, off in ((lower, 0), (upper, len(lower.msg_types))):
-            sub._typ_offset = off
-            sub.data_spec = spec
-            sub.emit_cap = self.emit_cap
+            sub._rewire(spec, self.emit_cap, off)
         upper._lower_proto = lower
 
     def typ(self, name: str) -> int:
-        return self.msg_types.index(name)
+        return self.msg_types.index(name) + getattr(self, "_typ_offset", 0)
+
+    def _rewire(self, spec, emit_cap, offset) -> None:
+        self._typ_offset = offset
+        self.data_spec = spec
+        self.emit_cap = emit_cap
+        for sub, off in ((self.lower, offset),
+                         (self.upper, offset + len(self.lower.msg_types))):
+            sub._rewire(spec, emit_cap, off)
 
     def handlers(self) -> Tuple:
         def wrap_lower(h):
@@ -94,8 +109,9 @@ class Stacked(ProtocolBase):
                 return row.replace(lower=lrow), em
             return f
 
-        lows = tuple(wrap_lower(getattr(self.lower, "handle_" + t))
-                     for t in self.lower.msg_types)
+        # go through handlers() (not getattr) so a lower that is itself a
+        # Stacked contributes its already-wrapped table — nesting works
+        lows = tuple(wrap_lower(h) for h in self.lower.handlers())
         ups = tuple(getattr(self.upper, "handle_" + t)
                     for t in self.upper.msg_types)
         return lows + ups

@@ -117,20 +117,34 @@ def biased_bits(key: jax.Array, p: float, w: int,
     word index: adequate for simulation masks (churn, gossip coins), not
     for cryptography or statistics-grade sampling."""
     assert 0.0 < p < 1.0
-    # truncation depth: 2^-D <= p * rel_err (each bit position of u is one
-    # uniform random word; we realize the event "u < p" bit-serially)
+    salt = jax.random.bits(key, (), jnp.uint32)
+    iota = jnp.arange(w, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    draw = lambda d: mix32(
+        iota ^ salt ^ jnp.uint32((d * 0x9E3779B9) & 0xFFFFFFFF))
+    return bernoulli_expand(draw, p, rel_err, max_depth)
+
+
+def bernoulli_expand(draw, p: float, rel_err: float = 0.005,
+                     max_depth: int = 20) -> jax.Array:
+    """The bit-serial "u < p" comparison shared by every packed-Bernoulli
+    source (biased_bits above; the pallas kernel's on-core PRNG variant in
+    ops/rumor_kernel.py): ``draw(d)`` supplies the uint32 uniform words
+    for bit position d.  ONE definition so the two paths' statistics can
+    never desynchronize.
+
+    Truncation depth: 2^-D <= p * rel_err.  u < p iff at the first
+    differing bit position u has 0 and p has 1; ``eq`` tracks lanes whose
+    u-prefix still equals p's prefix."""
     D = 1
     while 2.0 ** -D > p * rel_err and D < max_depth:
         D += 1
-    salt = jax.random.bits(key, (), jnp.uint32)
-    iota = jnp.arange(w, dtype=jnp.uint32) * jnp.uint32(2654435761)
-    # u < p iff at the first differing bit position u has 0 and p has 1;
-    # eq tracks lanes whose u-prefix still equals p's prefix
-    eq = jnp.full((w,), 0xFFFFFFFF, jnp.uint32)
-    out = jnp.zeros((w,), jnp.uint32)
+    eq = out = None
     frac = p
     for d in range(1, D + 1):
-        u = mix32(iota ^ salt ^ jnp.uint32((d * 0x9E3779B9) & 0xFFFFFFFF))
+        u = draw(d)
+        if eq is None:
+            eq = jnp.full(u.shape, 0xFFFFFFFF, jnp.uint32)
+            out = jnp.zeros(u.shape, jnp.uint32)
         frac *= 2.0
         if frac >= 1.0:              # p's bit at depth d is 1
             frac -= 1.0

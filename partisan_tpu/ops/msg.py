@@ -86,6 +86,20 @@ def concat(*bufs: Msgs) -> Msgs:
     return jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *bufs)
 
 
+def pad_to(m: Msgs, cap: int) -> Msgs:
+    """Extend a buffer to ``cap`` slots with invalid padding (no-op when
+    already that size).  The engine normalizes every handler emission to
+    the protocol's emit_cap this way — a narrower buffer would otherwise
+    BROADCAST against the [N, emit_cap] slot table inside the per-type
+    select, silently replicating each message emit_cap times."""
+    if m.cap == cap:
+        return m
+    assert m.cap < cap, f"emission cap {m.cap} exceeds protocol cap {cap}"
+    pad = jax.tree_util.tree_map(
+        lambda x: jnp.zeros((cap - m.cap,) + x.shape[1:], x.dtype), m)
+    return concat(m, pad)
+
+
 def compact(m: Msgs, cap: int) -> Tuple[Msgs, jax.Array]:
     """Pack valid messages to the front and truncate/pad to ``cap`` slots.
     Returns (buffer, dropped_count) — overflow is counted, never silent

@@ -69,27 +69,14 @@ def _flat_bit_roll(x: jax.Array, s: jax.Array, n: int) -> jax.Array:
     return jnp.where(r == 0, xw, (xw << r) | carry)
 
 
-def _bernoulli_words(p: float, shape, rel_err: float = 0.005,
-                     max_depth: int = 20) -> jax.Array:
-    """Packed Bernoulli(p) bits from the on-core PRNG — the bit-serial
-    "u < p" comparison of ops/bitset.biased_bits, one fresh uint32 draw
-    per expansion depth."""
-    D = 1
-    while 2.0 ** -D > p * rel_err and D < max_depth:
-        D += 1
-    eq = jnp.full(shape, 0xFFFFFFFF, jnp.uint32)
-    out = jnp.zeros(shape, jnp.uint32)
-    frac = p
-    for _ in range(D):
-        u = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-        frac *= 2.0
-        if frac >= 1.0:
-            frac -= 1.0
-            out = out | (eq & ~u)
-            eq = eq & u
-        else:
-            eq = eq & ~u
-    return out
+def _bernoulli_words(p: float, shape) -> jax.Array:
+    """Packed Bernoulli(p) bits from the on-core PRNG — the shared
+    bit-serial expansion (ops/bitset.bernoulli_expand) fed by
+    ``pltpu.prng_random_bits`` draws."""
+    from .bitset import bernoulli_expand
+    draw = lambda d: pltpu.bitcast(pltpu.prng_random_bits(shape),
+                                   jnp.uint32)
+    return bernoulli_expand(draw, p)
 
 
 def _round_body(i, seed, inf, hot, alive, n, fanout, stop_k, churn):
@@ -123,7 +110,9 @@ def _round_body(i, seed, inf, hot, alive, n, fanout, stop_k, churn):
         new_hot = new_hot & ~reborn
 
     # sustained gossip: reseed a random patient zero when the rumor died
-    dead = jnp.sum((new_hot & alive).astype(jnp.int32)) == 0
+    # count NONZERO WORDS (a raw int32 cast of uint32 words can wrap the
+    # sum to 0 while hot bits remain)
+    dead = jnp.sum(((new_hot & alive) != 0).astype(jnp.int32)) == 0
     pz = (sbits[1, 0] % jnp.uint32(n)).astype(jnp.int32)
     wi, bi = pz // WORD, (pz % WORD).astype(jnp.uint32)
     row = jax.lax.broadcasted_iota(jnp.int32, inf.shape, 0)

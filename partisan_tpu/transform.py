@@ -1,0 +1,105 @@
+"""Imperative-handler transform — the ``partisan_transform.erl`` analog.
+
+The reference ships a parse transform that rewrites user code written
+against BEAM-local primitives (``Pid ! Msg``, ``self()``) into
+partisan-routed calls (``forward_message``, ``partisan_util:pid()``)
+(src/partisan_transform.erl:37-47), so protocol modules read like plain
+Erlang while running over the partisan transport.
+
+The TPU engine's native handler contract is functional: a handler returns
+``(row, Msgs)`` built through :meth:`ProtocolBase.emit`.  This module is
+the same ergonomic bridge for Python: write handlers in imperative style —
+call ``send(dst, "type", **data)`` as many times as you like, mutate
+nothing, return just the row — and the transform collects the sends into
+one fixed-shape emission buffer behind the scenes:
+
+    class Gossip(transformed(ProtocolBase)):
+        msg_types = ("rumor", "ctl_join")
+        emit_cap = 8
+
+        def handle_rumor(self, cfg, me, row, m, key, send):
+            for p in row.peers:            # padded set; -1s are skipped
+                send(p, "rumor", payload=m.data["payload"])
+            return row
+
+Like the parse transform, this is sugar only: the wrapped handlers are
+exactly standard handlers (``transformed`` classes interoperate with
+stacking, interposition, and every engine feature), and ``send`` is the
+``!``-analog whose destination may be a scalar, a padded view row, or a
+masked array — invalid (< 0) destinations are dropped, mirroring how the
+rewritten ``!`` still routes through forward_message's validity checks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Type
+
+import jax.numpy as jnp
+
+from .engine import ProtocolBase
+from .ops.msg import Msgs
+
+
+class Sender:
+    """Collects imperative ``send`` calls for one handler invocation."""
+
+    def __init__(self, proto: ProtocolBase):
+        self._proto = proto
+        self._emits: List[Msgs] = []
+
+    def __call__(self, dst, typ, *, channel=None, delay=None, valid=None,
+                 **data) -> None:
+        typ_idx = self._proto.typ(typ) if isinstance(typ, str) else typ
+        dst = jnp.atleast_1d(jnp.asarray(dst, jnp.int32))
+        self._emits.append(self._proto.emit(
+            dst, typ_idx, cap=int(dst.shape[0]), channel=channel,
+            delay=delay, valid=valid, **data))
+
+    def collect(self, cap: int) -> Msgs:
+        # slot budget is static, so overflow is a LOUD trace-time error —
+        # transformed handlers never see cap plumbing, and merge would
+        # otherwise truncate silently (the never-silent-drops invariant)
+        total = sum(em.cap for em in self._emits)
+        assert total <= cap, (
+            f"transformed handler sends up to {total} messages but the "
+            f"protocol's emit cap is {cap}; raise emit_cap/tick_emit_cap")
+        if not self._emits:
+            return self._proto.no_emit(cap)
+        return self._proto.merge(*self._emits, cap=cap)
+
+
+def _wrap(fn: Callable, cap_attr: str) -> Callable:
+    @functools.wraps(fn)
+    def handler(self, cfg, me, row, *rest):
+        send = Sender(self)
+        out = fn(self, cfg, me, row, *rest, send)
+        cap = getattr(self, cap_attr)
+        return out, send.collect(cap)
+    handler._partisan_transformed = True
+    return handler
+
+
+def transformed(base: Type[ProtocolBase] = ProtocolBase) -> type:
+    """Class factory: subclasses write ``handle_<type>(..., send)`` /
+    ``tick(..., send)`` in imperative style; the metaclass rewrites them
+    into the engine's functional ``(row, Msgs)`` contract at class-creation
+    time — the import-time rewrite being exactly when the reference's
+    parse transform runs (compile time)."""
+
+    class _TransformMeta(type(base)):
+        def __new__(mcls, name, bases, ns):
+            for key, val in list(ns.items()):
+                if not callable(val) or \
+                        getattr(val, "_partisan_transformed", False):
+                    continue
+                if key.startswith("handle_"):
+                    ns[key] = _wrap(val, "emit_cap")
+                elif key == "tick":
+                    ns[key] = _wrap(val, "tick_emit_cap")
+            return super().__new__(mcls, name, bases, ns)
+
+    class Transformed(base, metaclass=_TransformMeta):
+        pass
+
+    return Transformed

@@ -71,6 +71,9 @@ def main() -> None:
     ap.add_argument("--gather", type=int, default=None,
                     help="deliver_gather_cap for the engine configs "
                          "(sparse dispatch; see Config)")
+    ap.add_argument("--node-cap", type=int, default=None,
+                    help="node_emit_cap: per-node emission pre-compaction "
+                         "budget (see Config)")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -81,7 +84,8 @@ def main() -> None:
     if want("full_membership"):
         # BASELINE #1: full membership, small cluster
         cfg = pt.Config(n_nodes=16, inbox_cap=32, periodic_interval=2,
-                        deliver_gather_cap=args.gather)
+                        deliver_gather_cap=args.gather,
+                        node_emit_cap=args.node_cap)
         time_engine("full_membership", cfg, FullMembership(cfg), R,
                     lambda w: "converged" if bool(
                         (np.asarray(jax.vmap(FullMembership(cfg).member_mask)(
@@ -90,7 +94,8 @@ def main() -> None:
     if want("hyparview"):
         # BASELINE #2: HyParView N=64
         cfg = pt.Config(n_nodes=64, inbox_cap=8, shuffle_interval=5,
-                        deliver_gather_cap=args.gather)
+                        deliver_gather_cap=args.gather,
+                        node_emit_cap=args.node_cap)
         hv = HyParView(cfg)
         time_engine("hyparview", cfg, hv, R,
                     lambda w: "connected" if bool(graph.is_connected(
@@ -100,7 +105,8 @@ def main() -> None:
     if want("plumtree"):
         # BASELINE #3: plumtree over hyparview N=64
         cfg = pt.Config(n_nodes=64, inbox_cap=12, shuffle_interval=5,
-                        deliver_gather_cap=args.gather)
+                        deliver_gather_cap=args.gather,
+                        node_emit_cap=args.node_cap)
         time_engine("plumtree_over_hyparview", cfg,
                     Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1)), R,
                     lambda w: "ok", rows)
@@ -108,7 +114,8 @@ def main() -> None:
     if want("scamp"):
         # BASELINE #4: SCAMP v2 at 1024
         cfg = pt.Config(n_nodes=1024, inbox_cap=16, periodic_interval=5,
-                        deliver_gather_cap=args.gather)
+                        deliver_gather_cap=args.gather,
+                        node_emit_cap=args.node_cap)
         sc = ScampV2(cfg)
         time_engine("scamp_v2", cfg, sc, R,
                     lambda w: "connected" if bool(graph.is_connected(

@@ -284,3 +284,90 @@ class TestBitsetRolls:
             bits = bitset.biased_bits(k, p, 31250)
             dens = float(jnp.sum(jnp.bitwise_count(bits))) / (31250 * 32)
             assert abs(dens - p) < max(0.02 * p, 5e-4), (p, dens)
+
+
+class TestNodeEmitCap:
+    """cfg.node_emit_cap pre-compaction: identical trajectories when the
+    per-node budget is not exceeded; counted drops when it is."""
+
+    def test_equivalent_when_roomy(self):
+        import partisan_tpu as pt
+        from partisan_tpu import peer_service
+        from partisan_tpu.models.full_membership import FullMembership
+
+        worlds = {}
+        for cap in (None, 64):
+            cfg = pt.Config(n_nodes=8, inbox_cap=8, periodic_interval=3,
+                            node_emit_cap=cap)
+            proto = FullMembership(cfg)
+            world = pt.init_world(cfg, proto)
+            world = peer_service.cluster(
+                world, proto, [(i, 0) for i in range(1, 8)])
+            step = pt.make_step(cfg, proto, donate=False)
+            for _ in range(12):
+                world, m = step(world)
+            assert int(m["out_dropped"]) == 0
+            worlds[cap] = world
+        for la, lb in zip(jax.tree_util.tree_leaves(worlds[None].state),
+                          jax.tree_util.tree_leaves(worlds[64].state)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_overflow_counted(self):
+        import partisan_tpu as pt
+        from partisan_tpu import peer_service
+        from partisan_tpu.models.full_membership import FullMembership
+
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, periodic_interval=2,
+                        node_emit_cap=1)
+        proto = FullMembership(cfg)
+        world = pt.init_world(cfg, proto)
+        world = peer_service.cluster(
+            world, proto, [(i, 0) for i in range(1, 8)])
+        step = pt.make_step(cfg, proto, donate=False)
+        total_dropped = 0
+        for _ in range(10):
+            world, m = step(world)
+            total_dropped += int(m["out_dropped"])
+        assert total_dropped > 0
+
+
+class TestEmissionPadding:
+    """Regression: a handler replying with a NARROWER buffer than
+    emit_cap (e.g. one cap=1 pong) must yield exactly one message, not
+    emit_cap broadcast copies (ops/msg.pad_to + engine normalization)."""
+
+    def test_single_reply_not_amplified(self):
+        import partisan_tpu as pt
+        from partisan_tpu.engine import ProtocolBase
+        from partisan_tpu.peer_service import send_ctl
+
+        class PingPong(ProtocolBase):
+            msg_types = ("ping", "pong", "ctl_go")
+            emit_cap = 5
+
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self.data_spec = {"peer": ((), jnp.int32)}
+
+            def init(self, cfg, key):
+                return jnp.zeros((cfg.n_nodes,), jnp.int32)
+
+            def handle_ping(self, cfg, me, row, m, key):
+                return row, self.emit(m.src[None], self.typ("pong"), cap=1)
+
+            def handle_pong(self, cfg, me, row, m, key):
+                return row + 1, self.no_emit()
+
+            def handle_ctl_go(self, cfg, me, row, m, key):
+                return row, self.emit(m.data["peer"][None],
+                                      self.typ("ping"), cap=1)
+
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = PingPong(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 0, "ctl_go", peer=2)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state[0]) == 1      # exactly ONE pong came back
+        assert int(np.asarray(world.state).sum()) == 1
