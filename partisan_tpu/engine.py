@@ -330,9 +330,12 @@ def make_step(
         dkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 1)
         state, demits = deliver_batch(state, inbox, dkeys, node_ids)
 
-        # -- tick (timer phase)
+        # -- tick (timer phase); emissions normalized like handler ones
         tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 2)
-        tick = lambda i, r, k: proto.tick(cfg, i, r, rnd, k)
+
+        def tick(i, r, k):
+            r2, em = proto.tick(cfg, i, r, rnd, k)
+            return r2, msgops.pad_to(em, T)
         state, temits = jax.vmap(tick, in_axes=(0, 0, 0))(node_ids, state, tkeys)
 
         # -- collect: flatten [N, K*E] and [N, T] emissions, stamp src ids

@@ -32,6 +32,8 @@ class DistState:
     peer: jax.Array      # [N, P] measured-peer ids (-1 free)
     rtt: jax.Array       # [N, P] last RTT in rounds (-1 unknown)
     last_rnd: jax.Array  # [N] round counter mirror (ticked every round)
+    cursor: jax.Array    # [N] round-robin eviction slot when the table
+                         # is full (measurements are never silently lost)
 
 
 class Distance(UpperProtocol):
@@ -52,6 +54,7 @@ class Distance(UpperProtocol):
             peer=jnp.full((n, self.P), -1, jnp.int32),
             rtt=jnp.full((n, self.P), -1, jnp.int32),
             last_rnd=jnp.zeros((n,), jnp.int32),
+            cursor=jnp.zeros((n,), jnp.int32),
         )
 
     # --------------------------------------------------------------- handlers
@@ -67,11 +70,17 @@ class Distance(UpperProtocol):
         up = row.upper
         rtt = (up.last_rnd + 1) - m.data["stamp"]
         hit = up.peer == m.src
-        slot = jnp.where(hit.any(), jnp.argmax(hit), jnp.argmax(up.peer < 0))
-        ok = hit.any() | (up.peer[slot] < 0)
+        free = up.peer < 0
+        # existing slot, else a free one, else round-robin-evict the
+        # cursor slot — a fresh measurement is never thrown away
+        slot = jnp.where(hit.any(), jnp.argmax(hit),
+                         jnp.where(free.any(), jnp.argmax(free),
+                                   up.cursor % self.P))
+        evicting = ~hit.any() & ~free.any()
         up = up.replace(
-            peer=up.peer.at[slot].set(jnp.where(ok, m.src, up.peer[slot])),
-            rtt=up.rtt.at[slot].set(jnp.where(ok, rtt, up.rtt[slot])))
+            peer=up.peer.at[slot].set(m.src),
+            rtt=up.rtt.at[slot].set(rtt),
+            cursor=up.cursor + evicting.astype(jnp.int32))
         return self.up(row, up), self.no_emit()
 
     # ------------------------------------------------------------------ timer

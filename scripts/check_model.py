@@ -1,0 +1,101 @@
+"""Model-checker CLI — the analog of the reference's driver scripts
+(``bin/check-model.sh`` / ``bin/filibuster.sh`` and the Makefile targets
+``lampson-2pc`` / ``bernstein-ctp`` / ``skeen-3pc`` with their expected
+"Passed: N, Failed: M" lines, /root/reference/Makefile:105-113).
+
+Runs the omission-schedule model checker (verify/model_checker.py) over
+one of the commit-protocol workloads and prints the same pass/fail
+summary the reference CI greps for:
+
+    $ python scripts/check_model.py lampson_2pc
+    golden trace: 24 messages, invariant holds
+    Passed: 9, Failed: 3
+    failing schedules:
+      drop (round 3, 0 -> 1, commit)
+      ...
+
+Exit status is 0 when the observed failure count matches the protocol's
+KNOWN count (2PC blocks, 3PC has the uncertainty window, CTP recovers
+everything) — so this doubles as the CI check."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu.models.commit import (  # noqa: E402
+    P_ABORTED, P_COMMITTED, AlsbergDay, BernsteinCTP, Skeen3PC,
+    TwoPhaseCommit)
+from partisan_tpu.peer_service import send_ctl  # noqa: E402
+from partisan_tpu.verify.model_checker import ModelChecker  # noqa: E402
+
+# protocol -> (class, checked message types, rounds, expected failures/node)
+WORKLOADS = {
+    "lampson_2pc": (TwoPhaseCommit,
+                    ("prepare", "prepared", "commit", "commit_ack"), 24, 1),
+    "bernstein_ctp": (BernsteinCTP,
+                      ("prepare", "prepared", "commit", "commit_ack"), 44, 0),
+    "skeen_3pc": (Skeen3PC,
+                  ("prepare", "prepared", "precommit", "precommit_ack",
+                   "commit", "commit_ack"), 44, 1),
+}
+
+
+def invariant(world) -> bool:
+    """Agreement + termination over participant decisions
+    (the postcondition the reference's filibuster checks drive)."""
+    status = np.asarray(world.state.p_status)
+    decided = ((status == P_COMMITTED) | (status == P_ABORTED)).all()
+    mixed = (status == P_COMMITTED).any() and (status == P_ABORTED).any()
+    return bool(decided and not mixed)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("protocol", choices=sorted(WORKLOADS))
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--drops", type=int, default=1,
+                    help="max simultaneous omissions per schedule")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    cls, typ_names, rounds, fails_per_node = WORKLOADS[args.protocol]
+    cfg = pt.Config(n_nodes=args.nodes, inbox_cap=2 * args.nodes)
+    proto = cls(cfg)
+
+    def setup(world):
+        return send_ctl(world, proto, 0, "ctl_broadcast", value=5)
+
+    mc = ModelChecker(cfg, proto, setup, invariant, n_rounds=rounds)
+    res = mc.check(candidate_typs=[proto.typ(t) for t in typ_names],
+                   max_drops=args.drops)
+
+    ok = "holds" if res.golden.invariant_ok else "VIOLATED"
+    print(f"golden trace: {len(res.golden.wire_keys)} messages, "
+          f"invariant {ok}")
+    print(f"Passed: {res.passed}, Failed: {res.failed}")
+    if res.failures:
+        print("failing schedules:")
+        for sched in res.failures:
+            for (rnd, src, dst, typ) in sched:
+                name = proto.msg_types[typ]
+                print(f"  drop (round {rnd}, {src} -> {dst}, {name})")
+
+    expected_failed = fails_per_node * args.nodes
+    if args.drops == 1 and res.failed != expected_failed:
+        print(f"UNEXPECTED: wanted {expected_failed} failing schedules")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,3 +78,25 @@ class TestNestedStack:
         for node in range(8):
             seen.update(distances(world, node))
         assert seen and set(seen.values()) == {2}
+
+
+class TestEviction:
+    def test_full_table_round_robin_evicts(self):
+        """A pong from an unseen peer when the table is full must still
+        be recorded (round-robin eviction — never silently lost)."""
+        cfg = pt.Config(n_nodes=5, inbox_cap=16, distance_enabled=True,
+                        distance_interval=3)
+        proto = Stacked(HyParView(cfg), Distance(cfg, peer_cap=1))
+        world = pt.init_world(cfg, proto)
+        world = peer_service.cluster(world, proto,
+                                     [(i, 0) for i in range(1, 5)])
+        step = pt.make_step(cfg, proto, donate=False)
+        for _ in range(24):
+            world, _ = step(world)
+        # with a 1-slot table and several active peers, measurements keep
+        # landing (the slot holds SOME live peer with a valid rtt)
+        recorded = [distances(world, n) for n in range(5)]
+        assert any(d for d in recorded), recorded
+        for d in recorded:
+            for rtt in d.values():
+                assert rtt == 2
