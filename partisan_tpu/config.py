@@ -97,6 +97,20 @@ class Config:
     #   empty slots through that sort — the dominant engine cost there).
     #   Per-node overflow is counted in the out_dropped metric, never
     #   silent.  None = no pre-compaction.
+    deliver_gate: bool = True
+    # ^ False removes the per-(slot, type) emptiness conds from the
+    #   deliver loop: every handler runs full-batch every slot.  The
+    #   gates are what make SMALL-N rounds cheap (skip absent types), but
+    #   the branch machinery dominates XLA *compile* time at scale — on
+    #   TPU the gated HyParView program at N=4096 did not finish
+    #   compiling in 10 min, while the ungated one is a flat fusable
+    #   pipeline.  Rule of thumb: gate on CPU/small N, ungate for big-N
+    #   TPU runs.  (Measured later: with the batched cluster() fix, the
+    #   gated program compiles fine on TPU and gated+gather beats ungated
+    #   at N=4096 — 18 vs 11 rounds/s — so prefer gated unless compile
+    #   time is the problem.)  False takes precedence over
+    #   deliver_gather_cap: without gates there is no sparse branch, so
+    #   the gather knob is ignored.
     deliver_gather_cap: Optional[int] = None
     # ^ sparse-delivery gather width G: when set (and < n_nodes), each
     #   (inbox-slot, msg-type) dispatch gathers only the <= G receiving node

@@ -250,6 +250,10 @@ def make_step(
                     em_slot = _sel_where(sel, em2, em_slot)
                     return state, em_slot
 
+                if not cfg.deliver_gate:
+                    state, em_slot = dense((state, em_slot))
+                    continue
+
                 if G is None:
                     state, em_slot = jax.lax.cond(
                         jnp.any(sel), dense, lambda op: op, (state, em_slot))

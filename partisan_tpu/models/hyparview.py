@@ -413,9 +413,15 @@ class HyParView(ProtocolBase):
                        prio=prio, sample=sample,
                        dcid=self._my_dcid_for(row, cand))
 
-        # join retry while isolated (connection retry, pluggable :944-969)
+        # join retry until the CONTACT acknowledges (connection retry of
+        # the pending set, pluggable :944-969 — pending clears on
+        # `connected`, NOT on merely having some other active peer; gating
+        # on an empty view lets a clique of storm-dropped joiners satisfy
+        # each other and form a permanently disconnected island)
+        row = row.replace(contact=jnp.where(
+            ps.contains(row.active, row.contact), -1, row.contact))
         retry_due = (((rnd % cfg.connection_retry_interval) == 0) & stay
-                     & (ps.size(row.active) == 0) & (row.contact >= 0))
+                     & (row.contact >= 0))
         jn = self.emit(jnp.where(retry_due, row.contact, -1)[None],
                        self.typ("join"), cap=self.tick_emit_cap,
                        dcid=self._my_dcid_for(row, row.contact))

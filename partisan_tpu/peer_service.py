@@ -54,11 +54,28 @@ def cluster(world: World, proto: ProtocolBase,
     (test/partisan_support.erl cluster/3).  ``stagger > 0`` trickles joins
     in batches of ``stagger`` per round (the reference's sequential join +
     avoid_rush jitter, pluggable :1423-1458) to keep join storms under the
-    contact node's inbox cap."""
-    for i, (node, peer) in enumerate(pairs):
-        world = join(world, proto, node, peer,
-                     delay=(i // stagger) if stagger else 0)
-    return world
+    contact node's inbox cap.
+
+    All joins are injected as ONE batched buffer write: per-join injects
+    are eager device ops, and at N in the thousands the per-dispatch
+    latency (~100 ms through the TPU tunnel) would dwarf everything else.
+    """
+    if not pairs:
+        return world
+    k = len(pairs)
+    nodes = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    peers = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    delays = jnp.asarray([(i // stagger) if stagger else 0
+                          for i in range(k)], jnp.int32)
+    em = proto.emit(nodes, proto.typ("ctl_join"), cap=k, delay=delays,
+                    **{proto.ctl_peer_field: peers})
+    msgs, dropped = msgops.inject(world.msgs, em, src=nodes)
+    if not isinstance(dropped, jax.core.Tracer) and int(dropped) > 0:
+        # host path only — inside jit the caller owns overflow accounting
+        raise ValueError(
+            f"in-flight buffer too small for the join batch "
+            f"({int(dropped)} of {k} joins dropped); raise out_cap")
+    return world.replace(msgs=msgs)
 
 
 def members(world: World, proto: ProtocolBase, node: int) -> jax.Array:

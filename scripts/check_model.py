@@ -30,8 +30,7 @@ import numpy as np  # noqa: E402
 
 import partisan_tpu as pt  # noqa: E402
 from partisan_tpu.models.commit import (  # noqa: E402
-    P_ABORTED, P_COMMITTED, AlsbergDay, BernsteinCTP, Skeen3PC,
-    TwoPhaseCommit)
+    P_ABORTED, P_COMMITTED, BernsteinCTP, Skeen3PC, TwoPhaseCommit)
 from partisan_tpu.peer_service import send_ctl  # noqa: E402
 from partisan_tpu.verify.model_checker import ModelChecker  # noqa: E402
 
