@@ -53,16 +53,36 @@ class PtState:
     seq: jax.Array        # [N, K] highest seq delivered per key
     val: jax.Array        # [N, K] value at that seq
     next_seq: jax.Array   # [N] local broadcast seq source
+    known: jax.Array      # [N, A] membership snapshot for neighbor-up
+                          # detection (new members join every eager set,
+                          # plumtree_broadcast :314-336, 652-659)
 
 
 class Plumtree(UpperProtocol):
     msg_types = ("bcast", "i_have", "graft", "prune", "exchange",
                  "ctl_pt_broadcast")
 
-    def __init__(self, cfg: Config, n_keys: int = 1, n_roots: int = 4):
+    def __init__(self, cfg: Config, n_keys: int = 1, n_roots: int = 4,
+                 heartbeats: bool = False):
+        """``heartbeats=True`` reproduces the default backend's tree
+        keepalive (partisan_plumtree_backend.erl:110-124, 179-200): every
+        ``cfg.broadcast_heartbeat_interval`` rounds each node broadcasts a
+        fresh value on key ``me % n_keys`` — with ``n_keys = n_nodes``
+        that is exactly the reference's per-origin {node, timestamp}
+        store, and the periodic broadcasts keep exercising (and thereby
+        repairing) the eager/lazy tree.  EVERY node is then a broadcast
+        root, so size ``n_roots >= n_nodes`` (the per-root eager/lazy
+        table holds ``n_roots`` concurrent trees; an overflowing root's
+        pushes are silently bucketed away)."""
         self.cfg = cfg
         self.K = n_keys
         self.R = n_roots
+        self.heartbeats = heartbeats
+        if heartbeats and n_roots < cfg.n_nodes:
+            raise ValueError(
+                f"heartbeats make every node a broadcast root: n_roots="
+                f"{n_roots} < n_nodes={cfg.n_nodes} would thrash the "
+                f"root-bucket table (colliding roots evict each other)")
         self.A = cfg.max_active_size
         self.data_spec: Dict = {
             "pt_root": ((), jnp.int32),
@@ -73,7 +93,7 @@ class Plumtree(UpperProtocol):
         }
         # handle_bcast worst case: A eager pushes + A lazy i_haves + 1 prune
         self.emit_cap = 2 * cfg.max_active_size + 1
-        self.tick_emit_cap = 1
+        self.tick_emit_cap = 2 if heartbeats else 1
 
     # -- the partisan_plumtree_broadcast_handler behaviour (:26-43) ---------
     # Default implementation = partisan_plumtree_backend's monotonically-
@@ -111,6 +131,7 @@ class Plumtree(UpperProtocol):
             seq=jnp.zeros((n, self.K), jnp.int32),
             val=jnp.zeros((n, self.K), jnp.int32),
             next_seq=jnp.zeros((n,), jnp.int32),
+            known=jnp.full((n, self.A), -1, jnp.int32),
         )
 
     # ------------------------------------------------------- tree primitives
@@ -240,11 +261,39 @@ class Plumtree(UpperProtocol):
     # ------------------------------------------------------------------ timer
 
     def tick_upper(self, cfg, me, row: StackState, rnd, key):
-        """exchange_tick (:346-350): anti-entropy with one random peer."""
-        due = ((rnd + me) % cfg.exchange_tick_period) == 0
-        peer = ps.random_member(self.active_peers(row), key)
+        """exchange_tick (:346-350): anti-entropy with one random peer;
+        optional heartbeat broadcast (backend :110-124) via a self-
+        addressed ctl, one hop like the reference's self-cast."""
         up = row.upper
+        peers = self.active_peers(row)[: self.A]
+        # neighbor-up: members that appeared since the last tick join
+        # every OWNED root bucket's eager set (:314-336, 652-659) — a
+        # bucket allocated while this node was isolated would otherwise
+        # keep an empty eager set forever and its root could never push
+        already = jax.vmap(lambda x: ps.contains(up.known, x))(peers)
+        new = jnp.where(already, -1, peers)
+        owned = up.root_key >= 0
+        eager = up.eager
+        for j in range(new.shape[0]):          # static unroll over A
+            pj = new[j]
+            add = owned & ~jax.vmap(ps.contains, in_axes=(0, None))(
+                up.lazy, pj)
+            eager = jax.vmap(ps.insert)(
+                eager, jnp.where(add, pj, -1))
+        up = up.replace(eager=eager, known=peers)
+
+        due = ((rnd + me) % cfg.exchange_tick_period) == 0
+        peer = ps.random_member(peers, key)
+        # the reference's exchange walks ALL keys (:455-485); rotate one
+        # key per exchange tick so each key is anti-entropied in turn
+        k_ex = (rnd // cfg.exchange_tick_period + me) % self.K
         em = self.emit(jnp.where(due, peer, -1)[None], self.typ("exchange"),
-                       cap=self.tick_emit_cap, pt_key=0,
-                       pt_seq=up.seq[0], pt_val=up.val[0])
-        return row, em
+                       cap=self.tick_emit_cap, pt_key=k_ex,
+                       pt_seq=up.seq[k_ex], pt_val=up.val[k_ex])
+        if self.heartbeats:
+            hb_due = ((rnd + me) % cfg.broadcast_heartbeat_interval) == 0
+            hb = self.emit(jnp.where(hb_due, me, -1)[None],
+                           self.typ("ctl_pt_broadcast"), cap=1,
+                           pt_key=me % self.K, pt_val=rnd)
+            em = self.merge(em, hb, cap=self.tick_emit_cap)
+        return self.up(row, up), em

@@ -8,6 +8,7 @@ import pytest
 
 import partisan_tpu as pt
 from partisan_tpu import peer_service
+from partisan_tpu.peer_service import send_ctl
 from partisan_tpu.engine import init_world, make_step
 from partisan_tpu.models.hyparview import HyParView
 from partisan_tpu.models.plumtree import Plumtree
@@ -73,3 +74,61 @@ def test_partitioned_node_catches_up_via_exchange(booted):
         world, _ = step(world)
     vals = np.asarray(world.state.upper.val[:, 0])
     assert vals[11] == 7, "exchange must deliver the missed value"
+
+
+def test_heartbeats_keep_per_origin_timestamps_fresh():
+    """Plumtree(heartbeats=True, n_keys=N): the default backend's tree
+    keepalive — every node's {origin -> timestamp} store converges and
+    keeps advancing (partisan_plumtree_backend.erl:110-124, 179-200)."""
+    n = 8
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5,
+                    broadcast_heartbeat_interval=4)
+    proto = Stacked(HyParView(cfg),
+                    Plumtree(cfg, n_keys=n, n_roots=n, heartbeats=True))
+    world = pt.init_world(cfg, proto)
+    world = peer_service.cluster(world, proto,
+                                 [(i, 0) for i in range(1, n)])
+    step = pt.make_step(cfg, proto, donate=False)
+    for _ in range(30):
+        world, _ = step(world)
+    seq = np.asarray(world.state.upper.seq)       # [N, n_keys]
+    # every node has heard at least one heartbeat from every origin
+    assert (seq > 0).all(), seq
+    prev = seq
+    for _ in range(10):
+        world, _ = step(world)
+    assert (np.asarray(world.state.upper.seq) >= prev).all()
+    assert (np.asarray(world.state.upper.seq) > prev).any()
+
+
+def test_late_joiners_enter_existing_eager_sets():
+    """Neighbor-up repair (:314-336, 652-659): a root whose tree bucket
+    was allocated in a tiny cluster must push to members that join
+    LATER — without the membership-delta path its eager set would stay
+    frozen at allocation time."""
+    n = 6
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=4)
+    proto = Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1))
+    world = pt.init_world(cfg, proto)
+    world = peer_service.cluster(world, proto, [(1, 0)])
+    step = pt.make_step(cfg, proto, donate=False)
+    for _ in range(6):
+        world, _ = step(world)
+    # root 0 allocates its bucket while only {0, 1} exist
+    world = send_ctl(world, proto, 0, "ctl_pt_broadcast", pt_key=0,
+                     pt_val=111)
+    for _ in range(4):
+        world, _ = step(world)
+    # the rest of the cluster joins afterwards
+    world = peer_service.cluster(world, proto,
+                                 [(i, 0) for i in range(2, n)])
+    for _ in range(10):
+        world, _ = step(world)
+    # a fresh broadcast from the SAME (pre-existing) root bucket must now
+    # reach the late joiners through its repaired eager set
+    world = send_ctl(world, proto, 0, "ctl_pt_broadcast", pt_key=0,
+                     pt_val=222)
+    for _ in range(12):
+        world, _ = step(world)
+    val = np.asarray(world.state.upper.val)[:, 0]
+    assert (val == 222).all(), val
