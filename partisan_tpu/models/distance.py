@@ -69,19 +69,10 @@ class Distance(UpperProtocol):
         before this round's tick, so "now" is last_rnd + 1."""
         up = row.upper
         rtt = (up.last_rnd + 1) - m.data["stamp"]
-        hit = up.peer == m.src
-        free = up.peer < 0
-        # existing slot, else a free one, else round-robin-evict the
-        # cursor slot — a fresh measurement is never thrown away
-        slot = jnp.where(hit.any(), jnp.argmax(hit),
-                         jnp.where(free.any(), jnp.argmax(free),
-                                   up.cursor % self.P))
-        evicting = ~hit.any() & ~free.any()
-        up = up.replace(
-            peer=up.peer.at[slot].set(m.src),
-            rtt=up.rtt.at[slot].set(rtt),
-            cursor=up.cursor + evicting.astype(jnp.int32))
-        return self.up(row, up), self.no_emit()
+        peer, rtts, cursor = record_rtt(up.peer, up.rtt, up.cursor,
+                                        m.src, rtt)
+        return self.up(row, up.replace(peer=peer, rtt=rtts,
+                                       cursor=cursor)), self.no_emit()
 
     # ------------------------------------------------------------------ timer
 
@@ -102,3 +93,19 @@ def distances(world: World, node: int) -> Dict[int, int]:
     peers = np.asarray(up.peer[node])
     rtts = np.asarray(up.rtt[node])
     return {int(p): int(r) for p, r in zip(peers, rtts) if p >= 0 and r >= 0}
+
+
+def record_rtt(peer_tbl: jax.Array, rtt_tbl: jax.Array, cursor: jax.Array,
+               src, rtt):
+    """Slot-update shared by every RTT collector (Distance above, X-BOT's
+    measured mode in models/xbot.py): existing slot, else a free one,
+    else round-robin-evict the cursor slot — a fresh measurement is
+    never thrown away."""
+    cap = peer_tbl.shape[-1]
+    hit = peer_tbl == src
+    free = peer_tbl < 0
+    slot = jnp.where(hit.any(), jnp.argmax(hit),
+                     jnp.where(free.any(), jnp.argmax(free), cursor % cap))
+    evicting = ~hit.any() & ~free.any()
+    return (peer_tbl.at[slot].set(src), rtt_tbl.at[slot].set(rtt),
+            cursor + evicting.astype(jnp.int32))

@@ -14,18 +14,26 @@ i's old peer o, c's disconnect victim d):
   i: drop o, add c                              (:1171-1200)
 
 "Better" in the reference probes live RTT with ``net_adm:ping``
-(:1318-1327).  A round-synchronous simulator has uniform delivery, so the
-cost oracle is an explicit synthetic **latency matrix**: a deterministic
-symmetric cost ``lat(a, b)`` derived from node ids (ring distance by
-default).  This keeps the optimizer's observable behaviour — total active
-edge cost falls while the overlay stays connected — measurable and
-testable, which live RTT would not be.
+(:1318-1327).  Two oracles are provided:
+
+  * default: an explicit synthetic **latency matrix** — a deterministic
+    symmetric cost ``lat(a, b)`` derived from node ids (ring distance) —
+    which keeps the optimizer's observable behaviour (total active edge
+    cost falls while the overlay stays connected) exactly reproducible;
+  * ``measured=True``: LIVE RTT probing over the simulated transport
+    (ping/pong rounds, including any injected ingress/egress/'$delay'
+    latency) — the reference's ``?XPARAM latency`` mode; edges without a
+    measurement cost +inf so optimization only moves toward peers it has
+    actually probed.
 """
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from ..config import Config
 from ..ops import padded_set as ps
@@ -41,6 +49,18 @@ def ring_latency(a: jax.Array, b: jax.Array, n: int) -> jax.Array:
     return jnp.minimum(d, n - d).astype(jnp.int32)
 
 
+_UNMEASURED = jnp.int32(1 << 30)   # cost of an edge we have no RTT for
+
+
+@struct.dataclass
+class XbState(HvState):
+    """HvState + the measured-RTT table of ``measured=True`` mode."""
+    rtt_peer: jax.Array   # [N, P] peers with a measurement (-1 free)
+    rtt: jax.Array        # [N, P] RTT in rounds
+    rtt_cur: jax.Array    # [N] round-robin eviction cursor
+    last_rnd: jax.Array   # [N] round mirror (RTT computed at delivery)
+
+
 class XBotHyParView(HyParView):
     msg_types = HyParView.msg_types + (
         "optimization", "optimization_reply", "replace", "replace_reply",
@@ -48,11 +68,27 @@ class XBotHyParView(HyParView):
 
     xbot_interval = 9  # reference randomizes 5-65 s (partisan.hrl:61-62)
 
-    def __init__(self, cfg: Config, latency=None):
+    def __init__(self, cfg: Config, latency=None, measured: bool = False):
+        """``measured=True`` replaces the synthetic oracle with LIVE RTT
+        probing — the reference's `?XPARAM latency` mode, which measures
+        candidates with real pings (:1318-1327): nodes ping their active
+        peers and the current optimization candidate every
+        ``cfg.distance_interval`` rounds, and the optimizer compares
+        measured round-trip times (edges without a measurement cost
+        +inf, so optimization only ever moves TOWARD measured-cheaper
+        peers).  Under the engine's delay machinery the measured costs
+        reflect injected ingress/egress/'$delay' latency."""
         super().__init__(cfg)
+        self.measured = measured
+        self.rtt_cap = cfg.max_active_size + 4
+        if measured:
+            self.msg_types = self.msg_types + ("xb_ping", "xb_pong")
+            self.tick_emit_cap += cfg.max_active_size + 1
         self.lat = latency or (
             lambda a, b: ring_latency(a, b, cfg.n_nodes))
         self.data_spec = dict(self.data_spec)
+        if measured:
+            self.data_spec["xb_stamp"] = ((), jnp.int32)  # ping send round
         self.data_spec.update({
             "xb_old": ((), jnp.int32),     # o
             "xb_init": ((), jnp.int32),    # i
@@ -60,21 +96,45 @@ class XBotHyParView(HyParView):
             "xb_disc": ((), jnp.int32),    # d
         })
 
+    # -- state ---------------------------------------------------------------
+
+    def init(self, cfg: Config, key: jax.Array):
+        base = super().init(cfg, key)
+        if not self.measured:
+            return base
+        n = cfg.n_nodes
+        return XbState(
+            **{f.name: getattr(base, f.name)
+               for f in dataclasses.fields(base)},
+            rtt_peer=jnp.full((n, self.rtt_cap), -1, jnp.int32),
+            rtt=jnp.full((n, self.rtt_cap), -1, jnp.int32),
+            rtt_cur=jnp.zeros((n,), jnp.int32),
+            last_rnd=jnp.zeros((n,), jnp.int32),
+        )
+
     # -- cost helpers --------------------------------------------------------
+
+    def _cost(self, row: HvState, me, p) -> jax.Array:
+        """Edge cost for the optimizer: measured RTT (unmeasured = +inf)
+        or the synthetic oracle."""
+        if not self.measured:
+            return jnp.where(p >= 0, self.lat(me, p), _UNMEASURED)
+        hit = (row.rtt_peer == p) & (p >= 0)
+        return jnp.where(hit.any(), row.rtt[jnp.argmax(hit)], _UNMEASURED)
 
     def _worst_active(self, me, row: HvState, exclude=None) -> jax.Array:
         """Highest-latency active peer (the edge worth replacing)."""
-        costs = jax.vmap(lambda p: self.lat(me, p))(row.active)
+        costs = jax.vmap(lambda p: self._cost(row, me, p))(row.active)
         ok = row.active >= 0
         if exclude is not None:
             ok = ok & (row.active != exclude)
         idx = jnp.argmax(jnp.where(ok, costs, -1))
         return jnp.where(jnp.any(ok), row.active[idx], -1)
 
-    def _better(self, me, new, old) -> jax.Array:
+    def _better(self, row: HvState, me, new, old) -> jax.Array:
         """is_better(latency, New, Old) (:1318-1327)."""
-        return (new >= 0) & ((old < 0) | (self.lat(me, new)
-                                          < self.lat(me, old)))
+        return (new >= 0) & ((old < 0) | (self._cost(row, me, new)
+                                          < self._cost(row, me, old)))
 
     # -- handshake handlers --------------------------------------------------
 
@@ -104,7 +164,7 @@ class XBotHyParView(HyParView):
         """Disconnect-victim side (:1252-1268): is o better for me than my
         current edge to c?  yes -> ask o to switch; no -> refuse."""
         c, o, i = m.src, m.data["xb_old"], m.data["xb_init"]
-        better = self._better(me, o, c) & ~row.left
+        better = self._better(row, me, o, c) & ~row.left
         sw = self.emit(jnp.where(better, o, -1)[None], self.typ("switch"),
                        xb_init=i, xb_cand=c)
         no = self.emit(jnp.where(~better, c, -1)[None],
@@ -172,8 +232,34 @@ class XBotHyParView(HyParView):
         due = (((rnd + 3 * me) % self.xbot_interval) == 0) & ~row.left
         cand = ps.random_member(row.passive, prng.decision_key(key, 60))
         worst = self._worst_active(me, row)
-        go = due & self._better(me, cand, worst) & (worst >= 0)
+        go = due & self._better(row, me, cand, worst) & (worst >= 0)
         opt = self.emit(jnp.where(go, cand, -1)[None],
                         self.typ("optimization"),
                         cap=self.tick_emit_cap, xb_old=worst)
-        return row, self.merge(em, opt, cap=self.tick_emit_cap)
+        em = self.merge(em, opt, cap=self.tick_emit_cap)
+        if self.measured:
+            row = row.replace(last_rnd=jnp.broadcast_to(rnd, ()))
+            ping_due = (((rnd + me) % cfg.distance_interval) == 0) \
+                & ~row.left
+            # probe active peers and the current candidate — the
+            # reference measures exactly the edges optimization compares
+            targets = jnp.concatenate([row.active, cand[None]])
+            pings = self.emit(jnp.where(ping_due, targets, -1),
+                              self.typ("xb_ping"),
+                              cap=self.tick_emit_cap, xb_stamp=rnd)
+            em = self.merge(em, pings, cap=self.tick_emit_cap)
+        return row, em
+
+    # -- live RTT probing (measured mode) ------------------------------------
+
+    def handle_xb_ping(self, cfg, me, row: XbState, m: Msgs, key):
+        return row, self.emit(m.src[None], self.typ("xb_pong"), cap=1,
+                              xb_stamp=m.data["xb_stamp"])
+
+    def handle_xb_pong(self, cfg, me, row: XbState, m: Msgs, key):
+        from .distance import record_rtt
+        rtt = (row.last_rnd + 1) - m.data["xb_stamp"]
+        peer, rtts, cur = record_rtt(row.rtt_peer, row.rtt, row.rtt_cur,
+                                     m.src, rtt)
+        return row.replace(rtt_peer=peer, rtt=rtts,
+                           rtt_cur=cur), self.no_emit()

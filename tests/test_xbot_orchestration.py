@@ -84,3 +84,61 @@ class TestOrchestration:
         arts = store.download_artifacts()
         assert set(arts) == {"a", "b"}
         assert json.loads(arts["a"])["node"] == 1
+
+
+class TestXBotMeasured:
+    def test_live_rtt_probing_prefers_near_half(self):
+        """measured=True — the reference's `?XPARAM latency` mode with
+        real pings (:1318-1327): probe traffic crossing the two halves of
+        the id space is delayed, so measured RTTs make X-BOT drift active
+        edges toward same-half (cheap) peers while staying connected."""
+        import jax.numpy as jnp
+        from partisan_tpu.ops import graph
+
+        n = 16
+        half = n // 2
+        cfg = pt.Config(n_nodes=n, inbox_cap=12, shuffle_interval=5,
+                        distance_interval=3)
+        proto = XBotHyParView(cfg, measured=True)
+        probe_t = jnp.asarray([proto.typ("xb_ping"), proto.typ("xb_pong")])
+
+        def slow_cross_half_probes(m, rnd):
+            cross = (m.src < half) != (m.dst < half)
+            is_probe = (m.typ == probe_t[0]) | (m.typ == probe_t[1])
+            extra = jnp.where(m.valid & cross & is_probe, 4, 0)
+            return m.replace(delay=m.delay + extra)
+
+        world = pt.init_world(cfg, proto)
+        # ring-ish bootstrap mixing the halves so cross edges exist
+        world = peer_service.cluster(
+            world, proto, [(i, (i + half) % n if i % 3 == 0 else i - 1)
+                           for i in range(1, n)])
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=slow_cross_half_probes)
+
+        def cross_edges(w):
+            act = np.asarray(w.state.active)
+            src = np.repeat(np.arange(n), act.shape[1])
+            dst = act.reshape(-1)
+            ok = dst >= 0
+            return int((((src < half) != (dst < half)) & ok).sum())
+
+        for _ in range(30):
+            world, _ = step(world)
+        early = cross_edges(world)
+        for _ in range(120):
+            world, _ = step(world)
+        late = cross_edges(world)
+        assert late < early, (early, late)
+        assert bool(graph.is_connected(
+            graph.adjacency_from_views(world.state.active, n)))
+        # measurements really exist and reflect the injected asymmetry
+        rp = np.asarray(world.state.rtt_peer)
+        rt = np.asarray(world.state.rtt)
+        same_vals = [int(r) for i in range(n) for p, r in zip(rp[i], rt[i])
+                     if p >= 0 and r >= 0 and (p < half) == (i < half)]
+        cross_vals = [int(r) for i in range(n) for p, r in zip(rp[i], rt[i])
+                      if p >= 0 and r >= 0 and (p < half) != (i < half)]
+        assert same_vals and min(same_vals) == 2
+        if cross_vals:
+            assert min(cross_vals) >= 2 + 8  # 4 rounds extra each way
