@@ -43,14 +43,19 @@ def main() -> None:
     rounds = 20_000
     trials = 5
 
+    # On TPU the pallas kernel MUST run — a regression there should fail
+    # the bench loudly, not silently report the ~10x-slower packed number.
+    # Only a non-TPU device (the CPU fallback environment) may fall back.
     variant = "pallas"
     try:
         out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn,
                         variant)
         float(jnp.sum(out.infected))          # compile + real sync
     except Exception as e:                    # noqa: BLE001
-        print(f"# pallas path unavailable ({type(e).__name__}: {e}); "
-              f"falling back to XLA packed scan", file=sys.stderr)
+        if jax.devices()[0].platform == "tpu":
+            raise
+        print(f"# pallas path unavailable off-TPU ({type(e).__name__}: "
+              f"{e}); falling back to XLA packed scan", file=sys.stderr)
         variant = "packed"
         out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn,
                         variant)
@@ -78,6 +83,7 @@ def main() -> None:
         "value": round(rps, 1),
         "unit": "rounds/sec",
         "vs_baseline": round(rps / 1000.0, 3),
+        "variant": variant,
     }
     print(json.dumps(result))
     print(f"# variant={variant}, trials={['%.0f' % r for r in rates]}, "

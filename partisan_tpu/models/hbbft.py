@@ -99,6 +99,17 @@ class HbbftWorker(ProtocolBase):
         n = cfg.n_nodes
         self.f = (n - 1) // 3
         self.quorum = n - self.f
+        # Liveness requires the whole echo fan (one echo per node per epoch,
+        # all arriving within a round or two) to FIT in the inbox: echoes
+        # lost to inbox overflow are never retransmitted, so with
+        # inbox_cap < n votes can never reach quorum and no node commits —
+        # a total, silent liveness failure (visible only via the
+        # inbox_overflow metric).  +2 slack: the echo round can also carry
+        # a propose and an anti-entropy fetch/sync.  Tests use n + 4.
+        assert cfg.inbox_cap >= n + 2, (
+            f"HbbftWorker liveness needs cfg.inbox_cap >= n_nodes + 2 "
+            f"(echo fan-in + propose/anti-entropy slack); got "
+            f"inbox_cap={cfg.inbox_cap}, n_nodes={n}")
         self.data_spec: Dict = {
             "epoch": ((), jnp.int32),
             "digest": ((), jnp.int32),

@@ -71,6 +71,13 @@ class UpperProtocol(ProtocolBase):
 
 class Stacked(ProtocolBase):
     def __init__(self, lower: ProtocolBase, upper: UpperProtocol):
+        # nesting is supported on the LOWER side only: handlers(), init and
+        # tick build the upper via its handle_*/init_upper/tick_upper
+        # attributes, which a Stacked does not expose.  Stacked(a,
+        # Stacked(b, c)) is always expressible as Stacked(Stacked(a, b), c).
+        assert isinstance(upper, UpperProtocol), (
+            "upper operand must be a plain UpperProtocol (nest on the "
+            "lower side: Stacked(Stacked(lower, mid), upper))")
         self.lower, self.upper = lower, upper
         self.msg_types = tuple(lower.msg_types) + tuple(upper.msg_types)
         spec = dict(lower.data_spec)

@@ -95,7 +95,9 @@ def transformed(base: Type[ProtocolBase] = ProtocolBase) -> type:
                     continue
                 if key.startswith("handle_"):
                     ns[key] = _wrap(val, "emit_cap")
-                elif key == "tick":
+                elif key in ("tick", "tick_upper"):
+                    # tick_upper: an UpperProtocol (models/stack.py) written
+                    # imperatively gets the same send-collection treatment
                     ns[key] = _wrap(val, "tick_emit_cap")
             return super().__new__(mcls, name, bases, ns)
 

@@ -247,9 +247,14 @@ class TestSparseDelivery:
         from partisan_tpu.models.full_membership import FullMembership
 
         worlds = {}
-        for g in (None, 4):
+        # gated dense / gated gather / ungated (deliver_gate=False, the
+        # big-N TPU compile-time escape hatch) must all be trajectory-
+        # identical: same handlers, same per-node keys on every path
+        for label, gate, g in (("dense", True, None),
+                               ("gather", True, 4),
+                               ("ungated", False, None)):
             cfg = pt.Config(n_nodes=8, inbox_cap=8, periodic_interval=3,
-                            deliver_gather_cap=g)
+                            deliver_gate=gate, deliver_gather_cap=g)
             proto = FullMembership(cfg)
             world = pt.init_world(cfg, proto)
             # join storm: the periodic gossip fan-out exceeds G=4 receivers
@@ -259,13 +264,16 @@ class TestSparseDelivery:
             step = pt.make_step(cfg, proto, donate=False)
             for _ in range(12):
                 world, _ = step(world)
-            worlds[g] = world
-        a, b = worlds[None], worlds[4]
-        for la, lb in zip(jax.tree_util.tree_leaves(a.state),
-                          jax.tree_util.tree_leaves(b.state)):
-            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
-        np.testing.assert_array_equal(np.asarray(a.msgs.valid.sum()),
-                                      np.asarray(b.msgs.valid.sum()))
+            worlds[label] = world
+        a = worlds["dense"]
+        for label in ("gather", "ungated"):
+            b = worlds[label]
+            for la, lb in zip(jax.tree_util.tree_leaves(a.state),
+                              jax.tree_util.tree_leaves(b.state)):
+                np.testing.assert_array_equal(
+                    np.asarray(la), np.asarray(lb), err_msg=label)
+            np.testing.assert_array_equal(np.asarray(a.msgs.valid.sum()),
+                                          np.asarray(b.msgs.valid.sum()))
 
 
 class TestBitsetRolls:

@@ -66,6 +66,44 @@ class TestTransform:
         assert out.cap == proto.emit_cap
         assert not bool(out.valid.any())
 
+    def test_transformed_upper_protocol(self):
+        """tick_upper written imperatively (send-style) is wrapped like
+        tick: an UpperProtocol subclass inside a Stacked collects its
+        sends instead of failing at trace time with an arity error."""
+        from partisan_tpu import peer_service
+        from partisan_tpu.models.full_membership import FullMembership
+        from partisan_tpu.models.stack import Stacked, UpperProtocol
+
+        class Beacon(transformed(UpperProtocol)):
+            msg_types = ("beacon",)
+            emit_cap = 8
+            tick_emit_cap = 8
+
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self.data_spec = {"payload": ((), jnp.int32)}
+
+            def init_upper(self, cfg, key):
+                return jnp.zeros((cfg.n_nodes,), jnp.int32)
+
+            def handle_beacon(self, cfg, me, row, m, key, send):
+                return self.up(row, row.upper + 1)
+
+            def tick_upper(self, cfg, me, row, rnd, key, send):
+                send(self.active_peers(row), "beacon", payload=rnd)
+                return row
+
+        cfg = pt.Config(n_nodes=6, inbox_cap=8, periodic_interval=2)
+        proto = Stacked(FullMembership(cfg), Beacon(cfg))
+        world = pt.init_world(cfg, proto)
+        world = peer_service.cluster(world, proto,
+                                     [(i, 0) for i in range(1, 6)])
+        step = pt.make_step(cfg, proto, donate=False)
+        for _ in range(10):
+            world, _ = step(world)
+        # every node heard beacons from its (full-membership) peers
+        assert (np.asarray(world.state.upper) > 0).all()
+
     def test_interop_with_engine_features(self):
         """Transformed protocols are plain protocols: faults apply."""
         from partisan_tpu.verify import faults
