@@ -8,9 +8,19 @@
 %%                partisan_jax_peer_service_manager}]}
 %% and N virtual nodes run as rows of a sharded JAX array on the TPU;
 %% join/leave/members map onto port commands (bridge/port_server.py);
-%% rounds advance on a timer tick.  Real Erlang processes address virtual
-%% nodes by integer id carried in the node_spec's name:
-%% 'vnodeN@jax' <-> row N.
+%% rounds advance on a timer tick; forward_message/receive_message ride
+%% the port's {forward,...}/{recv,Node} data-plane verbs, so app
+%% messages cross the SIMULATED overlay (fault masks, interposition,
+%% channels) rather than disterl.
+%%
+%% Deployment model: ONE simulator world per cluster.  The BEAM node
+%% named by `jax_simulator_node' (default: this node) owns the port;
+%% every other BEAM node's shim is a thin proxy — its API calls route to
+%% the owner over disterl ({?MODULE, SimNode}), which is exactly the
+%% role disterl plays in the reference's own test harness (control
+%% channel only, partisan_support.erl:40).  Each shim attaches its
+%% virtual-node id at startup so the owner's recv poll knows which BEAM
+%% hosts which vnode's ServerRefs.
 %%
 %% Wire: open_port/2 with {packet, 4} + binary, terms via term_to_binary
 %% — the same framing the reference uses for its own peer links
@@ -19,7 +29,9 @@
 %% NOTE: the build image for the TPU rebuild carries no Erlang toolchain;
 %% this module is compiled and exercised only in deployments that embed
 %% the simulator into a live partisan cluster.  The Python PortClient
-%% (bridge/client.py) drives the identical wire protocol in CI.
+%% (bridge/client.py) drives the identical wire protocol in CI, and
+%% tests/test_bridge.py round-trips this module's term_to_words payload
+%% packing bit-for-bit.
 %% -------------------------------------------------------------------
 -module(partisan_jax_peer_service_manager).
 
@@ -59,49 +71,83 @@
 
 -define(ROUND_INTERVAL, 100).  %% ms per simulator round quantum
 -define(ADVANCE_ROUNDS, 1).
+-define(PAYLOAD_WORDS, 64).    %% 256-byte app payloads (int32 words)
 
--record(state, {port          :: port(),
+-record(state, {port          :: port() | undefined,
+                owner         :: boolean(),
                 myid          :: non_neg_integer(),
                 n_nodes       :: pos_integer(),
                 manager       :: atom(),
-                membership    :: [non_neg_integer()]}).
+                membership    :: [non_neg_integer()],
+                %% vnode id -> BEAM node hosting its ServerRefs
+                attached = #{} :: #{non_neg_integer() => node()},
+                %% ServerRef term <-> integer id registry (names live
+                %% host-side only, SURVEY section 5.6)
+                refs = #{}    :: #{term() => non_neg_integer()},
+                ref_ids = #{} :: #{non_neg_integer() => term()},
+                next_ref = 1  :: non_neg_integer(),
+                %% membership-change callbacks (on_up/2, on_down/2);
+                %% fired on the owner node
+                up_funs = []  :: [{term(), fun()}],
+                down_funs = [] :: [{term(), fun()}]}).
 
 %%%===================================================================
-%%% API
+%%% API — every call routes to the simulator owner's gen_server and
+%%% carries the CALLER's virtual-node id (read on the calling BEAM).
 %%%===================================================================
 
 start_link() ->
     gen_server:start_link({local, ?MODULE}, ?MODULE, [], []).
 
+sim_node() ->
+    partisan_config:get(jax_simulator_node, node()).
+
+sim_ref() ->
+    case sim_node() =:= node() of
+        true -> ?MODULE;
+        false -> {?MODULE, sim_node()}
+    end.
+
+my_id() ->
+    partisan_config:get(jax_my_id, 0).
+
+call(Req) ->
+    gen_server:call(sim_ref(), Req, infinity).
+
 members() ->
-    gen_server:call(?MODULE, members, infinity).
+    call({members, my_id()}).
 
 myself() ->
     partisan_peer_service_manager:myself().
 
 get_local_state() ->
-    gen_server:call(?MODULE, get_local_state, infinity).
+    call({get_local_state, my_id()}).
 
 join(NodeSpec) ->
-    gen_server:call(?MODULE, {join, NodeSpec}, infinity).
+    call({join, my_id(), NodeSpec}).
 
 sync_join(NodeSpec) ->
-    gen_server:call(?MODULE, {join, NodeSpec}, infinity).
+    call({sync_join, my_id(), NodeSpec}).
 
 leave() ->
-    gen_server:call(?MODULE, {leave, self_id}, infinity).
+    call({leave, my_id()}).
 
 leave(NodeSpec) ->
-    gen_server:call(?MODULE, {leave, NodeSpec}, infinity).
+    call({leave, node_to_id(NodeSpec)}).
 
-update_members(_Members) ->
-    {error, not_implemented}.
+%% Reset membership to exactly `Members': join the missing, leave the
+%% extra (the pluggable manager's update_members contract).
+update_members(Members) ->
+    call({update_members, my_id(), Members}).
 
-on_down(_Name, _Fun) ->
-    {error, not_implemented}.
+%% Register a callback fired when `Name' (or any node, for the atom
+%% '_') joins/leaves the membership (pluggable on_up/on_down).  Fired on
+%% the simulator-owner node.
+on_down(Name, Fun) ->
+    call({on_down, Name, Fun}).
 
-on_up(_Name, _Fun) ->
-    {error, not_implemented}.
+on_up(Name, Fun) ->
+    call({on_up, Name, Fun}).
 
 forward_message(Pid, Message) ->
     forward_message(Pid, undefined, Message).
@@ -113,9 +159,7 @@ forward_message(Name, Channel, ServerRef, Message) ->
     forward_message(Name, Channel, ServerRef, Message, []).
 
 forward_message(Name, _Channel, ServerRef, Message, _Options) ->
-    gen_server:call(?MODULE,
-                    {forward_message, Name, ServerRef, Message},
-                    infinity).
+    call({forward_message, my_id(), Name, ServerRef, Message}).
 
 cast_message(Name, ServerRef, Message) ->
     cast_message(Name, undefined, ServerRef, Message).
@@ -124,7 +168,8 @@ cast_message(Name, Channel, ServerRef, Message) ->
     cast_message(Name, Channel, ServerRef, Message, []).
 
 cast_message(Name, _Channel, ServerRef, Message, _Options) ->
-    gen_server:cast(?MODULE, {forward_message, Name, ServerRef, Message}).
+    gen_server:cast(sim_ref(),
+                    {forward_message, my_id(), Name, ServerRef, Message}).
 
 receive_message(_Peer, Message) ->
     partisan_util:process_forward(?MODULE, Message).
@@ -152,67 +197,118 @@ send_message(Name, Message) ->
 %%%===================================================================
 
 init([]) ->
-    NNodes = partisan_config:get(jax_n_nodes, 64),
-    Manager = partisan_config:get(jax_manager, hyparview),
-    MyId = partisan_config:get(jax_my_id, 0),
-    Python = partisan_config:get(jax_python, "python3"),
-    Port = open_port({spawn_executable, os:find_executable(Python)},
+    MyId = my_id(),
+    case sim_node() =:= node() of
+        true ->
+            NNodes = partisan_config:get(jax_n_nodes, 64),
+            Manager = partisan_config:get(jax_manager, hyparview),
+            Python = partisan_config:get(jax_python, "python3"),
+            Port = open_port(
+                     {spawn_executable, os:find_executable(Python)},
                      [{args, ["-m", "partisan_tpu.bridge.port_server"]},
                       {packet, 4}, binary, exit_status]),
-    ok = command(Port, {start, Manager, [{n_nodes, NNodes}]}),
-    erlang:send_after(?ROUND_INTERVAL, self(), advance),
-    {ok, #state{port=Port, myid=MyId, n_nodes=NNodes,
-                manager=Manager, membership=[MyId]}}.
+            ok = command(Port, {start, Manager,
+                                [{n_nodes, NNodes},
+                                 {payload_words, ?PAYLOAD_WORDS}]}),
+            erlang:send_after(?ROUND_INTERVAL, self(), advance),
+            {ok, #state{port=Port, owner=true, myid=MyId, n_nodes=NNodes,
+                        manager=Manager, membership=[MyId],
+                        attached=#{MyId => node()}}};
+        false ->
+            %% thin proxy: register this BEAM's vnode id with the owner
+            %% so recv records for it are delivered here
+            ok = gen_server:call({?MODULE, sim_node()},
+                                 {attach, my_id()}, infinity),
+            {ok, #state{port=undefined, owner=false, myid=MyId,
+                        n_nodes=0, manager=proxy, membership=[MyId]}}
+    end.
 
-handle_call(members, _From, #state{port=Port, myid=MyId}=State) ->
-    {ok, Ids} = command(Port, {members, MyId}),
-    {reply, {ok, [id_to_node(Id) || Id <- Ids]}, State};
+handle_call({attach, Id}, {Pid, _}, #state{attached=A}=State) ->
+    {reply, ok, State#state{attached=A#{Id => node(Pid)}}};
 
-handle_call(get_local_state, _From, #state{membership=M}=State) ->
+handle_call({members, Id}, _From, #state{port=Port}=State) ->
+    {ok, Ids} = command(Port, {members, Id}),
+    {reply, {ok, [id_to_node(I) || I <- Ids]}, State};
+
+handle_call({get_local_state, _Id}, _From, #state{membership=M}=State) ->
     {reply, {state, undefined, M}, State};
 
-handle_call({join, NodeSpec}, _From,
-            #state{port=Port, myid=MyId}=State) ->
-    ok = command(Port, {join, MyId, node_to_id(NodeSpec)}),
+handle_call({join, Id, NodeSpec}, _From, #state{port=Port}=State) ->
+    ok = command(Port, {join, Id, node_to_id(NodeSpec)}),
     {reply, ok, State};
 
-handle_call({leave, self_id}, _From,
-            #state{port=Port, myid=MyId}=State) ->
-    ok = command(Port, {leave, MyId}),
+handle_call({sync_join, Id, NodeSpec}, _From, #state{port=Port}=State) ->
+    %% blocking join: the simulator runs rounds until both sides list
+    %% each other (the fully_connected analog, pluggable :1461-1480)
+    case command(Port, {sync_join, Id, node_to_id(NodeSpec)}) of
+        {ok, _Rounds} -> {reply, ok, State};
+        Error -> {reply, Error, State}
+    end;
+
+handle_call({leave, Id}, _From, #state{port=Port}=State) ->
+    ok = command(Port, {leave, Id}),
     {reply, ok, State};
 
-handle_call({leave, NodeSpec}, _From, #state{port=Port}=State) ->
-    ok = command(Port, {leave, node_to_id(NodeSpec)}),
+handle_call({forward_message, SrcId, Name, ServerRef, Message}, _From,
+            #state{port=Port}=State0) ->
+    %% Data plane THROUGH the simulated overlay: queued at the port
+    %% ({forward,...} — one batched buffer write per advance), crossing
+    %% the simulator's router with the same fault masks and interposition
+    %% hooks as protocol traffic; drained by the {recv, Id} poll in the
+    %% advance tick, which delivers to ServerRef on the BEAM node
+    %% attached to the destination vnode.
+    {RefId, State} = ref_id(ServerRef, State0),
+    Payload = term_to_words(Message),
+    ok = command(Port, {forward, SrcId, node_to_id(Name), RefId, Payload}),
     {reply, ok, State};
 
-handle_call({forward_message, Name, ServerRef, Message}, _From,
-            #state{}=State) ->
-    %% Data-plane messages ride disterl to the owning BEAM node while the
-    %% overlay membership itself is simulated on the TPU; a full virtual
-    %% data plane goes through the batched enqueue command instead.
-    Node = case Name of
-               N when is_atom(N) -> N;
-               #{name := N} -> N
-           end,
-    _ = erlang:send({ServerRef, Node}, Message, [noconnect]),
+handle_call({update_members, Id, Members}, _From,
+            #state{port=Port, membership=Current}=State) ->
+    Wanted = lists:usort([node_to_id(M) || M <- Members]),
+    Extra = Current -- Wanted,
+    Missing = Wanted -- Current,
+    [ok = command(Port, {join, I, Id}) || I <- Missing],
+    [ok = command(Port, {leave, I}) || I <- Extra],
     {reply, ok, State};
+
+handle_call({on_up, Name, Fun}, _From, #state{up_funs=Fs}=State) ->
+    {reply, ok, State#state{up_funs=[{Name, Fun} | Fs]}};
+
+handle_call({on_down, Name, Fun}, _From, #state{down_funs=Fs}=State) ->
+    {reply, ok, State#state{down_funs=[{Name, Fun} | Fs]}};
 
 handle_call(_Msg, _From, State) ->
     {reply, {error, unknown_call}, State}.
 
-handle_cast({forward_message, Name, ServerRef, Message}, State) ->
+handle_cast({forward_message, SrcId, Name, ServerRef, Message}, State) ->
     {reply, ok, S} =
-        handle_call({forward_message, Name, ServerRef, Message},
+        handle_call({forward_message, SrcId, Name, ServerRef, Message},
                     undefined, State),
     {noreply, S};
 
 handle_cast(_Msg, State) ->
     {noreply, State}.
 
-handle_info(advance, #state{port=Port, myid=MyId}=State) ->
+handle_info(advance, #state{port=Port, myid=MyId, attached=Attached,
+                            membership=Prev}=State) ->
     {ok, _Metrics} = command(Port, {advance, ?ADVANCE_ROUNDS}),
     {ok, Ids} = command(Port, {members, MyId}),
     partisan_peer_service_events:update([id_to_node(Id) || Id <- Ids]),
+    %% fire on_up/on_down callbacks on membership diffs
+    [fire_funs(State#state.up_funs, id_to_node(Id))
+     || Id <- Ids -- Prev],
+    [fire_funs(State#state.down_funs, id_to_node(Id))
+     || Id <- Prev -- Ids],
+    %% drain the data plane for EVERY attached vnode: records route to
+    %% the ServerRef on the BEAM node hosting that vnode
+    maps:foreach(
+      fun(Id, Beam) ->
+              case command(Port, {recv, Id}) of
+                  {ok, Records, _Lost} ->
+                      [deliver(Rec, Beam, State) || Rec <- Records];
+                  _ -> ok
+              end
+      end, Attached),
     erlang:send_after(?ROUND_INTERVAL, self(), advance),
     {noreply, State#state{membership=Ids}};
 
@@ -222,9 +318,11 @@ handle_info({Port, {exit_status, Status}}, #state{port=Port}=State) ->
 handle_info(_Msg, State) ->
     {noreply, State}.
 
-terminate(_Reason, #state{port=Port}) ->
+terminate(_Reason, #state{owner=true, port=Port}) ->
     catch command(Port, stop),
     catch port_close(Port),
+    ok;
+terminate(_Reason, _State) ->
     ok.
 
 code_change(_OldVsn, State, _Extra) ->
@@ -234,18 +332,68 @@ code_change(_OldVsn, State, _Extra) ->
 %%% Internal
 %%%===================================================================
 
+command(undefined, _Term) ->
+    {error, not_owner};
 command(Port, Term) ->
     Port ! {self(), {command, term_to_binary(Term)}},
     receive
         {Port, {data, Data}} ->
-            case binary_to_term(Data) of
-                ok -> ok;
-                {ok, Result} -> {ok, Result};
-                {error, Reason} -> {error, Reason}
-            end
+            %% replies are ok | {ok, ...} | {error, Reason}; pass through
+            binary_to_term(Data)
     after 60000 ->
             {error, port_timeout}
     end.
+
+%% ServerRef term <-> integer id (the port's server_ref field).
+ref_id(Ref, #state{refs=Refs, ref_ids=Ids, next_ref=Next}=State) ->
+    case maps:find(Ref, Refs) of
+        {ok, Id} -> {Id, State};
+        error ->
+            {Next, State#state{refs=Refs#{Ref => Next},
+                               ref_ids=Ids#{Next => Ref},
+                               next_ref=Next + 1}}
+    end.
+
+fire_funs(Funs, NodeSpec) ->
+    Name = maps:get(name, NodeSpec),
+    [catch Fun(NodeSpec) || {N, Fun} <- Funs, N =:= Name orelse N =:= '_'].
+
+%% Deliver one drained record to its ServerRef on the hosting BEAM node.
+%% Pids route transparently over disterl; registered names are sent to
+%% {Name, Beam}.
+deliver({_Src, RefId, Payload}, Beam, #state{ref_ids=Ids}) ->
+    Message = words_to_term(Payload),
+    case maps:find(RefId, Ids) of
+        {ok, Pid} when is_pid(Pid) ->
+            Pid ! Message, ok;
+        {ok, Name} when is_atom(Name), Beam =:= node() ->
+            partisan_util:process_forward(Name, Message);
+        {ok, Name} when is_atom(Name) ->
+            _ = erlang:send({Name, Beam}, Message, [noconnect]), ok;
+        {ok, Other} ->
+            partisan_util:process_forward(Other, Message);
+        error ->
+            %% ref was registered by a shim generation that has since
+            %% restarted; nothing to deliver to
+            ok
+    end.
+
+%% Erlang term <-> int32 payload words: [ByteLen | Words], the term's
+%% external format packed big-endian 4 bytes per signed word.
+term_to_words(Term) ->
+    Bin = term_to_binary(Term),
+    Len = byte_size(Bin),
+    Pad = (4 - (Len rem 4)) rem 4,
+    Padded = <<Bin/binary, 0:(Pad * 8)>>,
+    Words = [W || <<W:32/signed-big>> <= Padded],
+    true = (1 + length(Words)) =< ?PAYLOAD_WORDS orelse
+        erlang:error({payload_too_large, Len}),
+    [Len | Words].
+
+words_to_term([Len | Words]) ->
+    Bin = << <<W:32/signed-big>> || W <- Words >>,
+    <<Used:Len/binary, _/binary>> = Bin,
+    binary_to_term(Used).
 
 %% Virtual node ids <-> node_spec names: 'vnodeN@jax'.
 id_to_node(Id) ->

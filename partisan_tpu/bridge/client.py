@@ -43,6 +43,12 @@ class PortClient:
     def leave(self, node: int) -> Any:
         return self.call((Atom("leave"), node))
 
+    def sync_join(self, node: int, peer: int, max_rounds: int = 100) -> int:
+        """Blocking join; returns the rounds it took."""
+        ok, rounds = self.call((Atom("sync_join"), node, peer, max_rounds))
+        assert ok == Atom("ok"), (ok, rounds)
+        return rounds
+
     def advance(self, k: int) -> Any:
         return self.call((Atom("advance"), k))
 
@@ -50,6 +56,21 @@ class PortClient:
         ok, ids = self.call((Atom("members"), node))
         assert ok == Atom("ok")
         return ids
+
+    def forward(self, src: int, dst: int, server_ref: int, payload,
+                **opts) -> Any:
+        """forward_message over the simulated overlay; opts: ack=True,
+        channel=N, partition_key=K, delay=D."""
+        plist = [(Atom(k), v) for k, v in opts.items()]
+        return self.call((Atom("forward"), src, dst, server_ref,
+                          list(payload), plist))
+
+    def recv(self, node: int):
+        """-> (records, lost): app messages delivered to node since the
+        last poll; records are (src, server_ref, payload_words)."""
+        ok, recs, lost = self.call((Atom("recv"), node))
+        assert ok == Atom("ok")
+        return [(s, r, list(p)) for s, r, p in recs], lost
 
     def health(self) -> dict:
         ok, h = self.call(Atom("health"))

@@ -10,19 +10,34 @@ partisan_peer_service_manager.erl:30-67); every reply is ``ok``,
 
   {start, Manager, Props}     Manager: hyparview | full | scamp_v1 |
                               scamp_v2 | static | client_server;
-                              Props: [{n_nodes, N} | {seed, S} | ...]
+                              Props: [{n_nodes, N} | {seed, S} | ...] plus
+                              bridge props {data_plane, Bool=true} |
+                              {payload_words, P} | {store_cap, S} |
+                              {ring_cap, R}
   {join, Node, Peer}          peer_service:join (queued; applies on advance)
   {leave, Node}               peer_service:leave
   {advance, K}                run K rounds, reply {ok, MetricsMap}
   {members, Node}             {ok, [Id]}  (bulk int list — native codec path)
+  {forward, Src, Dst, ServerRef, Payload [, Opts]}
+                              forward_message over the simulated overlay
+                              (pluggable :183-248); Payload an int list,
+                              Opts a proplist of ack | channel |
+                              partition_key | delay.  Queued; ONE batched
+                              buffer write at the next advance.
+  {recv, Node}                {ok, [{Src, ServerRef, Payload}], Lost} —
+                              app messages delivered to Node since the
+                              last poll (store_proc drain,
+                              test/partisan_SUITE.erl:1955); Lost counts
+                              ring-overwritten records (never silent)
   {crash, [Node]} / {recover, [Node]}
   {partition, [[Node]]} / resolve_partition
   {checkpoint, Path} / {restore, Path}
   health                      {ok, Map} of metrics.world_health
   stop                        close the session and exit
 
-Join/leave/crash commands batch between ``advance`` calls — the port never
-round-trips per message (SURVEY §7.3 "Host<->device bridge latency").
+Join/leave/crash/forward commands batch between ``advance`` calls — the
+port never round-trips per message (SURVEY §7.3 "Host<->device bridge
+latency").
 """
 
 from __future__ import annotations
@@ -81,6 +96,9 @@ class Session:
         self.proto = None
         self.world = None
         self.step = None
+        self.dp = None                       # DataPlane layer (if enabled)
+        self.pending_fwds: list = []         # queued {forward,...} records
+        self.recv_cursors: Dict[int, int] = {}
 
     # ------------------------------------------------------------- commands
 
@@ -91,10 +109,22 @@ class Session:
             if isinstance(v, list):
                 v = tuple(v)
             overrides[str(k)] = v
+        bridge = {k: overrides.pop(k) for k in
+                  ("data_plane", "payload_words", "store_cap", "ring_cap")
+                  if k in overrides}
         self.cfg = from_mapping(overrides)
         if str(manager) not in _MANAGERS:
             return (Atom("error"), Atom("unknown_manager"))
         self.proto = _MANAGERS[str(manager)](self.cfg)
+        if bridge.get("data_plane", True):
+            from ..models.dataplane import DataPlane
+            from ..models.stack import Stacked
+            self.dp = DataPlane(
+                self.cfg,
+                payload_words=int(bridge.get("payload_words", 4)),
+                store_cap=int(bridge.get("store_cap", 32)),
+                ring_cap=int(bridge.get("ring_cap", 8)))
+            self.proto = Stacked(self.proto, self.dp)
         self.world = init_world(self.cfg, self.proto)
         self.step = make_step(self.cfg, self.proto, donate=False)
         return Atom("ok")
@@ -110,12 +140,63 @@ class Session:
         self.world = ps_leave(self.world, self.proto, int(node))
         return Atom("ok")
 
+    def cmd_sync_join(self, node: int, peer: int, max_rounds: int = 100
+                      ) -> Any:
+        """Blocking join: runs rounds until complete, replying the round
+        count (the sync_join facade verb)."""
+        from ..peer_service import sync_join
+        self._flush_forwards()
+        try:
+            self.world, rounds = sync_join(
+                self.world, self.proto, int(node), int(peer), self.step,
+                max_rounds=int(max_rounds))
+        except TimeoutError:
+            return (Atom("error"), Atom("timeout"))
+        return (Atom("ok"), rounds)
+
     def cmd_advance(self, k: int) -> Any:
+        self._flush_forwards()
         last = {}
         for _ in range(int(k)):
             self.world, last = self.step(self.world)
         out = {Atom(name): _to_term(v) for name, v in last.items()}
         return (Atom("ok"), out)
+
+    # --------------------------------------------------------- data plane
+
+    def _need_dp(self):
+        if self.dp is None:
+            raise ValueError("data plane disabled for this session "
+                             "({data_plane, false})")
+
+    def _flush_forwards(self) -> None:
+        if self.pending_fwds:
+            from ..peer_service import forward_batch
+            self.world = forward_batch(self.world, self.proto,
+                                       self.pending_fwds)
+            self.pending_fwds = []
+
+    def cmd_forward(self, src: int, dst: int, server_ref: int, payload,
+                    opts=()) -> Any:
+        self._need_dp()
+        rec = {"src": int(src), "dst": int(dst),
+               "server_ref": int(server_ref),
+               "payload": [int(x) for x in payload]}
+        for item in opts:
+            k, v = (item, True) if isinstance(item, Atom) else item
+            rec[str(k)] = bool(v) if str(k) == "ack" else int(v)
+        self.pending_fwds.append(rec)
+        return Atom("ok")
+
+    def cmd_recv(self, node: int) -> Any:
+        self._need_dp()
+        from ..peer_service import receive_messages
+        recs, cur, lost = receive_messages(
+            self.world, self.proto, int(node),
+            self.recv_cursors.get(int(node), 0))
+        self.recv_cursors[int(node)] = cur
+        return (Atom("ok"), [tuple([s, r, list(p)]) for s, r, p in recs],
+                int(lost))
 
     def cmd_members(self, node: int) -> Any:
         row = _tree_index(self.world.state, int(node))
@@ -146,6 +227,13 @@ class Session:
 
     def cmd_restore(self, path) -> Any:
         self.world, _ = ckpt.load(_as_str(path), self.world)
+        # recv cursors and queued forwards are host-session state tied to
+        # the OLD timeline; restoring rewinds recv_count, so stale cursors
+        # would silently skip post-restore deliveries.  Reset them:
+        # deliveries in the restored world drain afresh (at-least-once
+        # across a restore, like every other replayed effect).
+        self.recv_cursors = {}
+        self.pending_fwds = []
         return Atom("ok")
 
     def cmd_health(self) -> Any:

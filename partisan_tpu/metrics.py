@@ -66,9 +66,13 @@ def world_health(world: World, proto: ProtocolBase) -> Dict[str, jax.Array]:
         "inflight": world.msgs.count(),
         "convergence": convergence(masks, world.alive),
     }
-    views = getattr(world.state, "active", None)
-    if views is None:
-        views = getattr(world.state, "partial", None)
+    st = world.state
+    views = None
+    while views is None and st is not None:
+        views = getattr(st, "active", None)
+        if views is None:
+            views = getattr(st, "partial", None)
+        st = getattr(st, "lower", None)  # unwrap Stacked layers
     if views is not None:
         out.update(view_stats(views, world.alive))
     return out

@@ -1,0 +1,223 @@
+"""Application data plane — ``forward_message`` / ``receive_message`` over
+the simulated overlay.
+
+This is the TPU-native rebuild of the manager's data hot path: the
+reference's ``forward_message(Name, Channel, ServerRef, Msg, Opts)``
+pipeline (src/partisan_pluggable_peer_service_manager.erl:183-248) ending
+in ``partisan_util:process_forward/2`` delivery to a registered process
+(src/partisan_util.erl:385-484), plus the acknowledgement path (store on
+send, ack on receive, retransmit timer — pluggable :737-741, 810-816,
+905-942 over src/partisan_acknowledgement_backend.erl).
+
+Design: a :class:`DataPlane` rides on ANY membership manager via
+:class:`~partisan_tpu.models.stack.Stacked`, so app messages traverse the
+same engine round as protocol traffic — same router, same fault masks,
+same interposition hooks, same channels/lanes.  Per node:
+
+  * a **receive store** — the ``store_proc`` analog of the reference test
+    harness (test/partisan_support.erl:325-333; the `check_forward_message`
+    contract, test/partisan_SUITE.erl:1955): a fixed ring of the last ``S``
+    delivered (src, server_ref, payload) records plus a monotone
+    ``recv_count``, so a host-side poller drains increments and *counts*
+    anything overwritten between polls (never silent);
+  * an **outstanding ring** for ack-requested sends (the `with_ack` suite
+    group): unacked messages re-emit every ``cfg.retransmit_interval``
+    rounds — at-least-once, exactly the reference's semantics.
+
+``server_ref`` is an integer registered-name id (names live host-side
+only, SURVEY §5.6); payloads are fixed-width int32 vectors.  The
+``partition_key`` field uses fill -1 = unkeyed (random lane), matching
+dispatch_pid's "no key -> random pick" (partisan_util.erl:142-201).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config import Config
+from ..ops.msg import Msgs
+from ..qos.ack import retransmit_due
+from ..ops import ring
+from .stack import StackState, UpperProtocol
+
+
+@struct.dataclass
+class DataRow:
+    # receive store ring (store_proc)
+    st_src: jax.Array      # [N, S] sender of each stored record
+    st_ref: jax.Array      # [N, S] server_ref of each stored record
+    st_pay: jax.Array      # [N, S, P] payload words
+    recv_count: jax.Array  # [N] monotone delivery counter (ring head)
+    # outstanding ring for ack-requested sends
+    out_valid: jax.Array   # [N, R]
+    out_dst: jax.Array     # [N, R]
+    out_ref: jax.Array     # [N, R]
+    out_pay: jax.Array     # [N, R, P]
+    out_seq: jax.Array     # [N, R] message clock (pluggable :687)
+    out_age: jax.Array     # [N, R] rounds since (re)transmission
+    out_chan: jax.Array    # [N, R] original channel — retransmits reuse
+    out_pk: jax.Array      # [N, R] original partition key (lane affinity)
+    next_seq: jax.Array    # [N] monotone clock source (1-based; 0 = no ack)
+    send_dropped: jax.Array  # [N] acked sends lost to a full ring (counted)
+
+
+class DataPlane(UpperProtocol):
+    """``ctl_fwd`` (host-injected at the SOURCE row) runs the send-side
+    pipeline in-step; ``fwd`` delivers into the destination's store ring;
+    ``fwd_ack`` clears the outstanding slot.  Retransmission rides
+    ``tick_upper``."""
+
+    msg_types = ("fwd", "fwd_ack", "ctl_fwd")
+
+    def __init__(self, cfg: Config, payload_words: int = 4,
+                 store_cap: int = 32, ring_cap: int = 8):
+        self.cfg = cfg
+        self.P = payload_words
+        self.S = store_cap
+        self.R = ring_cap
+        self.data_spec: Dict = {
+            "peer": ((), jnp.int32),                 # ctl_fwd destination
+            "server_ref": ((), jnp.int32),
+            "payload": ((payload_words,), jnp.int32),
+            "clock": ((), jnp.int32),                # 0 = no ack requested
+            "ack": ((), jnp.int32),                  # ctl_fwd: request ack?
+            "partition_key": ((), jnp.int32, -1),    # -1 = unkeyed
+        }
+        self.emit_cap = 1
+        self.tick_emit_cap = ring_cap
+
+    # ------------------------------------------------------------------ state
+
+    def init_upper(self, cfg: Config, key: jax.Array) -> DataRow:
+        n, S, R, P = cfg.n_nodes, self.S, self.R, self.P
+        return DataRow(
+            st_src=jnp.full((n, S), -1, jnp.int32),
+            st_ref=jnp.zeros((n, S), jnp.int32),
+            st_pay=jnp.zeros((n, S, P), jnp.int32),
+            recv_count=jnp.zeros((n,), jnp.int32),
+            out_valid=jnp.zeros((n, R), bool),
+            out_dst=jnp.zeros((n, R), jnp.int32),
+            out_ref=jnp.zeros((n, R), jnp.int32),
+            out_pay=jnp.zeros((n, R, P), jnp.int32),
+            out_seq=jnp.zeros((n, R), jnp.int32),
+            out_age=jnp.zeros((n, R), jnp.int32),
+            out_chan=jnp.zeros((n, R), jnp.int32),
+            out_pk=jnp.full((n, R), -1, jnp.int32),
+            next_seq=jnp.ones((n,), jnp.int32),
+            send_dropped=jnp.zeros((n,), jnp.int32),
+        )
+
+    # --------------------------------------------------------------- handlers
+
+    def handle_ctl_fwd(self, cfg, me, row: StackState, m: Msgs, key):
+        """Send side (pluggable forward_message :183-248): an acked send
+        parks a copy in the outstanding ring stamped with the next message
+        clock; the wire message carries the clock so the receiver can ack
+        it.  An unacked send ships clock 0 (fire-and-forget fast path)."""
+        up: DataRow = row.upper
+        dst = m.data["peer"]
+        want_ack = m.data["ack"] > 0
+        ok, slot = ring.alloc(up.out_valid)
+        stored = want_ack & ok
+        seq = jnp.where(want_ack, up.next_seq, 0)
+        wr = lambda a, v: ring.masked_set(a, slot, stored, v)
+        up = up.replace(
+            out_valid=wr(up.out_valid, True),
+            out_dst=wr(up.out_dst, dst),
+            out_ref=wr(up.out_ref, m.data["server_ref"]),
+            out_pay=wr(up.out_pay, m.data["payload"]),
+            out_seq=wr(up.out_seq, seq),
+            out_age=wr(up.out_age, 0),
+            out_chan=wr(up.out_chan, m.channel),
+            out_pk=wr(up.out_pk, m.data["partition_key"]),
+            next_seq=up.next_seq + want_ack.astype(jnp.int32),
+            send_dropped=up.send_dropped
+            + (want_ack & ~ok).astype(jnp.int32),
+        )
+        # an acked send that could not be stored is NOT shipped (it could
+        # never be retransmitted); the drop is counted above
+        ship = ~want_ack | stored
+        em = self.emit(jnp.where(ship, dst, -1)[None], self.typ("fwd"),
+                       channel=m.channel,
+                       server_ref=m.data["server_ref"],
+                       payload=m.data["payload"],
+                       clock=jnp.where(stored, seq, 0),
+                       partition_key=m.data["partition_key"])
+        return self.up(row, up), em
+
+    def handle_fwd(self, cfg, me, row: StackState, m: Msgs, key):
+        """Receive side: process_forward into the store ring (util
+        :385-484) + send_acknowledgement when the clock asks for one
+        (pluggable :1217-1227, 1612-1617)."""
+        up: DataRow = row.upper
+        slot = up.recv_count % self.S
+        up = up.replace(
+            st_src=up.st_src.at[slot].set(m.src),
+            st_ref=up.st_ref.at[slot].set(m.data["server_ref"]),
+            st_pay=up.st_pay.at[slot].set(m.data["payload"]),
+            recv_count=up.recv_count + 1,
+        )
+        ack_dst = jnp.where(m.data["clock"] > 0, m.src, -1)
+        em = self.emit(ack_dst[None], self.typ("fwd_ack"),
+                       clock=m.data["clock"])
+        return self.up(row, up), em
+
+    def handle_fwd_ack(self, cfg, me, row: StackState, m: Msgs, key):
+        up: DataRow = row.upper
+        hit = up.out_valid & (up.out_seq == m.data["clock"])
+        return self.up(row, up.replace(out_valid=up.out_valid & ~hit)), \
+            self.no_emit()
+
+    def tick_upper(self, cfg, me, row: StackState, rnd, key):
+        """Retransmit timer (pluggable :905-942): re-emit every outstanding
+        slot whose age reaches the interval — floored at the simulated
+        round-trip (send -> deliver -> ack back = 2 rounds, +1 slack).
+        The reference's 1 s timer never races its sub-millisecond ack
+        RTT; without the floor every acked send would be delivered
+        duplicate-per-round until its ack lands."""
+        up: DataRow = row.upper
+        age, due = retransmit_due(up.out_valid, up.out_age,
+                                  max(cfg.retransmit_interval, 3))
+        row = self.up(row, up.replace(out_age=age))
+        em = self.emit(jnp.where(due, up.out_dst, -1), self.typ("fwd"),
+                       cap=self.tick_emit_cap, channel=up.out_chan,
+                       server_ref=up.out_ref, payload=up.out_pay,
+                       clock=up.out_seq, partition_key=up.out_pk)
+        return row, em
+
+    # ---------------------------------------------------------- host surface
+
+    def pad_payload(self, payload) -> np.ndarray:
+        """Host helper: int sequence -> fixed [P] int32 vector."""
+        arr = np.zeros((self.P,), np.int32)
+        vals = np.atleast_1d(np.asarray(payload, np.int32))
+        assert vals.size <= self.P, \
+            f"payload of {vals.size} words > payload_words={self.P}"
+        arr[: vals.size] = vals
+        return arr
+
+    def received(self, upper: DataRow, node: int, cursor: int = 0,
+                 ) -> Tuple[List[Tuple[int, int, List[int]]], int, int]:
+        """Drain ``node``'s store ring from ``cursor`` (a previously
+        returned position; 0 = from the beginning).  Returns
+        ``(records, new_cursor, lost)`` where records are
+        ``(src, server_ref, payload_words)`` in delivery order and
+        ``lost`` counts records overwritten before this poll reached them
+        (ring wrap — counted, never silent)."""
+        head = int(np.asarray(upper.recv_count[node]))
+        lost = max(0, (head - cursor) - self.S)
+        start = max(cursor, head - self.S)
+        recs = []
+        src = np.asarray(upper.st_src[node])
+        ref = np.asarray(upper.st_ref[node])
+        pay = np.asarray(upper.st_pay[node])
+        for c in range(start, head):
+            s = c % self.S
+            recs.append((int(src[s]), int(ref[s]),
+                         [int(x) for x in pay[s]]))
+        return recs, head, lost

@@ -67,14 +67,18 @@ class Msgs:
 
 def empty(cap: int, data_spec: Dict[str, Tuple[Tuple[int, ...], Any]]) -> Msgs:
     """An all-invalid buffer.  ``data_spec`` maps field name -> (trailing
-    shape, dtype); e.g. {"ttl": ((), jnp.int32), "sample": ((8,), jnp.int32)}.
-    """
+    shape, dtype) or (trailing shape, dtype, fill); e.g.
+    {"ttl": ((), jnp.int32), "sample": ((8,), jnp.int32)}.  ``fill``
+    (default 0) is the value a field takes in slots a handler does not
+    write — fields whose zero is meaningful (e.g. partition_key 0 = lane
+    key 0) declare a sentinel fill like -1."""
     z = jnp.zeros((cap,), dtype=jnp.int32)
     return Msgs(
         valid=jnp.zeros((cap,), dtype=bool),
         src=z, dst=z, typ=z, channel=z, lane=z, delay=z, born=z,
-        data={k: jnp.zeros((cap,) + tuple(shape), dtype=dt)
-              for k, (shape, dt) in data_spec.items()},
+        data={k: jnp.full((cap,) + tuple(spec[0]), spec[2] if len(spec) > 2
+                          else 0, dtype=spec[1])
+              for k, spec in data_spec.items()},
     )
 
 

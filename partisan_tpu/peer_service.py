@@ -14,6 +14,7 @@ from typing import Any, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .config import Config
 from .engine import ProtocolBase, World
@@ -21,12 +22,12 @@ from .ops import msg as msgops
 
 
 def send_ctl(world: World, proto: ProtocolBase, node: int, typ_name: str,
-             delay: int = 0, **data) -> World:
+             delay: int = 0, channel=None, **data) -> World:
     """Inject one control message addressed to ``node`` itself — the
     host-side verb entry point every façade call (and the test harness)
     goes through."""
     em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
-                    cap=1, delay=delay, **data)
+                    cap=1, delay=delay, channel=channel, **data)
     msgs, _ = msgops.inject(world.msgs, em, src=node)
     return world.replace(msgs=msgs)
 
@@ -83,3 +84,110 @@ def members(world: World, proto: ProtocolBase, node: int) -> jax.Array:
     (partisan_peer_service:members/0)."""
     row = jax.tree_util.tree_map(lambda x: x[node], world.state)
     return proto.member_mask(row)
+
+
+def sync_join(world: World, proto: ProtocolBase, node: int, peer: int,
+              step, max_rounds: int = 100) -> Tuple[World, int]:
+    """Blocking join — partisan_peer_service:sync_join via the pluggable
+    manager's sync_joins list + fully_connected check
+    (partisan_pluggable_peer_service_manager.erl:953-963, 1461-1480).
+    Runs rounds until BOTH sides list each other as members (the
+    simulator's "all channels x parallelism connections up" analog:
+    connections are implicit in membership here).  Returns
+    (world, rounds_taken); raises TimeoutError when the join does not
+    complete within ``max_rounds`` — the reference's gen_server call
+    timeout."""
+    world = join(world, proto, node, peer)
+    for r in range(1, max_rounds + 1):
+        world, _ = step(world)
+        if bool(members(world, proto, node)[peer]) and \
+                bool(members(world, proto, peer)[node]):
+            return world, r
+    raise TimeoutError(
+        f"sync_join({node} -> {peer}) incomplete after {max_rounds} rounds")
+
+
+# --------------------------------------------------------------- data plane
+# (partisan_peer_service:forward_message, the reference facade's data verb;
+#  requires the protocol to be Stacked(manager, DataPlane) — see
+#  models/dataplane.py)
+
+
+def _dataplane_of(proto: ProtocolBase):
+    """Locate the DataPlane in a (possibly lower-nested) stack.  Returns
+    (dp, state_path): ``state_path`` is the attribute path from
+    ``world.state`` to the DataRow subtree, mirroring the walk through
+    the Stacked tree (upper layers nest on the lower side only)."""
+    from .models.dataplane import DataPlane
+    p, path = proto, []
+    while p is not None:
+        up = getattr(p, "upper", None)
+        if isinstance(up, DataPlane):
+            return up, path + ["upper"]
+        path.append("lower")
+        p = getattr(p, "lower", None)
+    raise TypeError("protocol has no DataPlane layer; build it as "
+                    "Stacked(manager, DataPlane(cfg))")
+
+
+def forward_message(world: World, proto: ProtocolBase, src: int, dst: int,
+                    server_ref: int = 0, payload=(), ack: bool = False,
+                    channel=None, partition_key: int = -1,
+                    delay: int = 0) -> World:
+    """forward_message/5 (partisan_peer_service.erl:24-42 facade over
+    pluggable :183-248): ship ``payload`` from ``src`` to ``server_ref``
+    on ``dst`` over the simulated overlay.  The send-side pipeline (clock
+    stamping, ack store) runs inside the step at the source row.
+    One-record convenience over :func:`forward_batch` (single pipeline —
+    the two entry points cannot diverge)."""
+    return forward_batch(world, proto, [{
+        "src": src, "dst": dst, "server_ref": server_ref,
+        "payload": payload, "ack": ack, "channel": channel,
+        "partition_key": partition_key, "delay": delay}])
+
+
+def forward_batch(world: World, proto: ProtocolBase, records) -> World:
+    """Batched forward_message — ONE buffer write for the whole batch
+    (the port bridge's command-batching contract, SURVEY §7.3).  Each
+    record is a dict with keys src, dst, server_ref, payload and optional
+    ack / channel / partition_key / delay."""
+    if not records:
+        return world
+    dp, _ = _dataplane_of(proto)
+    k = len(records)
+    srcs = jnp.asarray([r["src"] for r in records], jnp.int32)
+    em = proto.emit(
+        srcs, proto.typ("ctl_fwd"), cap=k,
+        channel=jnp.asarray([r.get("channel", 0) or 0 for r in records],
+                            jnp.int32),
+        delay=jnp.asarray([r.get("delay", 0) for r in records], jnp.int32),
+        peer=jnp.asarray([r["dst"] for r in records], jnp.int32),
+        server_ref=jnp.asarray([r.get("server_ref", 0) for r in records],
+                               jnp.int32),
+        payload=jnp.asarray(np.stack([dp.pad_payload(r.get("payload", ()))
+                                      for r in records])),
+        ack=jnp.asarray([int(bool(r.get("ack", False))) for r in records],
+                        jnp.int32),
+        partition_key=jnp.asarray([r.get("partition_key", -1)
+                                   for r in records], jnp.int32))
+    msgs, dropped = msgops.inject(world.msgs, em, src=srcs)
+    if not isinstance(dropped, jax.core.Tracer) and int(dropped) > 0:
+        raise ValueError(f"in-flight buffer too small for the forward "
+                         f"batch ({int(dropped)} of {k} dropped); raise "
+                         f"out_cap")
+    return world.replace(msgs=msgs)
+
+
+def receive_messages(world: World, proto: ProtocolBase, node: int,
+                     cursor: int = 0):
+    """Drain app messages delivered to ``node`` since ``cursor`` — the
+    receive half of the check_forward_message round-trip
+    (test/partisan_SUITE.erl:1955).  Returns (records, new_cursor, lost);
+    records are (src, server_ref, payload_words).  The DataPlane may sit
+    anywhere in a lower-nested stack — the state subtree is resolved by
+    the same walk forward_message uses."""
+    dp, path = _dataplane_of(proto)
+    sub = world.state
+    for attr in path:
+        sub = getattr(sub, attr)
+    return dp.received(sub, node, cursor)

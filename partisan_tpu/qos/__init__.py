@@ -1,7 +1,7 @@
 """Messaging QoS backends (SURVEY §2.6): vector clocks, causal delivery,
-acknowledgement + retransmission, RPC — the TPU-native rebuilds of
-``src/partisan_vclock.erl``, ``src/partisan_causality_backend.erl``,
-``src/partisan_acknowledgement_backend.erl`` and
-``src/partisan_rpc_backend.erl``."""
+acknowledgement + retransmission, RPC, promises — the TPU-native rebuilds
+of ``src/partisan_vclock.erl``, ``src/partisan_causality_backend.erl``,
+``src/partisan_acknowledgement_backend.erl``,
+``src/partisan_rpc_backend.erl`` and ``src/partisan_promise_backend.erl``."""
 
 from . import vclock  # noqa: F401

@@ -1,0 +1,163 @@
+"""Standalone promise backend — ``src/partisan_promise_backend.erl``.
+
+The reference module is a declared-but-skeletal gen_server owning an ETS
+table (:1-78 — no public verbs beyond start_link); its intended role is
+the reply store for rpc-style request/response flows.  This rebuild gives
+the table the full verb set that role implies, as pure fixed-shape row
+functions usable inside jitted handlers (every array is a per-node slice):
+
+  create   park a pending promise under a caller-chosen ref
+  resolve  fulfil it with a value — FIRST resolve wins; later resolves
+           (duplicate acks) are counted, not applied
+  tick     age pending promises; those older than ``timeout`` flip to
+           TIMED_OUT (the reference analog: partisan_gen's call timeout,
+           src/partisan_gen.erl:156-186 — timeout -> exit)
+  query    read (found, state, value) by ref
+  forget   free a slot for reuse once the caller has consumed it
+
+:class:`Promises` wraps the table as an engine protocol so promises span
+nodes: ``ctl_expect`` parks a promise locally, ``p_resolve`` messages
+from any node fulfil it over the simulated overlay.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import ring
+from ..ops.msg import Msgs
+
+PENDING = 0
+RESOLVED = 1
+TIMED_OUT = 2
+
+
+@struct.dataclass
+class PromiseRow:
+    valid: jax.Array         # [P] slot allocated
+    ref: jax.Array           # [P]
+    state: jax.Array         # [P] PENDING / RESOLVED / TIMED_OUT
+    value: jax.Array         # [P]
+    age: jax.Array           # [P] rounds pending
+    dropped: jax.Array       # scalar — creates lost to a full table
+    dup_resolved: jax.Array  # scalar — resolves of a non-pending ref
+                             # (duplicate acks; counted, never applied)
+
+
+def init_rows(n_nodes: int, cap: int = 8) -> PromiseRow:
+    n = n_nodes
+    return PromiseRow(
+        valid=jnp.zeros((n, cap), bool),
+        ref=jnp.zeros((n, cap), jnp.int32),
+        state=jnp.zeros((n, cap), jnp.int32),
+        value=jnp.zeros((n, cap), jnp.int32),
+        age=jnp.zeros((n, cap), jnp.int32),
+        dropped=jnp.zeros((n,), jnp.int32),
+        dup_resolved=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def create(row: PromiseRow, ref) -> Tuple[PromiseRow, jax.Array]:
+    """Park a pending promise; returns (row', ok).  The table is keyed by
+    ref like the reference's ETS table: a create whose ref already holds a
+    slot is a no-op returning ok (so retried creates never double-allocate
+    and query stays single-valued).  Full table => ok False and the drop
+    is counted."""
+    exists = jnp.any(row.valid & (row.ref == ref))
+    free_ok, slot = ring.alloc(row.valid)
+    do = ~exists & free_ok
+    wr = lambda a, v: ring.masked_set(a, slot, do, v)
+    row = row.replace(
+        valid=wr(row.valid, True),
+        ref=wr(row.ref, ref),
+        state=wr(row.state, PENDING),
+        value=wr(row.value, 0),
+        age=wr(row.age, 0),
+        dropped=row.dropped + (~exists & ~free_ok).astype(jnp.int32),
+    )
+    return row, exists | do
+
+
+def resolve(row: PromiseRow, ref, value) -> PromiseRow:
+    """First resolve wins; a resolve matching no PENDING slot (already
+    resolved, timed out, or never created) increments dup_resolved."""
+    hit = row.valid & (row.ref == ref) & (row.state == PENDING)
+    any_hit = jnp.any(hit)
+    return row.replace(
+        state=jnp.where(hit, RESOLVED, row.state),
+        value=jnp.where(hit, value, row.value),
+        dup_resolved=row.dup_resolved + (~any_hit).astype(jnp.int32),
+    )
+
+
+def tick(row: PromiseRow, timeout: int) -> PromiseRow:
+    """Age pending promises; expire those reaching ``timeout`` rounds."""
+    pending = row.valid & (row.state == PENDING)
+    age = jnp.where(pending, row.age + 1, row.age)
+    expired = pending & (age >= timeout)
+    return row.replace(age=age,
+                       state=jnp.where(expired, TIMED_OUT, row.state))
+
+
+def query(row: PromiseRow, ref) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(found, state, value) for ``ref`` — found False => state/value
+    undefined (0)."""
+    hit = row.valid & (row.ref == ref)
+    found = jnp.any(hit)
+    pick = lambda a: jnp.sum(jnp.where(hit, a, 0))
+    return found, pick(row.state), pick(row.value)
+
+
+def forget(row: PromiseRow, ref) -> PromiseRow:
+    """Free the slot once consumed (the ETS delete)."""
+    hit = row.valid & (row.ref == ref)
+    return row.replace(valid=row.valid & ~hit)
+
+
+_tick_rows = tick  # the method below shadows the name inside the class
+
+
+class Promises(ProtocolBase):
+    """Cross-node promises over the overlay: ``ctl_expect`` parks a
+    pending promise at this node; any node's ``p_resolve {ref, value}``
+    message fulfils it; unresolved promises time out after
+    ``timeout`` rounds (counted per state, queryable per ref)."""
+
+    msg_types = ("p_resolve", "ctl_expect", "ctl_resolve")
+
+    def __init__(self, cfg: Config, cap: int = 8, timeout: int = 16):
+        self.cfg = cfg
+        self.P = cap
+        self.timeout = timeout
+        self.data_spec: Dict = {
+            "ref": ((), jnp.int32),
+            "value": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+        }
+        self.emit_cap = 1
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> PromiseRow:
+        return init_rows(cfg.n_nodes, self.P)
+
+    def handle_ctl_expect(self, cfg, me, row: PromiseRow, m: Msgs, key):
+        row, _ = create(row, m.data["ref"])
+        return row, self.no_emit()
+
+    def handle_ctl_resolve(self, cfg, me, row: PromiseRow, m: Msgs, key):
+        """Host-injected: ship a resolution to the promise's owner."""
+        return row, self.emit(m.data["peer"][None], self.typ("p_resolve"),
+                              ref=m.data["ref"], value=m.data["value"])
+
+    def handle_p_resolve(self, cfg, me, row: PromiseRow, m: Msgs, key):
+        return resolve(row, m.data["ref"], m.data["value"]), self.no_emit()
+
+    def tick(self, cfg, me, row: PromiseRow, rnd, key):
+        return _tick_rows(row, self.timeout), \
+            self.no_emit(self.tick_emit_cap)

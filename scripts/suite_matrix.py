@@ -1,0 +1,707 @@
+"""Suite-matrix parity driver — the reference's Common Test group matrix
+(test/partisan_SUITE.erl:121-308: groups x managers x feature flags)
+enumerated as parameterized configs and driven through BOTH the
+in-process engine and the Erlang port bridge, emitting one parity row
+per (group, test, path) into ``suite_matrix.csv``:
+
+    group,test,manager,path,result,detail
+
+``result`` is pass / fail / skipped; skipped rows carry the reason a
+group has no simulator analog (TLS handshakes, disterl, BEAM
+binary-heap tricks — transport-level machinery the round-synchronous
+simulator replaces wholesale, SURVEY §7.4).
+
+Usage: python scripts/suite_matrix.py [--out suite_matrix.csv]
+       [--only SUBSTR] [--engine-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import peer_service as ps  # noqa: E402
+from partisan_tpu.models.dataplane import DataPlane  # noqa: E402
+from partisan_tpu.models.stack import Stacked  # noqa: E402
+from partisan_tpu.ops import graph  # noqa: E402
+from partisan_tpu.verify import faults  # noqa: E402
+
+
+# ----------------------------------------------------------------- helpers
+
+def _manager(name, cfg):
+    if name == "full":
+        from partisan_tpu.models.full_membership import FullMembership
+        return FullMembership(cfg)
+    if name == "hyparview":
+        from partisan_tpu.models.hyparview import HyParView
+        return HyParView(cfg)
+    if name == "scamp_v1":
+        from partisan_tpu.models.scamp import ScampV1
+        return ScampV1(cfg)
+    if name == "scamp_v2":
+        from partisan_tpu.models.scamp import ScampV2
+        return ScampV2(cfg)
+    if name == "static":
+        from partisan_tpu.models.managers import StaticManager
+        return StaticManager(cfg)
+    if name == "client_server":
+        from partisan_tpu.models.managers import ClientServerManager
+        return ClientServerManager(cfg)
+    raise ValueError(name)
+
+
+def _cluster(cfg, proto, rounds=20, pairs=None, **step_kw):
+    world = pt.init_world(cfg, proto)
+    world = ps.cluster(world, proto,
+                       pairs or [(i, 0) for i in range(1, cfg.n_nodes)])
+    step = pt.make_step(cfg, proto, donate=False, **step_kw)
+    for _ in range(rounds):
+        world, m = step(world)
+    return world, step
+
+
+def _with_dataplane(mgr_name, cfg, rounds=20):
+    proto = Stacked(_manager(mgr_name, cfg), DataPlane(cfg))
+    world, step = _cluster(cfg, proto, rounds=rounds)
+    return proto, world, step
+
+
+def _assert_members_converged(world, proto, n):
+    masks = np.asarray(
+        [np.asarray(ps.members(world, proto, i)) for i in range(n)])
+    assert masks.all(), f"membership not converged:\n{masks.sum(axis=1)}"
+
+
+def _forward_roundtrip(proto, world, step, n, rounds=4, **opts):
+    """check_forward_message (partisan_SUITE.erl:1955): a distinct value
+    into EVERY node's store."""
+    world = ps.forward_batch(world, proto, [
+        {"src": (i + 1) % n, "dst": i, "server_ref": i,
+         "payload": [1000 + i], **opts} for i in range(n)])
+    for _ in range(rounds):
+        world, _ = step(world)
+    for i in range(n):
+        recs, _, _ = ps.receive_messages(world, proto, i)
+        got = [(s, r, p[0]) for s, r, p in recs]
+        assert ((i + 1) % n, i, 1000 + i) in got, (i, recs)
+    return world
+
+
+# ------------------------------------------------------------ engine cases
+# Each case mirrors one (group, test) cell of the reference matrix; the
+# docstring cites the reference test it stands for.
+
+def basic_test(manager="full", **cfg_kw):
+    """basic_test (:1399): cluster forms, members agree, a value
+    round-trips into every node's store."""
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2, **cfg_kw)
+    proto, world, step = _with_dataplane(manager, cfg)
+    _assert_members_converged(world, proto, n)
+    _forward_roundtrip(proto, world, step, n)
+
+
+def leave_test(self_leave=False):
+    """leave_test / self_leave_test: departure propagates to every
+    member's view."""
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+    from partisan_tpu.models.full_membership import FullMembership
+    proto = FullMembership(cfg)
+    world, step = _cluster(cfg, proto)
+    _assert_members_converged(world, proto, n)
+    world = ps.leave(world, proto, 3 if self_leave else 0,
+                     None if self_leave else 3)
+    for _ in range(12):
+        world, _ = step(world)
+    for i in range(3):
+        mask = np.asarray(ps.members(world, proto, i))
+        assert not mask[3], f"node {i} still lists the departed node"
+
+
+def on_down_test():
+    """on_down_test: membership-change callbacks fire on departure
+    (events.PeerServiceEvents — partisan_peer_service_events.erl:59-81)."""
+    from partisan_tpu import events
+    from partisan_tpu.models.full_membership import FullMembership
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+    proto = FullMembership(cfg)
+    world, step = _cluster(cfg, proto)
+    ev = events.PeerServiceEvents(proto)
+    fired = []
+    ev.add_sup_callback(lambda node, mask: fired.append((node, mask.copy())))
+    ev.update(world)
+    world = ps.leave(world, proto, 0, 3)
+    for _ in range(12):
+        world, _ = step(world)
+        ev.update(world)
+    assert any(not mask[3] for _, mask in fired), \
+        "no callback observed node 3 going down"
+
+
+def rpc_test(**cfg_kw):
+    """rpc_test (:813): a call ships, applies remotely, fulfils the
+    caller's promise."""
+    from partisan_tpu.qos.rpc import Rpc
+    cfg = pt.Config(n_nodes=4, inbox_cap=8, **cfg_kw)
+    proto = Rpc(cfg, fns=(lambda x: x * 2,))
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = ps.send_ctl(world, proto, 1, "ctl_call", peer=2, fn=0, arg=21)
+    for _ in range(4):
+        world, _ = step(world)
+    assert bool(world.state.prom_done[1].any())
+    assert 42 in np.asarray(world.state.prom_result[1])
+
+
+def client_server_manager_test():
+    """client_server_manager_test: clients attach to servers only."""
+    from partisan_tpu.models.managers import ClientServerManager
+    n = 6
+    cfg = pt.Config(n_nodes=n, inbox_cap=16)
+    proto = ClientServerManager(cfg, n_servers=2)
+    world, step = _cluster(cfg, proto, pairs=[(i, i % 2) for i in range(2, n)])
+    for c in range(2, n):
+        mask = np.asarray(ps.members(world, proto, c))
+        assert mask[:2].any(), f"client {c} reached no server: {mask}"
+        others = [j for j in range(2, n) if j != c]
+        assert not mask[others].any(), \
+            f"client {c} linked to other clients: {mask}"
+
+
+def rejoin_test():
+    """rejoin_test: leave then join again converges."""
+    from partisan_tpu.models.full_membership import FullMembership
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+    proto = FullMembership(cfg)
+    world, step = _cluster(cfg, proto)
+    world = ps.leave(world, proto, 3)
+    for _ in range(10):
+        world, _ = step(world)
+    world = ps.join(world, proto, 3, 0)
+    for _ in range(14):
+        world, _ = step(world)
+    _assert_members_converged(world, proto, n)
+
+
+def transform_test():
+    """transform_test: an imperatively-written (send-style) protocol runs
+    on the engine contract (partisan_transform.erl analog)."""
+    from partisan_tpu.transform import transformed
+    from partisan_tpu.engine import ProtocolBase
+
+    class Relay(transformed(ProtocolBase)):
+        msg_types = ("token", "ctl_seed")
+        emit_cap = 1
+
+        def __init__(self, cfg):
+            self.cfg = cfg
+            self.data_spec = {"payload": ((), jnp.int32),
+                              "peer": ((), jnp.int32)}
+
+        def init(self, cfg, key):
+            return jnp.zeros((cfg.n_nodes,), jnp.int32)
+
+        def handle_token(self, cfg, me, row, m, key, send):
+            nxt = (me + 1) % cfg.n_nodes
+            send(jnp.where(m.data["payload"] > 0, nxt, -1), "token",
+                 payload=m.data["payload"] - 1)
+            return row + 1
+
+        def handle_ctl_seed(self, cfg, me, row, m, key, send):
+            send(me, "token", payload=m.data["payload"])
+            return row
+
+    cfg = pt.Config(n_nodes=4, inbox_cap=4)
+    proto = Relay(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = ps.send_ctl(world, proto, 0, "ctl_seed", payload=8)
+    for _ in range(10):
+        world, _ = step(world)
+    assert int(np.asarray(world.state).sum()) == 9  # 8 hops + seed
+
+
+def otp_test():
+    """otp_test (:1261): a gen_server call over the overlay replies."""
+    from partisan_tpu import otp
+
+    class Doubler(otp.GenServer):
+        def server_call(self, cfg, me, row, req, key):
+            return row, req * 2
+
+    cfg = pt.Config(n_nodes=4, inbox_cap=8)
+    proto = Doubler(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = ps.send_ctl(world, proto, 1, "ctl_call", peer=2,
+                        req=jnp.asarray([21, 0], jnp.int32), timeout=10)
+    for _ in range(4):
+        world, _ = step(world)
+    assert bool(world.state.call_done[1][0])
+    assert int(world.state.call_reply[1][0][0]) == 42
+
+
+def connectivity_test(manager, n=16, rounds=40):
+    """connectivity_test (:1214): every node reaches every other over the
+    overlay graph."""
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=3)
+    proto = _manager(manager, cfg)
+    world, step = _cluster(cfg, proto, rounds=rounds)
+    views = getattr(world.state, "active", None)
+    if views is None:
+        views = getattr(world.state, "partial", None)
+    if views is not None:
+        adj = graph.adjacency_from_views(views, n)
+    else:
+        masks = jnp.stack([ps.members(world, proto, i) for i in range(n)])
+        adj = masks & ~jnp.eye(n, dtype=bool)
+    assert bool(graph.is_connected(adj)), f"{manager} overlay disconnected"
+
+
+def gossip_test(manager, n=8, rounds=24):
+    """gossip_test (:1138): direct-mail broadcast (demers_direct_mail
+    over the manager) delivers to every member."""
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=3)
+    proto = Stacked(_manager(manager, cfg), DataPlane(cfg, store_cap=8))
+    world, step = _cluster(cfg, proto, rounds=rounds)
+    # direct mail: node 0 sends the payload to every node
+    world = ps.forward_batch(world, proto, [
+        {"src": 0, "dst": i, "server_ref": 1, "payload": [777]}
+        for i in range(1, n)])
+    for _ in range(4):
+        world, _ = step(world)
+    for i in range(1, n):
+        recs, _, _ = ps.receive_messages(world, proto, i)
+        assert (0, 1, [777, 0, 0, 0]) in recs, (i, recs)
+
+
+def ack_test():
+    """ack_test (:573): acked messages survive omission faults via
+    retransmission; outstanding drains to zero."""
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+    from partisan_tpu.models.full_membership import FullMembership
+    proto = Stacked(FullMembership(cfg), DataPlane(cfg))
+    fwd_typ = proto.typ("fwd")
+
+    def drop_early_fwds(m, rnd):  # omission fault: fwds lost before r12
+        return m.replace(valid=m.valid & ~((m.typ == fwd_typ) & (rnd < 12)))
+
+    world = pt.init_world(cfg, proto)
+    world = ps.cluster(world, proto, [(i, 0) for i in range(1, n)])
+    step = pt.make_step(cfg, proto, donate=False,
+                        interpose_send=drop_early_fwds)
+    for _ in range(8):
+        world, _ = step(world)
+    world = ps.forward_message(world, proto, 1, 3, server_ref=9,
+                               payload=[55], ack=True)
+    for _ in range(12):
+        world, _ = step(world)
+    recs, _, _ = ps.receive_messages(world, proto, 3)
+    assert any(r == (1, 9, [55, 0, 0, 0]) for r in recs), recs
+    assert int(world.state.upper.out_valid[1].sum()) == 0
+
+
+def causal_test():
+    """causal_test (:402): delivery respects causal order under wire
+    reordering (causality_backend)."""
+    from partisan_tpu.qos.causal import CausalDelivery
+    cfg = pt.Config(n_nodes=4, inbox_cap=8)
+    proto = CausalDelivery(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False, randomize_delivery=False)
+    # three sends 0 -> 1 whose wire delays REVERSE arrival order
+    for k, d in ((1, 4), (2, 2), (3, 0)):
+        world = ps.send_ctl(world, proto, 0, "ctl_csend", peer=1,
+                            payload=k, cdelay=d)
+        world, _ = step(world)
+    for _ in range(10):
+        world, _ = step(world)
+    log = np.asarray(world.state.log[1])
+    assert int(world.state.log_n[1]) == 3
+    assert list(log[:3]) == [1, 2, 3], f"causal order violated: {log[:3]}"
+
+
+def interposition_test(kind):
+    """forward/receive/forward_delay interposition tests: drop or delay
+    hooks between emit and route (pluggable :51-58, 640-667)."""
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=2)
+    from partisan_tpu.models.full_membership import FullMembership
+    proto = Stacked(FullMembership(cfg), DataPlane(cfg))
+    fwd_typ = proto.typ("fwd")
+
+    if kind == "forward":
+        hook = {"interpose_send": lambda m, rnd: m.replace(
+            valid=m.valid & ~((m.typ == fwd_typ) & (m.dst == 2)))}
+    elif kind == "receive":
+        hook = {"interpose_recv": lambda m, rnd: m.replace(
+            valid=m.valid & ~((m.typ == fwd_typ) & (m.dst == 2)))}
+    else:  # forward_delay: the '$delay' verb
+        hook = {"interpose_send": lambda m, rnd: m.replace(
+            delay=jnp.where((m.typ == fwd_typ) & (m.dst == 2),
+                            jnp.maximum(m.delay, 5), m.delay))}
+
+    world = pt.init_world(cfg, proto)
+    world = ps.cluster(world, proto, [(i, 0) for i in range(1, n)])
+    step = pt.make_step(cfg, proto, donate=False, **hook)
+    for _ in range(8):
+        world, _ = step(world)
+    world = ps.forward_message(world, proto, 0, 2, server_ref=1,
+                               payload=[5])
+    world = ps.forward_message(world, proto, 0, 3, server_ref=1,
+                               payload=[6])
+    for _ in range(3):
+        world, _ = step(world)
+    recs3, _, _ = ps.receive_messages(world, proto, 3)
+    assert recs3 == [(0, 1, [6, 0, 0, 0])]  # untargeted node unaffected
+    recs2, _, _ = ps.receive_messages(world, proto, 2)
+    if kind in ("forward", "receive"):
+        assert recs2 == [], recs2            # dropped
+    else:
+        assert recs2 == [], recs2            # delayed: not yet...
+        for _ in range(5):
+            world, _ = step(world)
+        recs2, _, _ = ps.receive_messages(world, proto, 2)
+        assert recs2 == [(0, 1, [5, 0, 0, 0])]  # ...but arrives later
+
+
+def delay_test(field):
+    """with_ingress/egress_delay (server :85-90, client :88-93): a fixed
+    transport delay postpones delivery by that many rounds."""
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16)
+    from partisan_tpu.models.full_membership import FullMembership
+    proto = Stacked(FullMembership(cfg), DataPlane(cfg))
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = ps.forward_message(world, proto, 0, 2, server_ref=1,
+                               payload=[9], delay=4)
+    for _ in range(3):
+        world, _ = step(world)
+    assert ps.receive_messages(world, proto, 2)[0] == []
+    for _ in range(4):
+        world, _ = step(world)
+    assert ps.receive_messages(world, proto, 2)[0] == [(0, 1, [9, 0, 0, 0])]
+
+
+def channels_test(channels, monotonic=(), rpc_on_channel=False):
+    """with_channels / with_no_channels / with_monotonic_channels:
+    basic_test under the channel config; monotonic channels elide stale
+    sends (peer_connection :82-100)."""
+    basic_test(channels=tuple(channels), monotonic_channels=tuple(monotonic))
+    if rpc_on_channel:
+        rpc_test(channels=tuple(channels))
+
+
+def parallelism_test():
+    """with_parallelism: k connection lanes per edge (partisan.hrl:16)."""
+    basic_test(parallelism=4)
+
+
+def partition_key_test():
+    """with_partition_key: keyed messages ride a deterministic lane
+    (dispatch_pid, partisan_util.erl:190-195)."""
+    n = 4
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, parallelism=4,
+                    periodic_interval=2)
+    proto, world, step = _with_dataplane("full", cfg)
+    _forward_roundtrip(proto, world, step, n, partition_key=3)
+
+
+def sync_join_test():
+    """with_sync_join: join blocks until fully connected
+    (pluggable :1461-1480)."""
+    from partisan_tpu.models.full_membership import FullMembership
+    cfg = pt.Config(n_nodes=4, inbox_cap=8, periodic_interval=2)
+    proto = FullMembership(cfg)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world, rounds = ps.sync_join(world, proto, 1, 0, step)
+    assert rounds >= 1
+
+
+def broadcast_test():
+    """with_broadcast (hyparview_manager_high_active_test under
+    broadcast): plumtree over hyparview delivers to all."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.models.plumtree import Plumtree
+    n = 16
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1))
+    world, step = _cluster(cfg, proto, rounds=20)
+    world = ps.send_ctl(world, proto, 0, "ctl_pt_broadcast",
+                        pt_key=0, pt_val=42)
+    for _ in range(20):
+        world, _ = step(world)
+    vals = np.asarray(world.state.upper.val[:, 0])
+    assert (vals == 42).all(), f"broadcast incomplete: {(vals == 42).sum()}/{n}"
+
+
+def hyparview_partition_test():
+    """hyparview_manager_partition_test (:1586): a partition splits the
+    overlay; healing reconnects it."""
+    from partisan_tpu.models.hyparview import HyParView
+    n = 16
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    world, step = _cluster(cfg, proto, rounds=20)
+    world = faults.inject_partition(world, [list(range(8)),
+                                            list(range(8, 16))])
+    for _ in range(10):
+        world, _ = step(world)
+    world = faults.resolve_partition(world)
+    for _ in range(30):
+        world, _ = step(world)
+    adj = graph.adjacency_from_views(world.state.active, n)
+    assert bool(graph.is_connected(adj)), "overlay did not heal"
+
+
+def hyparview_high_active_test():
+    """hyparview_manager_high_active_test (:1706): connectivity and view
+    symmetry at N past max_active."""
+    connectivity_test("hyparview", n=24, rounds=40)
+
+
+def hyparview_high_client_test():
+    """hyparview_manager_high_client_test: many clients on few servers."""
+    client_server_manager_test()
+
+
+def performance_test():
+    """performance_test (:1029): the echo harness completes its streams
+    (the full swept numbers live in scripts/perf_suite.py ->
+    results.csv)."""
+    from partisan_tpu.models.echo import Echo
+    cfg = pt.Config(n_nodes=2, inbox_cap=8)
+    proto = Echo(cfg, concurrency=2, size_words=8, total=10)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    world = ps.send_ctl(world, proto, 0, "ctl_start", peer=0)
+    for _ in range(30):
+        world, _ = step(world)
+    assert bool(proto.done(world))
+
+
+# -------------------------------------------------------------- port cases
+
+def port_basic_test(manager="full", **props):
+    from partisan_tpu.bridge.client import PortClient
+    from partisan_tpu.bridge.etf import Atom
+    with PortClient() as pc:
+        assert pc.start(manager, n_nodes=4, periodic_interval=2,
+                        **props) == Atom("ok")
+        for i in range(1, 4):
+            assert pc.join(i, 0) == Atom("ok")
+        pc.advance(16)
+        assert pc.members(0) == list(range(4))
+        for i in range(4):
+            pc.forward((i + 1) % 4, i, i, [1000 + i])
+        pc.advance(4)
+        for i in range(4):
+            recs, lost = pc.recv(i)
+            assert lost == 0
+            assert ((i + 1) % 4, i, [1000 + i, 0, 0, 0]) in recs, (i, recs)
+
+
+def port_connectivity_test(manager):
+    from partisan_tpu.bridge.client import PortClient
+    from partisan_tpu.bridge.etf import Atom
+    with PortClient() as pc:
+        assert pc.start(manager, n_nodes=16, periodic_interval=3,
+                        data_plane=False) == Atom("ok")
+        for i in range(1, 16):
+            assert pc.join(i, 0) == Atom("ok")
+        pc.advance(60)
+        h = pc.health()
+        conv = h.get(Atom("convergence"), 0)
+        mean_view = h.get(Atom("view_mean"), None)
+        assert conv == 1.0 or (mean_view is not None and mean_view > 0), h
+
+
+def port_ack_test():
+    from partisan_tpu.bridge.client import PortClient
+    from partisan_tpu.bridge.etf import Atom
+    with PortClient() as pc:
+        assert pc.start("full", n_nodes=4, periodic_interval=2) == Atom("ok")
+        for i in range(1, 4):
+            pc.join(i, 0)
+        pc.advance(12)
+        assert pc.forward(1, 3, 7, [5], ack=True) == Atom("ok")
+        pc.advance(6)
+        recs, _ = pc.recv(3)
+        assert (1, 7, [5, 0, 0, 0]) in recs
+
+
+def port_sync_join_test():
+    from partisan_tpu.bridge.client import PortClient
+    from partisan_tpu.bridge.etf import Atom
+    with PortClient() as pc:
+        assert pc.start("full", n_nodes=4, periodic_interval=2) == Atom("ok")
+        assert pc.sync_join(1, 0) >= 1
+
+
+# ------------------------------------------------------------------ matrix
+
+SKIP = {
+    "with_tls": "TLS is transport-level; the simulated router has no "
+                "socket layer to wrap (SURVEY §7.4)",
+    "with_disterl": "disterl is the reference's control channel; replaced "
+                    "by the port bridge (SURVEY §7.4)",
+    "with_binary_padding": "BEAM shared-heap binary trick; no analog in "
+                           "array payloads",
+    "pid_test": "pid rewriting not ported (integer node ids only, "
+                "SURVEY §7.4)",
+    "with_parallelism_bypass_pid_encoding":
+        "pid encoding not ported; plain parallelism perf covered",
+    "with_partisan_bypass_pid_encoding":
+        "pid encoding not ported; performance_test covered under default",
+}
+
+
+def build_matrix():
+    """(group, test, manager, path, fn_or_skipreason) rows mirroring
+    all/0 + groups/0 of test/partisan_SUITE.erl:121-308."""
+    M = []
+    add = lambda *row: M.append(row)
+
+    # default group: simple + hyparview
+    add("default/simple", "basic_test", "full", "engine", basic_test)
+    add("default/simple", "leave_test", "full", "engine", leave_test)
+    add("default/simple", "self_leave_test", "full", "engine",
+        lambda: leave_test(self_leave=True))
+    add("default/simple", "on_down_test", "full", "engine", on_down_test)
+    add("default/simple", "rpc_test", "full", "engine", rpc_test)
+    add("default/simple", "client_server_manager_test", "client_server",
+        "engine", client_server_manager_test)
+    add("default/simple", "pid_test", "full", "engine", SKIP["pid_test"])
+    add("default/simple", "rejoin_test", "full", "engine", rejoin_test)
+    add("default/simple", "transform_test", "full", "engine", transform_test)
+    add("default/simple", "otp_test", "full", "engine", otp_test)
+    add("default/hyparview", "hyparview_manager_partition_test",
+        "hyparview", "engine", hyparview_partition_test)
+    add("default/hyparview", "hyparview_manager_high_active_test",
+        "hyparview", "engine", hyparview_high_active_test)
+    add("default/hyparview", "hyparview_manager_high_client_test",
+        "client_server", "engine", hyparview_high_client_test)
+
+    # membership strategies
+    for mgr in ("full", "scamp_v1", "scamp_v2"):
+        g = f"with_{mgr}_membership_strategy"
+        add(g, "connectivity_test", mgr, "engine",
+            lambda mgr=mgr: connectivity_test(mgr))
+        add(g, "gossip_test", mgr, "engine",
+            lambda mgr=mgr: gossip_test(mgr))
+
+    # features
+    add("with_ack", "basic_test", "full", "engine", basic_test)
+    add("with_ack", "ack_test", "full", "engine", ack_test)
+    add("with_causal_labels", "causal_test", "full", "engine", causal_test)
+    add("with_causal_send", "basic_test", "full", "engine", causal_test)
+    add("with_causal_send_and_ack", "basic_test", "full", "engine",
+        causal_test)
+    add("with_forward_interposition", "forward_interposition_test", "full",
+        "engine", lambda: interposition_test("forward"))
+    add("with_forward_delay_interposition",
+        "forward_delay_interposition_test", "full", "engine",
+        lambda: interposition_test("forward_delay"))
+    add("with_receive_interposition", "receive_interposition_test", "full",
+        "engine", lambda: interposition_test("receive"))
+    add("with_tls", "basic_test", "full", "engine", SKIP["with_tls"])
+    add("with_parallelism", "basic_test", "full", "engine",
+        parallelism_test)
+    add("with_parallelism_bypass_pid_encoding", "performance_test", "full",
+        "engine", SKIP["with_parallelism_bypass_pid_encoding"])
+    add("with_partisan_bypass_pid_encoding", "performance_test", "full",
+        "engine", SKIP["with_partisan_bypass_pid_encoding"])
+    add("with_disterl", "performance_test", "full", "engine",
+        SKIP["with_disterl"])
+    add("default", "performance_test", "full", "engine", performance_test)
+    add("with_channels", "basic_test", "full", "engine",
+        lambda: channels_test(("undefined", "rpc", "membership")))
+    add("with_channels", "rpc_test", "full", "engine",
+        lambda: channels_test(("undefined", "rpc"), rpc_on_channel=True))
+    add("with_no_channels", "basic_test", "full", "engine",
+        lambda: channels_test(("undefined",)))
+    add("with_monotonic_channels", "basic_test", "full", "engine",
+        lambda: channels_test(("undefined", "mono"), monotonic=("mono",)))
+    add("with_sync_join", "basic_test", "full", "engine", sync_join_test)
+    add("with_binary_padding", "basic_test", "full", "engine",
+        SKIP["with_binary_padding"])
+    add("with_partition_key", "basic_test", "full", "engine",
+        partition_key_test)
+    add("with_ingress_delay", "basic_test", "full", "engine",
+        lambda: delay_test("ingress"))
+    add("with_egress_delay", "basic_test", "full", "engine",
+        lambda: delay_test("egress"))
+    add("with_broadcast", "hyparview_manager_high_active_test",
+        "hyparview", "engine", broadcast_test)
+
+    # the same contracts over the port bridge (the Erlang-facing path)
+    add("default/simple", "basic_test", "full", "port", port_basic_test)
+    add("default/hyparview", "connectivity_test", "hyparview", "port",
+        lambda: port_connectivity_test("hyparview"))
+    add("with_full_membership_strategy", "connectivity_test", "full",
+        "port", lambda: port_connectivity_test("full"))
+    add("with_ack", "ack_test", "full", "port", port_ack_test)
+    add("with_sync_join", "basic_test", "full", "port", port_sync_join_test)
+    add("with_parallelism", "basic_test", "full", "port",
+        lambda: port_basic_test(parallelism=4))
+    return M
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="suite_matrix.csv")
+    ap.add_argument("--only", default=None)
+    ap.add_argument("--engine-only", action="store_true")
+    args = ap.parse_args()
+
+    rows = []
+    failures = 0
+    for group, test, mgr, path, fn in build_matrix():
+        if args.only and args.only not in f"{group}/{test}":
+            continue
+        if args.engine_only and path != "engine":
+            continue
+        if isinstance(fn, str):
+            rows.append([group, test, mgr, path, "skipped", fn])
+            print(f"SKIP {group}/{test} [{path}]: {fn}")
+            continue
+        t0 = time.time()
+        try:
+            fn()
+            rows.append([group, test, mgr, path, "pass",
+                         f"{time.time() - t0:.1f}s"])
+            print(f"PASS {group}/{test} [{path}] ({time.time() - t0:.1f}s)")
+        except Exception as e:  # noqa: BLE001
+            failures += 1
+            detail = f"{type(e).__name__}: {e}"[:160].replace("\n", " ")
+            rows.append([group, test, mgr, path, "fail", detail])
+            print(f"FAIL {group}/{test} [{path}]: {detail}")
+            traceback.print_exc()
+    with open(args.out, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["group", "test", "manager", "path", "result", "detail"])
+        w.writerows(rows)
+    print(f"\n{len(rows)} rows -> {args.out}; {failures} failures")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""Promise backend tests — the standalone promise table
+(qos/promise.py, src/partisan_promise_backend.erl) and the sync_join
+facade verb (pluggable :953-963, 1461-1480)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service as ps
+from partisan_tpu.peer_service import send_ctl
+from partisan_tpu.qos import promise as pr
+
+
+class TestPromiseTable:
+    """Pure row-level verbs on a single node's slice."""
+
+    def row(self, cap=4):
+        return jax.tree_util.tree_map(lambda x: x[0], pr.init_rows(1, cap))
+
+    def test_create_resolve_query(self):
+        row = self.row()
+        row, ok = pr.create(row, jnp.int32(7))
+        assert bool(ok)
+        found, state, value = pr.query(row, jnp.int32(7))
+        assert bool(found) and int(state) == pr.PENDING
+        row = pr.resolve(row, jnp.int32(7), jnp.int32(99))
+        found, state, value = pr.query(row, jnp.int32(7))
+        assert int(state) == pr.RESOLVED and int(value) == 99
+        assert int(row.dup_resolved) == 0
+
+    def test_duplicate_resolve_counted_not_applied(self):
+        row = self.row()
+        row, _ = pr.create(row, jnp.int32(3))
+        row = pr.resolve(row, jnp.int32(3), jnp.int32(10))
+        row = pr.resolve(row, jnp.int32(3), jnp.int32(20))  # duplicate ack
+        _, state, value = pr.query(row, jnp.int32(3))
+        assert int(state) == pr.RESOLVED and int(value) == 10
+        assert int(row.dup_resolved) == 1
+        # resolving a never-created ref is also a counted no-op
+        row = pr.resolve(row, jnp.int32(42), jnp.int32(1))
+        assert int(row.dup_resolved) == 2
+
+    def test_timeout(self):
+        row = self.row()
+        row, _ = pr.create(row, jnp.int32(5))
+        for _ in range(3):
+            row = pr.tick(row, timeout=3)
+        _, state, _ = pr.query(row, jnp.int32(5))
+        assert int(state) == pr.TIMED_OUT
+        # a late resolve of a timed-out promise is a duplicate
+        row = pr.resolve(row, jnp.int32(5), jnp.int32(1))
+        assert int(row.dup_resolved) == 1
+
+    def test_full_table_counts_drops(self):
+        row = self.row(cap=2)
+        for ref in (1, 2, 3):
+            row, ok = pr.create(row, jnp.int32(ref))
+        assert int(row.dropped) == 1
+        # forget frees the slot for reuse
+        row = pr.forget(row, jnp.int32(1))
+        row, ok = pr.create(row, jnp.int32(4))
+        assert bool(ok) and int(row.dropped) == 1
+
+
+class TestPromisesProtocol:
+    def test_cross_node_resolution(self):
+        """Node 2 parks a promise; node 5 resolves it over the overlay;
+        an unresolved one on node 3 times out."""
+        cfg = pt.Config(n_nodes=6, inbox_cap=8)
+        proto = pr.Promises(cfg, timeout=6)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 2, "ctl_expect", ref=11)
+        world = send_ctl(world, proto, 3, "ctl_expect", ref=12)
+        world = send_ctl(world, proto, 5, "ctl_resolve", delay=1,
+                         peer=2, ref=11, value=77)
+        for _ in range(4):
+            world, _ = step(world)
+        row2 = jax.tree_util.tree_map(lambda x: x[2], world.state)
+        found, state, value = pr.query(row2, jnp.int32(11))
+        assert bool(found) and int(state) == pr.RESOLVED and int(value) == 77
+        # node 3's promise is still pending, then times out
+        for _ in range(6):
+            world, _ = step(world)
+        row3 = jax.tree_util.tree_map(lambda x: x[3], world.state)
+        _, state, _ = pr.query(row3, jnp.int32(12))
+        assert int(state) == pr.TIMED_OUT
+
+
+class TestSyncJoin:
+    def test_sync_join_completes(self):
+        from partisan_tpu.models.full_membership import FullMembership
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, periodic_interval=2)
+        proto = FullMembership(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world, rounds = ps.sync_join(world, proto, 1, 0, step)
+        assert rounds >= 1
+        assert bool(ps.members(world, proto, 1)[0])
+        assert bool(ps.members(world, proto, 0)[1])
+
+    def test_sync_join_times_out_on_dead_peer(self):
+        from partisan_tpu.models.full_membership import FullMembership
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = FullMembership(cfg)
+        world = pt.init_world(cfg, proto)
+        world = world.replace(alive=world.alive.at[0].set(False))
+        step = pt.make_step(cfg, proto, donate=False)
+        with pytest.raises(TimeoutError):
+            ps.sync_join(world, proto, 1, 0, step, max_rounds=8)
