@@ -257,16 +257,29 @@ handle_call({forward_message, SrcId, Name, ServerRef, Message}, _From,
     %% hooks as protocol traffic; drained by the {recv, Id} poll in the
     %% advance tick, which delivers to ServerRef on the BEAM node
     %% attached to the destination vnode.
-    {RefId, State} = ref_id(ServerRef, State0),
-    Payload = term_to_words(Message),
-    ok = command(Port, {forward, SrcId, node_to_id(Name), RefId, Payload}),
-    {reply, ok, State};
+    try term_to_words(Message) of
+        Payload ->
+            {RefId, State} = ref_id(ServerRef, State0),
+            ok = command(Port, {forward, SrcId, node_to_id(Name), RefId,
+                                Payload}),
+            {reply, ok, State}
+    catch
+        %% an oversized term must error to the CALLER, not crash the
+        %% shared owner gen_server (which would tear down the port and
+        %% the whole cluster's world)
+        error:{payload_too_large, Len} ->
+            {reply, {error, {payload_too_large, Len}}, State0}
+    end;
 
 handle_call({update_members, Id, Members}, _From,
-            #state{port=Port, membership=Current}=State) ->
+            #state{port=Port}=State) ->
+    %% diff against the CALLER's membership view, not the owner's cached
+    %% one — a proxy shim resetting its own member list must not evict
+    %% unrelated live nodes
+    {ok, CurrentIds} = command(Port, {members, Id}),
     Wanted = lists:usort([node_to_id(M) || M <- Members]),
-    Extra = Current -- Wanted,
-    Missing = Wanted -- Current,
+    Extra = (CurrentIds -- [Id]) -- Wanted,
+    Missing = Wanted -- CurrentIds,
     [ok = command(Port, {join, I, Id}) || I <- Missing],
     [ok = command(Port, {leave, I}) || I <- Extra],
     {reply, ok, State};

@@ -127,6 +127,11 @@ class Session:
             self.proto = Stacked(self.proto, self.dp)
         self.world = init_world(self.cfg, self.proto)
         self.step = make_step(self.cfg, self.proto, donate=False)
+        # a re-start is a fresh world: session-side cursors and queued
+        # forwards from the previous world must not leak into it (same
+        # stale-cursor hazard cmd_restore documents)
+        self.recv_cursors = {}
+        self.pending_fwds = []
         return Atom("ok")
 
     def _started(self) -> bool:
@@ -172,9 +177,11 @@ class Session:
     def _flush_forwards(self) -> None:
         if self.pending_fwds:
             from ..peer_service import forward_batch
-            self.world = forward_batch(self.world, self.proto,
-                                       self.pending_fwds)
-            self.pending_fwds = []
+            batch, self.pending_fwds = self.pending_fwds, []
+            # the queue is cleared BEFORE applying: a failing batch (e.g.
+            # in-flight buffer full) must error once, not wedge every
+            # subsequent advance by replaying the same poison records
+            self.world = forward_batch(self.world, self.proto, batch)
 
     def cmd_forward(self, src: int, dst: int, server_ref: int, payload,
                     opts=()) -> Any:
@@ -182,6 +189,10 @@ class Session:
         rec = {"src": int(src), "dst": int(dst),
                "server_ref": int(server_ref),
                "payload": [int(x) for x in payload]}
+        if len(rec["payload"]) > self.dp.P:
+            # reject at enqueue time — a bad record must not poison the
+            # batched flush at the next advance
+            return (Atom("error"), Atom("payload_too_large"))
         for item in opts:
             k, v = (item, True) if isinstance(item, Atom) else item
             rec[str(k)] = bool(v) if str(k) == "ack" else int(v)

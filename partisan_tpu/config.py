@@ -81,6 +81,13 @@ class Config:
     #   the simulator's transport can drop messages (inbox overflow), so
     #   dead/one-sided active edges are detected by keepalive expiry instead
     #   of socket death.
+    ingress_delay: int = 0             # server-side receive sleep, in rounds
+    egress_delay: int = 0              # client-side send sleep, in rounds
+    # ^ partisan_peer_service_server.erl:85-90 / _client.erl:88-93.  In a
+    #   round-synchronous simulator both collapse to extra rounds in
+    #   flight, applied once at emission (their sum); the two knobs are
+    #   kept distinct so each reference config group maps to its own
+    #   field (with_ingress_delay / with_egress_delay).
     broadcast: bool = False            # tree-based transitive relay when disconnected
     distance_enabled: bool = False     # ?DISTANCE_ENABLED (partisan.hrl:40)
     distance_interval: int = 10        # ping/pong distance metrics (pluggable :852-873)

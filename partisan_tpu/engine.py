@@ -309,6 +309,14 @@ def make_step(
         now = now.replace(valid=now.valid & same_part)
         if interpose_recv is not None:
             now = _interp(interpose_recv, now, rnd, world)
+            # the '$delay' verb on the RECV side: a hook that bumps delay
+            # re-holds the message for later rounds — without this split
+            # build_inbox would treat it as undeliverable and its held
+            # output is discarded (silent loss)
+            re_held = now.replace(valid=now.valid & (now.delay > 0),
+                                  delay=jnp.maximum(now.delay - 1, 0))
+            held = msgops.concat(held, re_held)
+            now = now.replace(valid=now.valid & (now.delay <= 0))
 
         # -- connection lanes: partition-key hash or random spread over the
         #    k parallel connections (dispatch_pid, partisan_util.erl:142-201)
@@ -365,6 +373,11 @@ def make_step(
         new = msgops.concat(flat(demits, d_per), flat(temits, T))
         alive_src = world.alive[jnp.clip(new.src, 0, N - 1)]
         new = new.replace(valid=new.valid & alive_src)
+        # transport delays (ingress_delay + egress_delay, Config): extra
+        # rounds in flight, stamped once at emission
+        if cfg.ingress_delay or cfg.egress_delay:
+            new = new.replace(
+                delay=new.delay + cfg.ingress_delay + cfg.egress_delay)
         if interpose_send is not None:
             new = _interp(interpose_send, new, rnd, world)  # once, at send
         out = msgops.concat(new, held)

@@ -1,11 +1,15 @@
 """Full-membership strategy: CRDT gossip over the complete member set.
 
 TPU-native rebuild of ``src/partisan_full_membership_strategy.erl``:
-  * membership is a ``state_orset`` CRDT (:33) — here encoded for the fixed
-    node-id universe as two packed bitsets per node (adds, rems); the member
-    set is ``adds & ~rems`` (2P-set cover of the orset for a universe where a
-    node id re-joins under a fresh id, which is how the simulator's churn
-    generator works).
+  * membership is a ``state_orset`` CRDT (:33) — here encoded for the
+    fixed node-id universe as per-element add/remove EPOCH vectors: node
+    ``t`` is a member iff ``add_ep[t] > rmv_ep[t]``; merge is the
+    elementwise max of both vectors (a join-semilattice, so gossip
+    converges).  Epochs are the fixed-shape analog of the orset's unique
+    dots: a re-add mints ``rmv_ep[t] + 1``, which survives every
+    already-observed removal — add-wins observed-remove semantics, so a
+    node can leave and REJOIN under the same id exactly like the
+    reference (rejoin_test), unlike a 2P tombstone set.
   * join = CRDT merge + re-gossip to all          (:49-55)
   * leave = rmv mutation, gossiped                (:58-89)
   * periodic = full state to every peer           (:92-96, 127-144)
@@ -17,7 +21,7 @@ clusters (SURVEY §7.3); the big-N configs use HyParView / SCAMP.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -25,18 +29,17 @@ from flax import struct
 
 from ..config import Config
 from ..engine import ProtocolBase
-from ..ops import bitset
 from ..ops.msg import Msgs
 
 
 @struct.dataclass
 class FullState:
-    adds: jax.Array   # [N, W] uint32 — grow-only add set
-    rems: jax.Array   # [N, W] uint32 — grow-only remove set
-    left: jax.Array   # [N] bool — self-evicted, inert (the {stop, normal}
-                      # shutdown when a node sees itself removed,
-                      # pluggable :1170-1188); rejoining needs a fresh id
-                      # (2P-set semantics, see module docstring)
+    add_ep: jax.Array  # [N, N] uint8 — highest observed add epoch per node
+    rmv_ep: jax.Array  # [N, N] uint8 — highest observed remove epoch
+    left: jax.Array    # [N] bool — self-evicted, inert (the {stop, normal}
+                       # shutdown when a node sees itself removed,
+                       # pluggable :1170-1188); a later ctl_join revives it
+                       # (the app restarting partisan, rejoin_test)
 
 
 class FullMembership(ProtocolBase):
@@ -44,21 +47,28 @@ class FullMembership(ProtocolBase):
 
     def __init__(self, cfg: Config):
         self.cfg = cfg
-        self.W = bitset.n_words(cfg.n_nodes)
+        n = cfg.n_nodes
+        # full-state gossip is O(N) per MESSAGE and fan-out is O(N) per
+        # node — the strategy the reference itself uses only for small
+        # clusters (SURVEY §7.3).  Epochs ride uint8 (saturating at 255
+        # leave/rejoin cycles per node) to keep the wire payload at 2N
+        # bytes; the guard keeps the flat buffer allocatable.
+        assert n <= 128, (
+            f"FullMembership is the small-cluster strategy (O(N^2) wire "
+            f"state); use HyParView/SCAMP beyond 128 nodes, got {n}")
         self.data_spec: Dict = {
-            "adds": ((self.W,), jnp.uint32),
-            "rems": ((self.W,), jnp.uint32),
+            "add_ep": ((n,), jnp.uint8),
+            "rmv_ep": ((n,), jnp.uint8),
             "peer": ((), jnp.int32),
         }
         # gossip fan-out is "to every member" — cap at N (small-N strategy)
-        self.emit_cap = cfg.n_nodes
-        self.tick_emit_cap = cfg.n_nodes
+        self.emit_cap = n
+        self.tick_emit_cap = n
 
     # -- helpers ------------------------------------------------------------
 
     def member_mask(self, row: FullState) -> jax.Array:
-        n = self.cfg.n_nodes
-        return bitset.to_mask(row.adds, n) & ~bitset.to_mask(row.rems, n)
+        return row.add_ep > row.rmv_ep
 
     def _peers(self, row: FullState, me: jax.Array) -> jax.Array:
         """Padded list of members excluding self (gossip targets,
@@ -70,15 +80,16 @@ class FullMembership(ProtocolBase):
 
     def _gossip_all(self, row: FullState, me: jax.Array) -> Msgs:
         return self.emit(self._peers(row, me), self.typ("gossip"),
-                         adds=row.adds, rems=row.rems)
+                         add_ep=row.add_ep, rmv_ep=row.rmv_ep)
 
     # -- behaviour callbacks ------------------------------------------------
 
     def init(self, cfg: Config, key: jax.Array) -> FullState:
-        n, w = cfg.n_nodes, self.W
-        me = jnp.arange(n)
-        adds = jax.vmap(lambda i: bitset.add(jnp.zeros((w,), jnp.uint32), i))(me)
-        return FullState(adds=adds, rems=jnp.zeros((n, w), jnp.uint32),
+        n = cfg.n_nodes
+        # each node starts knowing only itself: own add epoch 1
+        add_ep = jnp.eye(n, dtype=jnp.uint8)
+        return FullState(add_ep=add_ep,
+                         rmv_ep=jnp.zeros((n, n), jnp.uint8),
                          left=jnp.zeros((n,), bool))
 
     def tick(self, cfg, node_id, row, rnd, key):
@@ -91,14 +102,15 @@ class FullMembership(ProtocolBase):
         # and local states, not "did my state change" (full :99-116):
         # a node holding strictly more knowledge than the sender must
         # re-gossip so the SENDER converges too
-        unequal = jnp.any((m.data["adds"] != row.adds)
-                          | (m.data["rems"] != row.rems))
-        adds = row.adds | m.data["adds"]
-        rems = row.rems | m.data["rems"]
+        unequal = jnp.any((m.data["add_ep"] != row.add_ep)
+                          | (m.data["rmv_ep"] != row.rmv_ep))
+        add_ep = jnp.maximum(row.add_ep, m.data["add_ep"])
+        rmv_ep = jnp.maximum(row.rmv_ep, m.data["rmv_ep"])
         # seeing myself removed is the self-eviction shutdown
         # (pluggable :1170-1188): go inert
-        evicted = bitset.contains(rems, node_id)
-        row = row.replace(adds=adds, rems=rems, left=row.left | evicted)
+        evicted = rmv_ep[node_id] >= add_ep[node_id]
+        row = row.replace(add_ep=add_ep, rmv_ep=rmv_ep,
+                          left=row.left | evicted)
         em = self._gossip_all(row, node_id)
         # a left node is stopped in the reference; it cannot answer
         return row, em.replace(valid=em.valid & unequal & ~row.left)
@@ -106,11 +118,20 @@ class FullMembership(ProtocolBase):
     def handle_ctl_join(self, cfg, node_id, row, m, key):
         """Control-plane join(peer): merge peer into my view and push my full
         state at it — the {connected, ...} handshake collapsed to one message
-        (pluggable :986-1044 -> full :49-55)."""
+        (pluggable :986-1044 -> full :49-55).  Both the peer's and MY OWN
+        membership are (re-)minted above any observed removal — a fresh
+        orset dot — which both bootstraps first joins and revives a node
+        rejoining after leave (rejoin_test)."""
         peer = m.data["peer"]
-        row = row.replace(adds=bitset.add(row.adds, peer))
+        # saturating epoch mint: at 255 cycles the slot pins removed
+        # (documented bound; max-merge stays a semilattice either way)
+        readd = lambda eps, t: eps.at[t].set(jnp.maximum(
+            eps[t], jnp.where(row.rmv_ep[t] < 255,
+                              row.rmv_ep[t] + 1, row.rmv_ep[t])))
+        add_ep = readd(readd(row.add_ep, peer), node_id)
+        row = row.replace(add_ep=add_ep, left=jnp.zeros((), bool))
         return row, self.emit(peer[None], self.typ("gossip"),
-                              adds=row.adds, rems=row.rems)
+                              add_ep=row.add_ep, rmv_ep=row.rmv_ep)
 
     def handle_ctl_leave(self, cfg, node_id, row, m, key):
         """leave(target): rmv mutation gossiped to the PRE-removal member
@@ -120,7 +141,9 @@ class FullMembership(ProtocolBase):
         gossip."""
         target = m.data["peer"]
         peers_before = self._peers(row, node_id)
-        row = row.replace(rems=bitset.add(row.rems, target),
+        rmv_ep = row.rmv_ep.at[target].set(
+            jnp.maximum(row.rmv_ep[target], row.add_ep[target]))
+        row = row.replace(rmv_ep=rmv_ep,
                           left=row.left | (target == node_id))
         return row, self.emit(peers_before, self.typ("gossip"),
-                              adds=row.adds, rems=row.rems)
+                              add_ep=row.add_ep, rmv_ep=row.rmv_ep)

@@ -101,10 +101,18 @@ class ModelChecker:
     def check(self, candidate_typs: Optional[Iterable[int]] = None,
               max_drops: int = 1,
               max_schedules: int = 1000,
-              annotations: Optional[Dict[str, list]] = None) -> CheckResult:
+              annotations: Optional[Dict[str, list]] = None,
+              candidate_filter: Optional[Callable[[Key], bool]] = None,
+              ) -> CheckResult:
         """Enumerate and replay omission schedules up to ``max_drops``
         simultaneous omissions (the powerset walk of :697-930, breadth
         first, causally pruned).
+
+        ``candidate_filter`` restricts the omission candidates by full
+        key (round, src, dst, typ) — e.g. targeting one destination to
+        explore deep blocking classes without the full combinatorial
+        frontier (the reference narrows candidates the same way, by
+        tracing only the protocol under test).
 
         ``annotations`` (a causality map from verify/analysis.py) enables
         the reference's independence pruning (:697-930 prune via the
@@ -121,6 +129,8 @@ class ModelChecker:
             seen, out = set(), []
             for k in keys:
                 if candidate_typs is not None and k[3] not in candidate_typs:
+                    continue
+                if candidate_filter is not None and not candidate_filter(k):
                     continue
                 if k not in seen:
                     seen.add(k)

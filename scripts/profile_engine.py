@@ -1,0 +1,127 @@
+"""Differential profiling for the generic engine (ROADMAP #1/#3).
+
+Times one steady-state round of a protocol under ablations that isolate
+each engine phase, so the dominant cost is located by subtraction rather
+than guessed:
+
+  default       the full step as configured
+  inbox_K/4     deliver loop scaled down (K x types gating cost)
+  null_handlers handlers return (row, no_emit) — framework minus protocol
+  node_cap      per-node emission pre-compaction before the global sort
+  gather_G      sparse delivery gather
+  out_cap/4     the global compact + route sort at a smaller carry
+
+Usage: python scripts/profile_engine.py [--proto scamp_v2|hyparview]
+       [--n 1024] [--rounds 20] [--warm 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import peer_service  # noqa: E402
+from partisan_tpu.engine import default_out_cap, init_world, make_step  # noqa: E402
+
+
+def build(cfg, proto_name):
+    if proto_name == "scamp_v2":
+        from partisan_tpu.models.scamp import ScampV2
+        return ScampV2(cfg)
+    if proto_name == "hyparview":
+        from partisan_tpu.models.hyparview import HyParView
+        return HyParView(cfg)
+    raise ValueError(proto_name)
+
+
+def null_wrap(proto):
+    """Replace every handler body with identity (same emission SHAPES so
+    the collect path is unchanged) — what's left is the engine frame."""
+    class Null(type(proto)):
+        def handlers(self):
+            def h(cfg, me, row, m, key):
+                return row, self.no_emit()
+            return tuple(h for _ in self.msg_types)
+
+        def tick(self, cfg, me, row, rnd, key):
+            return row, self.no_emit(self.tick_emit_cap)
+    n = object.__new__(Null)
+    n.__dict__.update(proto.__dict__)
+    return n
+
+
+def timed(cfg, proto, world, rounds, label, out_cap=None):
+    step = make_step(cfg, proto, donate=False, out_cap=out_cap)
+    w, m = step(world)                      # compile
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    w = world
+    for _ in range(rounds):
+        w, m = step(w)
+    jax.block_until_ready(m)
+    dt = (time.perf_counter() - t0) / rounds
+    print(f"{label:24s} {dt * 1e3:9.1f} ms/round   "
+          f"({1 / dt:7.1f} rounds/s)")
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--proto", default="scamp_v2")
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--warm", type=int, default=40)
+    args = ap.parse_args()
+
+    def mkcfg(**kw):
+        base = dict(n_nodes=args.n, inbox_cap=16, periodic_interval=5)
+        base.update(kw)
+        return pt.Config(**base)
+
+    cfg = mkcfg()
+    proto = build(cfg, args.proto)
+    world = init_world(cfg, proto)
+    world = peer_service.cluster(
+        world, proto, [(i, 0) for i in range(1, args.n)], stagger=8)
+    warm_step = make_step(cfg, proto, donate=False)
+    for _ in range(args.warm):
+        world, _ = warm_step(world)         # steady-state world
+    jax.block_until_ready(world.msgs.valid)
+    print(f"proto={args.proto} N={args.n} "
+          f"out_cap={default_out_cap(cfg, proto)} "
+          f"K={cfg.inbox_cap} E={proto.emit_cap} T={proto.tick_emit_cap} "
+          f"types={len(proto.msg_types)} "
+          f"inflight={int(world.msgs.count())}")
+
+    timed(cfg, proto, world, args.rounds, "default")
+    timed(cfg, proto, world, args.rounds, "out_cap/4",
+          out_cap=default_out_cap(cfg, proto) // 4)
+    timed(cfg, null_wrap(proto), world, args.rounds, "null_handlers")
+
+    cfg4 = mkcfg(inbox_cap=4)
+    p4 = build(cfg4, args.proto)
+    w4 = jax.tree_util.tree_map(lambda x: x, world)
+    timed(cfg4, p4, w4, args.rounds, "inbox_K=4")
+
+    cfgn = mkcfg(node_emit_cap=8)
+    timed(cfgn, build(cfgn, args.proto), world, args.rounds,
+          "node_emit_cap=8")
+
+    cfgg = mkcfg(deliver_gather_cap=32)
+    timed(cfgg, build(cfgg, args.proto), world, args.rounds,
+          "gather_G=32")
+
+    cfgng = mkcfg(node_emit_cap=8, deliver_gather_cap=32)
+    timed(cfgng, build(cfgng, args.proto), world, args.rounds,
+          "node_cap+gather")
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,8 @@ class TestCheckpoint:
         for _ in range(5):
             wb, _ = step(wb)
 
-        assert (np.asarray(wa.state.adds) == np.asarray(wb.state.adds)).all()
+        assert (np.asarray(wa.state.add_ep)
+                == np.asarray(wb.state.add_ep)).all()
         assert (np.asarray(wa.msgs.valid) == np.asarray(wb.msgs.valid)).all()
         assert int(wa.rnd) == int(wb.rnd) == 10
 

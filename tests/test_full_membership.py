@@ -105,3 +105,25 @@ def test_crashed_node_stops_gossiping():
     world = run_rounds(cfg, proto, world, 6)
     m0 = np.asarray(peer_service.members(world, proto, 0))
     np.testing.assert_array_equal(m0, [True, True, False])
+
+
+def test_leave_then_rejoin_same_id():
+    """rejoin_test (test/partisan_SUITE.erl:121-308 simple group): a node
+    that left re-joins under the SAME id — add-wins observed-remove
+    semantics of the state_orset (a fresh epoch outranks every observed
+    removal); a 2P tombstone set cannot do this."""
+    cfg = Config(n_nodes=4, periodic_interval=2, inbox_cap=16)
+    proto = FullMembership(cfg)
+    world = engine.init_world(cfg, proto)
+    world = peer_service.cluster(world, proto, [(i, 0) for i in range(1, 4)])
+    world = run_rounds(cfg, proto, world, 12)
+    same, mask = converged_membership(world, proto, cfg)
+    assert same and mask.all()
+    world = peer_service.leave(world, proto, 3)
+    world = run_rounds(cfg, proto, world, 10)
+    for i in range(3):
+        assert not bool(peer_service.members(world, proto, i)[3])
+    world = peer_service.join(world, proto, 3, 0)
+    world = run_rounds(cfg, proto, world, 14)
+    same, mask = converged_membership(world, proto, cfg)
+    assert same and mask.all(), "rejoin did not restore full membership"

@@ -294,6 +294,41 @@ class TestBitsetRolls:
             assert abs(dens - p) < max(0.02 * p, 5e-4), (p, dens)
 
 
+class TestRecvSideDelay:
+    def test_recv_interposition_delay_holds_not_drops(self):
+        """A recv-side interposition fun that bumps `delay` (the '$delay'
+        verb, pluggable :669-764) must RE-HOLD the message for later
+        rounds, not lose it: build_inbox's held output is discarded, so
+        the engine re-splits after the recv hook."""
+        import partisan_tpu as pt
+        from partisan_tpu import peer_service
+        from partisan_tpu.models.full_membership import FullMembership
+
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, periodic_interval=2)
+        proto = FullMembership(cfg)
+        gossip_t = proto.typ("gossip")
+
+        def delay_gossip_to_2(m, rnd):
+            hit = (m.typ == gossip_t) & (m.dst == 2) & (rnd < 6)
+            return m.replace(delay=jnp.where(hit, 5, m.delay))
+
+        world = pt.init_world(cfg, proto)
+        world = peer_service.cluster(world, proto,
+                                     [(i, 0) for i in range(1, 4)])
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_recv=delay_gossip_to_2)
+        for _ in range(4):
+            world, _ = step(world)
+        # all gossip TO node 2 was delayed: it knows only itself and the
+        # contact its own ctl_join added locally
+        assert int(np.asarray(
+            peer_service.members(world, proto, 2)).sum()) == 2
+        for _ in range(10):
+            world, _ = step(world)
+        # ...but the delayed messages ARRIVE later instead of vanishing
+        assert np.asarray(peer_service.members(world, proto, 2)).all()
+
+
 class TestNodeEmitCap:
     """cfg.node_emit_cap pre-compaction: identical trajectories when the
     per-node budget is not exceeded; counted drops when it is."""
