@@ -97,13 +97,18 @@ class Config:
     # knows its fan-out; only the shared routing cap lives here)
     inbox_cap: int = 16                # max messages a node processes per round
     node_emit_cap: Optional[int] = None
-    # ^ per-node emission budget per round: when set, each node's K x E
-    #   handler-emission slots are compacted to this many BEFORE the
-    #   global collect, so the flat-buffer sort handles N*node_emit_cap
-    #   candidates instead of N*K*E (SCAMP at N=1024 carries ~1.4M mostly
-    #   empty slots through that sort — the dominant engine cost there).
-    #   Per-node overflow is counted in the out_dropped metric, never
-    #   silent.  None = no pre-compaction.
+    # ^ per-node emission budget per round (handler + tick emissions
+    #   combined): when set, the engine collects emissions with a
+    #   RUNNING-OFFSET write into a fixed [N, C] region instead of
+    #   materializing the [N, K*E] worst-case buffer and argsorting it —
+    #   the dominant engine cost for wide-emit protocols (SCAMP at
+    #   N=1024 carried ~1.5M mostly-empty slots through that sort; the
+    #   offset collect moves ~N*C).  The carry buffer shrinks to
+    #   N*(C+4) as well (engine.default_out_cap).  Entry order per node
+    #   is slot-major with tick emissions last — identical to the
+    #   unbounded path, so per-connection FIFO semantics are unchanged;
+    #   per-node overflow is counted in out_dropped, never silent.
+    #   None = unbounded (exact worst-case shapes).
     deliver_gate: bool = True
     # ^ False removes the per-(slot, type) emptiness conds from the
     #   deliver loop: every handler runs full-batch every slot.  The

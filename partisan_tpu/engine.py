@@ -54,7 +54,24 @@ class World:
 
 def default_out_cap(cfg: Config, proto: "ProtocolBase") -> int:
     """Shared default for the flat in-flight buffer capacity (must agree
-    between init_world and make_step or the scan carry changes shape)."""
+    between init_world and make_step or the scan carry changes shape).
+
+    With ``node_emit_cap`` set, per-round emissions are bounded to C per
+    node at the source (the running-offset collect), so the carry only
+    needs N*C plus slack for held (delayed) traffic — orders of magnitude
+    below the worst-case K*E bound that the unbounded path must assume
+    (ROADMAP #1: at SCAMP's padded-view emit caps the worst-case buffer
+    was ~400k slots for ~1k live messages, and the per-round global
+    compact of it dominated the round)."""
+    if cfg.node_emit_cap is not None:
+        c = min(cfg.node_emit_cap,
+                cfg.inbox_cap * proto.emit_cap + proto.tick_emit_cap)
+        # held (delayed) traffic slack: with a configured transport delay
+        # of d rounds, steady-state in-flight is ~(1+d) rounds of
+        # emissions — without the factor every delayed message beyond 4
+        # slots/node would be compact-dropped each round
+        d = cfg.ingress_delay + cfg.egress_delay
+        return cfg.n_nodes * (c + 4) * (1 + d)
     return cfg.n_nodes * (cfg.inbox_cap * proto.emit_cap
                           + proto.tick_emit_cap) // 4
 
@@ -139,11 +156,17 @@ class ProtocolBase:
         return out
 
     def merge(self, *emits: Msgs, cap: Optional[int] = None) -> Msgs:
-        """Concatenate several emission buffers, compacting valid slots to the
-        front and truncating to cap (choose caps generously; engine counts any
-        flat-level drops)."""
+        """Concatenate several emission buffers into ``cap`` slots.  When
+        the parts already fit, this is a pure concat (+ padding) — no
+        per-node compaction sort, which matters because merge runs inside
+        vmap over N for every handler/tick invocation (sparse validity is
+        fine; the router ignores invalid slots).  Only an overflowing
+        merge pays the pack-and-truncate sort (choose caps generously;
+        the engine counts any flat-level drops)."""
         cap = cap or self.emit_cap
         cat = msgops.concat(*emits)
+        if cat.cap <= cap:
+            return msgops.pad_to(cat, cap)
         out, _ = msgops.compact(cat, cap)
         return out
 
@@ -200,92 +223,242 @@ def make_step(
             lambda b, a: jnp.where(
                 sel.reshape((N,) + (1,) * (b.ndim - 1)), b, a), new, old)
 
-    # sparse-delivery gather width (see Config.deliver_gather_cap)
-    G = cfg.deliver_gather_cap
-    if G is not None and G >= N:
-        G = None
+    # delivery gather-chunk width (see Config.deliver_gather_cap).
+    # None = gated-dense delivery: per-type full-batch applies with
+    # emptiness conds — the fastest shape at small N, where gathers cost
+    # more than they save.  Set = chunked-gather delivery for big N.
+    G = None if cfg.deliver_gather_cap is None \
+        else min(cfg.deliver_gather_cap, N)
+
+    # running-offset collect (active when cfg.node_emit_cap is set): per
+    # node, a [C]-slot output region written incrementally at a running
+    # position — replaces BOTH the [N, K*E] emission buffer and its
+    # per-node compaction argsort (ROADMAP #1).  Entry order per node is
+    # slot-major, exactly the order the stable per-node compact produced,
+    # so per-connection FIFO semantics are unchanged.
+    C = cfg.node_emit_cap
+
+    def outbuf_write(outbuf, pos, drops, em, width):
+        """Scatter em [N, width] into each node's running region of the
+        flat [N*C + 1] buffer (last slot = dump).  Returns
+        (outbuf, pos, drops) with overflow counted, never silent."""
+        v = em.valid
+        within = jnp.cumsum(v, axis=1) - v           # exclusive prefix
+        idx = pos[:, None] + within
+        ok = v & (idx < C)
+        flat_idx = jnp.where(
+            ok, node_col * C + jnp.clip(idx, 0, C - 1), N * C)
+        fi = flat_idx.reshape(-1)
+
+        def scat(b, e):
+            return b.at[fi].set(e.reshape((N * width,) + e.shape[2:]))
+
+        outbuf = jax.tree_util.tree_map(scat, outbuf, em)
+        # dropped/invalid entries all landed in the dump slot; its valid
+        # flag must end False no matter what was written last
+        outbuf = outbuf.replace(valid=outbuf.valid.at[N * C].set(False))
+        drops = drops + jnp.sum(v & ~ok).astype(jnp.int32)
+        return outbuf, pos + jnp.sum(v, axis=1).astype(jnp.int32), drops
+
+    def outbuf_write_rows(outbuf, pos, drops, idx, em):
+        """outbuf_write for a gathered row subset: em is [G, width] with
+        row g belonging to node idx[g] (idx == N = fill, dropped)."""
+        ic = jnp.minimum(idx, N - 1)
+        v = em.valid & (idx < N)[:, None]
+        within = jnp.cumsum(v, axis=1) - v
+        p = pos[ic][:, None] + within
+        ok = v & (p < C)
+        flat_idx = jnp.where(ok, ic[:, None] * C + jnp.clip(p, 0, C - 1),
+                             N * C)
+        fi = flat_idx.reshape(-1)
+        width = em.valid.shape[1]
+
+        def scat(b, e):
+            return b.at[fi].set(
+                e.reshape((idx.shape[0] * width,) + e.shape[2:]))
+
+        outbuf = jax.tree_util.tree_map(scat, outbuf, em)
+        outbuf = outbuf.replace(valid=outbuf.valid.at[N * C].set(False))
+        drops = drops + jnp.sum(v & ~ok).astype(jnp.int32)
+        pos = pos.at[idx].add(jnp.sum(v, axis=1).astype(jnp.int32),
+                              mode="drop")
+        return outbuf, pos, drops
+
+    node_col = jnp.arange(N, dtype=jnp.int32)[:, None]
 
     def deliver_batch(state, inbox, dkeys, node_ids):
-        """Process inbox slot k for every node, slot-sequentially (Erlang
-        mailbox order), but dispatch per TYPE with a global emptiness
-        gate: ``vmap(lax.switch)`` lowers to evaluate-every-branch, so the
-        naive form pays K x (all handlers) per round; hoisting the slot
-        loop out of vmap lets ``lax.cond`` genuinely skip the (slot, type)
-        pairs that carry no messages — in steady state nearly all of them.
-        Per (node, slot) there is ONE message, so applying present types
-        one after another touches disjoint node rows and preserves the
-        per-node sequential semantics exactly.
+        """Process inbox slots slot-sequentially (Erlang mailbox order).
+        Per (node, slot) there is ONE message and handlers write only
+        their own row, so within a slot the receiving rows are disjoint
+        and one batched application preserves the per-node sequential
+        semantics exactly.
 
-        With ``cfg.deliver_gather_cap = G`` a third, cheaper path handles
-        the common case of 1..G receivers: gather just those node rows
-        (``jnp.nonzero(size=G)``), run the handler over G rows, scatter
-        back with out-of-bounds fill indices dropped.  Handlers receive
-        identical per-node keys on every path, so results are the same."""
-        embuf = jax.tree_util.tree_map(
-            lambda x: jnp.zeros((N, K * E) + x.shape[1:], x.dtype),
-            msgops.empty(1, proto.data_spec))
+        Gated mode (default): inboxes are front-filled per node, so only
+        the occupied slot prefix runs (outer while_loop); within a slot,
+        the receiving rows are gathered in chunks of G
+        (cfg.deliver_gather_cap) and each row dispatches its own handler
+        via ONE ``vmap(lax.switch)``.  Evaluate-every-branch semantics
+        cost n_types x G row-evals — tiny — while keeping exactly one
+        instance of each handler in the program; the earlier per-type
+        dense/sparse machinery multiplied program size by ~2 x n_types,
+        which dominated CPU runtime overhead and TPU compile time
+        (scripts/profile_engine.py).
 
-        def slot_body(k, carry):
-            state, embuf = carry
-            mk = jax.tree_util.tree_map(lambda x: x[:, k], inbox)
-            kkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(
-                dkeys, 1000 + k)
-            em_slot = msgops.empty(1, proto.data_spec)
-            em_slot = jax.tree_util.tree_map(
-                lambda x: jnp.zeros((N, E) + x.shape[1:], x.dtype), em_slot)
+        Ungated mode (deliver_gate=False): a flat fori/per-type dense
+        pipeline with NO data-dependent control flow — the big-N TPU
+        compile escape hatch.  Handlers receive identical per-node keys
+        on every path, so trajectories agree bit-for-bit."""
+        if C is not None:
+            embuf = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((N * C + 1,) + x.shape[1:], x.dtype),
+                msgops.empty(1, proto.data_spec))
+            carry0 = (state, embuf, jnp.zeros((N,), jnp.int32),
+                      jnp.int32(0))
+        else:
+            embuf = jax.tree_util.tree_map(
+                lambda x: jnp.zeros((N, K * E) + x.shape[1:], x.dtype),
+                msgops.empty(1, proto.data_spec))
+            carry0 = (state, embuf)
 
-            for t, h in enumerate(handlers):
-                sel = mk.valid & (mk.typ == t)
+        # normalize narrower emissions (e.g. a cap=1 reply) to the full
+        # emit width — see msgops.pad_to
+        def mk_branch(h):
+            def b(op):
+                i, r, m, hk = op
+                r2, em = h(cfg, i, r, m, hk)
+                return r2, msgops.pad_to(em, E)
+            return b
+        branches = tuple(mk_branch(h) for h in handlers)
 
-                # normalize narrower emissions (e.g. a cap=1 reply) to the
-                # full emit width — see msgops.pad_to
-                def call(i, r, m, hk, h=h):
-                    r2, em = h(cfg, i, r, m, hk)
-                    return r2, msgops.pad_to(em, E)
+        def apply_row(i, r, m, hk):
+            t = jnp.clip(m.typ, 0, len(branches) - 1)
+            return jax.lax.switch(t, branches, (i, r, m, hk))
 
-                def dense(op, call=call, sel=sel):
-                    state, em_slot = op
-                    st2, em2 = jax.vmap(call)(node_ids, state, mk, kkeys)
-                    state = _sel_where(sel, st2, state)
-                    em_slot = _sel_where(sel, em2, em_slot)
-                    return state, em_slot
+        def fresh_em_slot():
+            return jax.tree_util.tree_map(
+                lambda x: jnp.zeros((N, E) + x.shape[1:], x.dtype),
+                msgops.empty(1, proto.data_spec))
 
-                if not cfg.deliver_gate:
-                    state, em_slot = dense((state, em_slot))
-                    continue
-
-                if G is None:
-                    state, em_slot = jax.lax.cond(
-                        jnp.any(sel), dense, lambda op: op, (state, em_slot))
-                    continue
-
-                def sparse(op, call=call, sel=sel):
-                    state, em_slot = op
-                    # fill slots index N: clipped for the gather, dropped
-                    # (mode="drop") on the scatter back
-                    idx, = jnp.nonzero(sel, size=G, fill_value=N)
-                    ic = jnp.minimum(idx, N - 1).astype(jnp.int32)
-                    take = lambda x: x[ic]
-                    st2, em2 = jax.vmap(call)(
-                        ic, jax.tree_util.tree_map(take, state),
-                        jax.tree_util.tree_map(take, mk), kkeys[ic])
-                    put = lambda s, v: s.at[idx].set(v, mode="drop")
-                    state = jax.tree_util.tree_map(put, state, st2)
-                    em_slot = jax.tree_util.tree_map(put, em_slot, em2)
-                    return state, em_slot
-
-                cnt = jnp.sum(sel)
-                branch = (cnt > 0).astype(jnp.int32) \
-                    + (cnt > G).astype(jnp.int32)
-                state, em_slot = jax.lax.switch(
-                    branch, [lambda op: op, sparse, dense], (state, em_slot))
-
+        def store_em_slot(carry, em_slot, k):
+            """Fold one slot's [N, E] emissions into the output carry."""
+            if C is not None:
+                embuf, pos, drops = outbuf_write(
+                    carry[1], carry[2], carry[3], em_slot, E)
+                return (carry[0], embuf, pos, drops)
             embuf = jax.tree_util.tree_map(
                 lambda b, e: jax.lax.dynamic_update_slice_in_dim(
-                    b, e, k * E, 1), embuf, em_slot)
-            return state, embuf
+                    b, e, k * E, 1), carry[1], em_slot)
+            return (carry[0], embuf)
 
-        return jax.lax.fori_loop(0, K, slot_body, (state, embuf))
+        def process_slot(k, mk, carry):
+            """Gated delivery of slot k: gather the rows that hold a
+            message, run each row's handler, scatter back; loop in
+            chunks of G until the slot is drained (one chunk suffices
+            except under burst fan-in)."""
+            kkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(
+                dkeys, 1000 + k)
+            # a typ outside the handler table is ignored-but-counted
+            # (the `unhandled` metric), like the reference's unhandled-
+            # message log sites — excluded from dispatch
+            sel0 = mk.valid & (mk.typ >= 0) & (mk.typ < n_types)
+
+            def chunk_cond(c):
+                return jnp.any(c[0])
+
+            def chunk_body(c):
+                pending, carry = c[0], c[1:]
+                state = carry[0]
+                idx, = jnp.nonzero(pending, size=G, fill_value=N)
+                ic = jnp.minimum(idx, N - 1).astype(jnp.int32)
+                take = lambda x: x[ic]
+                st2, em2 = jax.vmap(apply_row)(
+                    ic, jax.tree_util.tree_map(take, state),
+                    jax.tree_util.tree_map(take, mk), kkeys[ic])
+                # fill rows (idx == N) are dropped on every write-back
+                put = lambda s, v: s.at[idx].set(v, mode="drop")
+                state = jax.tree_util.tree_map(put, state, st2)
+                pending = pending.at[idx].set(False, mode="drop")
+                if C is not None:
+                    embuf, pos, drops = outbuf_write_rows(
+                        embuf_of(carry), carry[2], carry[3], idx, em2)
+                    return pending, state, embuf, pos, drops
+                # dense carry: scatter this chunk's emissions into the
+                # slot's [N, E] stripe of the [N, K*E] buffer
+                stripe = jax.tree_util.tree_map(
+                    lambda x: jax.lax.dynamic_slice_in_dim(
+                        x, k * E, E, 1), carry[1])
+                stripe = jax.tree_util.tree_map(put, stripe, em2)
+                embuf = jax.tree_util.tree_map(
+                    lambda b, e: jax.lax.dynamic_update_slice_in_dim(
+                        b, e, k * E, 1), carry[1], stripe)
+                return pending, state, embuf
+
+            embuf_of = lambda carry: carry[1]
+            out = jax.lax.while_loop(chunk_cond, chunk_body,
+                                     (sel0,) + tuple(carry))
+            return out[1:]
+
+        def dense_slot(k, mk, carry, gate_types=False):
+            """Per-type full-batch delivery of slot k with masked selects.
+            ``gate_types=True`` (gated-dense mode) wraps each type in an
+            emptiness cond so absent types are skipped; False keeps the
+            code straight-line (the ungated big-N TPU escape hatch)."""
+            state = carry[0]
+            kkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(
+                dkeys, 1000 + k)
+            em_slot = fresh_em_slot()
+            for t in range(n_types):
+                sel = mk.valid & (mk.typ == t)
+
+                def apply_t(op, t=t, sel=sel):
+                    state, em_slot = op
+                    st2, em2 = jax.vmap(
+                        lambda i, r, m, hk: branches[t]((i, r, m, hk))
+                    )(node_ids, state, mk, kkeys)
+                    return (_sel_where(sel, st2, state),
+                            _sel_where(sel, em2, em_slot))
+
+                if gate_types:
+                    state, em_slot = jax.lax.cond(
+                        jnp.any(sel), apply_t, lambda op: op,
+                        (state, em_slot))
+                else:
+                    state, em_slot = apply_t((state, em_slot))
+            return store_em_slot((state,) + tuple(carry[1:]), em_slot, k)
+
+        if not cfg.deliver_gate:
+            def fori_body(k, carry):
+                mk = jax.tree_util.tree_map(lambda x: x[:, k], inbox)
+                return dense_slot(k, mk, carry)
+            return jax.lax.fori_loop(0, K, fori_body, carry0)
+
+        # gated mode: inboxes are front-filled per node (build_inbox
+        # writes rank order), so slot k is entirely empty for every node
+        # once k >= the max per-node message count.  In chunked-gather
+        # mode (big N) bounding the loop to that occupied prefix pays;
+        # in gated-dense mode (small N) the DYNAMIC bound itself costs
+        # more than the skipped slots (measured 2x at N=64 — XLA keeps a
+        # static-trip loop much tighter), so the bound stays static and
+        # the per-type emptiness conds do the skipping.
+        if G is not None:
+            n_occ = jnp.max(jnp.sum(inbox.valid, axis=1)).astype(jnp.int32)
+        else:
+            n_occ = jnp.int32(K)
+
+        def w_cond(c):
+            return c[0] < n_occ
+
+        def w_body(c):
+            k = c[0]
+            mk = jax.tree_util.tree_map(lambda x: x[:, k], inbox)
+            if G is None:
+                return (k + 1,) + tuple(
+                    dense_slot(k, mk, c[1:], gate_types=True))
+            return (k + 1,) + tuple(process_slot(k, mk, c[1:]))
+
+        out = jax.lax.while_loop(w_cond, w_body,
+                                 (jnp.int32(0),) + tuple(carry0))
+        return out[1:]
 
     def step(world: World) -> Tuple[World, Dict[str, jax.Array]]:
         state, msgs, rnd = world.state, world.msgs, world.rnd
@@ -340,7 +513,8 @@ def make_step(
 
         # -- deliver (per-node sequential, batched over N, type-gated)
         dkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 1)
-        state, demits = deliver_batch(state, inbox, dkeys, node_ids)
+        delivered = deliver_batch(state, inbox, dkeys, node_ids)
+        state = delivered[0]
 
         # -- tick (timer phase); emissions normalized like handler ones
         tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 2)
@@ -350,7 +524,7 @@ def make_step(
             return r2, msgops.pad_to(em, T)
         state, temits = jax.vmap(tick, in_axes=(0, 0, 0))(node_ids, state, tkeys)
 
-        # -- collect: flatten [N, K*E] and [N, T] emissions, stamp src ids
+        # -- collect: stamp src ids and merge with held traffic
         def flat(em: Msgs, per: int) -> Msgs:
             out = jax.tree_util.tree_map(
                 lambda x: x.reshape((N * per,) + x.shape[2:]), em)
@@ -358,19 +532,21 @@ def make_step(
             return out.replace(src=src,
                                born=jnp.full((N * per,), rnd, jnp.int32))
 
-        # optional per-node pre-compaction: rows stay grouped by node (a
-        # stable per-row sort), so src stamping by position still holds
-        # and per-connection FIFO order is unchanged
-        node_dropped = jnp.int32(0)
-        if cfg.node_emit_cap is not None and cfg.node_emit_cap < K * E:
-            demits, per_node_drops = jax.vmap(
-                lambda m: msgops.compact(m, cfg.node_emit_cap))(demits)
-            node_dropped = jnp.sum(per_node_drops).astype(jnp.int32)
-            d_per = cfg.node_emit_cap
+        if C is not None:
+            # running-offset collect: tick emissions append to each
+            # node's region (slot-major, demits first — the same
+            # within-node order the flatten path produces, so
+            # per-connection FIFO is unchanged); the flat [N*C] buffer
+            # needs no compaction at all
+            _, outbuf, pos, drops0 = delivered
+            outbuf, pos, node_dropped = outbuf_write(
+                outbuf, pos, drops0, temits, T)
+            new = jax.tree_util.tree_map(lambda x: x[: N * C], outbuf)
+            new = new.replace(src=jnp.repeat(node_ids, C),
+                              born=jnp.full((N * C,), rnd, jnp.int32))
         else:
-            d_per = K * E
-
-        new = msgops.concat(flat(demits, d_per), flat(temits, T))
+            node_dropped = jnp.int32(0)
+            new = msgops.concat(flat(delivered[1], K * E), flat(temits, T))
         alive_src = world.alive[jnp.clip(new.src, 0, N - 1)]
         new = new.replace(valid=new.valid & alive_src)
         # transport delays (ingress_delay + egress_delay, Config): extra

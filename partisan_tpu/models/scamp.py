@@ -84,7 +84,11 @@ class ScampV1(ProtocolBase):
         }
         # join fans to the whole partial view + c extra copies + 1 to contact
         self.emit_cap = self.P + cfg.scamp_c + 1
-        self.tick_emit_cap = self.P + 1  # pings to all + isolation resub
+        # pings to the whole view + the (rare) isolation re-subscription
+        # fan: sized so the tick merge is a pure concat — a compacting
+        # merge would run an argsort per node per ROUND (the dominant
+        # steady-state cost at N=1024, scripts/profile_engine.py)
+        self.tick_emit_cap = self.P + 1 + self.emit_cap
 
     # ------------------------------------------------------------------ state
 
@@ -238,7 +242,7 @@ class ScampV1(ProtocolBase):
         stay = ~row.left
         due = (((rnd + me) % cfg.periodic_interval) == 0) & stay
         pings = self.emit(jnp.where(due, row.partial, -1), self.typ("ping"),
-                          cap=self.tick_emit_cap, subject=rnd)
+                          cap=self.P, subject=rnd)
         silence = rnd - row.last_msg_rnd
         isolated = due & (silence > cfg.periodic_interval
                           * cfg.scamp_message_window)

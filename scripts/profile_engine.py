@@ -1,18 +1,20 @@
 """Differential profiling for the generic engine (ROADMAP #1/#3).
 
-Times one steady-state round of a protocol under ablations that isolate
+Times steady-state rounds of a protocol under ablations that isolate
 each engine phase, so the dominant cost is located by subtraction rather
-than guessed:
+than guessed.  Variants (see `variants` in main): default, out_cap/16,
+null_handlers (framework minus protocol), inbox_K=8, node_emit_cap=8
+(running-offset collect), gather_G=32 (chunked delivery),
+node_cap+gather, ncap+gath+cap/16, null+ncap+gather, ncap32+gather.
 
-  default       the full step as configured
-  inbox_K/4     deliver loop scaled down (K x types gating cost)
-  null_handlers handlers return (row, no_emit) — framework minus protocol
-  node_cap      per-node emission pre-compaction before the global sort
-  gather_G      sparse delivery gather
-  out_cap/4     the global compact + route sort at a smaller carry
+Each variant builds its OWN steady state (carry shape depends on the
+config) and syncs with SCALAR READBACKS — block_until_ready does not
+reliably block on this box (see the tpu-tunnel-measurement notes; also:
+run under jax.config.update("jax_platforms", "cpu") if you want CPU —
+the env var alone is ignored by the image's TPU plugin).
 
 Usage: python scripts/profile_engine.py [--proto scamp_v2|hyparview]
-       [--n 1024] [--rounds 20] [--warm 40]
+       [--n 1024] [--rounds 10] [--warm 30] [--only SUBSTR]
 """
 
 from __future__ import annotations
@@ -57,18 +59,41 @@ def null_wrap(proto):
     return n
 
 
-def timed(cfg, proto, world, rounds, label, out_cap=None):
+def timed(cfg, proto_name, warm, rounds, label, out_cap=None,
+          null_handlers=False):
+    """Build the variant's OWN steady state (worlds are not portable
+    across configs: out_cap is part of the carry shape) and time with a
+    sync every round (async dispatch otherwise hides per-round cost)."""
+    proto = build(cfg, proto_name)
+    if null_handlers:
+        proto = null_wrap(proto)
+    world = init_world(cfg, proto, out_cap=out_cap)
+    world = peer_service.cluster(
+        world, proto, [(i, 0) for i in range(1, cfg.n_nodes)], stagger=8)
     step = make_step(cfg, proto, donate=False, out_cap=out_cap)
-    w, m = step(world)                      # compile
-    jax.block_until_ready(m)
     t0 = time.perf_counter()
-    w = world
+    m = None
+    for _ in range(warm):
+        world, m = step(world)
+    if m is None:
+        world, m = step(world)
+    int(m["delivered"])
+    warm_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
     for _ in range(rounds):
-        w, m = step(w)
-    jax.block_until_ready(m)
+        world, m = step(world)
+        # block_until_ready can return before execution completes under
+        # this runtime (memory: tpu-tunnel-measurement); only a scalar
+        # READBACK reliably syncs.  Read one late output (the compacted
+        # carry) plus a state leaf.
+        int(world.msgs.valid.sum())
+        int(jax.tree_util.tree_leaves(world.state)[0].sum())
     dt = (time.perf_counter() - t0) / rounds
-    print(f"{label:24s} {dt * 1e3:9.1f} ms/round   "
-          f"({1 / dt:7.1f} rounds/s)")
+    print(f"{label:24s} {dt * 1e3:9.1f} ms/round  ({1 / dt:7.1f} r/s)  "
+          f"[warm+compile {warm_dt:.0f}s, "
+          f"inflight {int(world.msgs.count())}, "
+          f"delivered/rnd {int(m['delivered'])}, "
+          f"dropped {int(m['out_dropped'])}]")
     return dt
 
 
@@ -76,8 +101,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--proto", default="scamp_v2")
     ap.add_argument("--n", type=int, default=1024)
-    ap.add_argument("--rounds", type=int, default=20)
-    ap.add_argument("--warm", type=int, default=40)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--warm", type=int, default=30)
+    ap.add_argument("--only", default=None)
     args = ap.parse_args()
 
     def mkcfg(**kw):
@@ -87,40 +113,34 @@ def main():
 
     cfg = mkcfg()
     proto = build(cfg, args.proto)
-    world = init_world(cfg, proto)
-    world = peer_service.cluster(
-        world, proto, [(i, 0) for i in range(1, args.n)], stagger=8)
-    warm_step = make_step(cfg, proto, donate=False)
-    for _ in range(args.warm):
-        world, _ = warm_step(world)         # steady-state world
-    jax.block_until_ready(world.msgs.valid)
     print(f"proto={args.proto} N={args.n} "
           f"out_cap={default_out_cap(cfg, proto)} "
           f"K={cfg.inbox_cap} E={proto.emit_cap} T={proto.tick_emit_cap} "
-          f"types={len(proto.msg_types)} "
-          f"inflight={int(world.msgs.count())}")
+          f"types={len(proto.msg_types)}")
 
-    timed(cfg, proto, world, args.rounds, "default")
-    timed(cfg, proto, world, args.rounds, "out_cap/4",
-          out_cap=default_out_cap(cfg, proto) // 4)
-    timed(cfg, null_wrap(proto), world, args.rounds, "null_handlers")
-
-    cfg4 = mkcfg(inbox_cap=4)
-    p4 = build(cfg4, args.proto)
-    w4 = jax.tree_util.tree_map(lambda x: x, world)
-    timed(cfg4, p4, w4, args.rounds, "inbox_K=4")
-
-    cfgn = mkcfg(node_emit_cap=8)
-    timed(cfgn, build(cfgn, args.proto), world, args.rounds,
-          "node_emit_cap=8")
-
-    cfgg = mkcfg(deliver_gather_cap=32)
-    timed(cfgg, build(cfgg, args.proto), world, args.rounds,
-          "gather_G=32")
-
-    cfgng = mkcfg(node_emit_cap=8, deliver_gather_cap=32)
-    timed(cfgng, build(cfgng, args.proto), world, args.rounds,
-          "node_cap+gather")
+    variants = [
+        ("default", {}, {}),
+        ("out_cap/16", {}, {"out_cap": default_out_cap(cfg, proto) // 16}),
+        ("null_handlers", {}, {"null_handlers": True}),
+        ("inbox_K=8", {"inbox_cap": 8}, {}),
+        ("node_emit_cap=8", {"node_emit_cap": 8}, {}),
+        ("gather_G=32", {"deliver_gather_cap": 32}, {}),
+        ("node_cap+gather", {"node_emit_cap": 8,
+                             "deliver_gather_cap": 32}, {}),
+        ("ncap+gath+cap/16", {"node_emit_cap": 8,
+                              "deliver_gather_cap": 32},
+         {"out_cap": default_out_cap(cfg, proto) // 16}),
+        ("null+ncap+gather", {"node_emit_cap": 8,
+                              "deliver_gather_cap": 32},
+         {"null_handlers": True}),
+        ("ncap32+gather", {"node_emit_cap": 32,
+                           "deliver_gather_cap": 32}, {}),
+    ]
+    for label, cfg_kw, t_kw in variants:
+        if args.only and args.only not in label:
+            continue
+        timed(mkcfg(**cfg_kw), args.proto, args.warm, args.rounds,
+              label, **t_kw)
 
 
 if __name__ == "__main__":

@@ -339,9 +339,12 @@ class TestNodeEmitCap:
         from partisan_tpu.models.full_membership import FullMembership
 
         worlds = {}
-        for cap in (None, 64):
+        # cap-only, and cap COMBINED with chunked-gather delivery (the
+        # benchmark configuration: process_slot -> outbuf_write_rows)
+        for label, cap, g in (("off", None, None), ("cap", 64, None),
+                              ("cap+gather", 64, 4)):
             cfg = pt.Config(n_nodes=8, inbox_cap=8, periodic_interval=3,
-                            node_emit_cap=cap)
+                            node_emit_cap=cap, deliver_gather_cap=g)
             proto = FullMembership(cfg)
             world = pt.init_world(cfg, proto)
             world = peer_service.cluster(
@@ -350,10 +353,14 @@ class TestNodeEmitCap:
             for _ in range(12):
                 world, m = step(world)
             assert int(m["out_dropped"]) == 0
-            worlds[cap] = world
-        for la, lb in zip(jax.tree_util.tree_leaves(worlds[None].state),
-                          jax.tree_util.tree_leaves(worlds[64].state)):
-            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+            worlds[label] = world
+        for label in ("cap", "cap+gather"):
+            for la, lb in zip(
+                    jax.tree_util.tree_leaves(worlds["off"].state),
+                    jax.tree_util.tree_leaves(worlds[label].state)):
+                np.testing.assert_array_equal(np.asarray(la),
+                                              np.asarray(lb),
+                                              err_msg=label)
 
     def test_overflow_counted(self):
         import partisan_tpu as pt
