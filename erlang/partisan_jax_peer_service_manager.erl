@@ -177,17 +177,30 @@ receive_message(_Peer, Message) ->
 decode(State) ->
     State.
 
-reserve(_Tag) ->
-    {error, no_available_slots}.
+%% Tags are atoms in the reference; the port speaks integer ids, so the
+%% tag rides as its hash (stable within a run — tags are compared, never
+%% inverted).
+reserve(Tag) ->
+    call({reserve, my_id(), erlang:phash2(Tag)}).
 
 partitions() ->
-    {error, not_implemented}.
+    case call({hv_partitions, my_id()}) of
+        {ok, Pairs} ->
+            {ok, [{Ref, id_to_node(Peer)} || {Ref, Peer} <- Pairs]};
+        Error -> Error
+    end.
 
-inject_partition(_Origin, _TTL) ->
-    {error, not_implemented}.
+%% inject_partition/2 starts the TTL flood from this vnode and returns
+%% the reference used to resolve it (hyparview :244-254).
+inject_partition(_Origin, TTL) ->
+    Ref = erlang:unique_integer([positive]),
+    case call({hv_inject_partition, my_id(), Ref, TTL}) of
+        ok -> {ok, Ref};
+        Error -> Error
+    end.
 
-resolve_partition(_Reference) ->
-    {error, not_implemented}.
+resolve_partition(Reference) ->
+    call({hv_resolve_partition, my_id(), Reference}).
 
 send_message(Name, Message) ->
     forward_message(Name, undefined, Message).
@@ -207,9 +220,14 @@ init([]) ->
                      {spawn_executable, os:find_executable(Python)},
                      [{args, ["-m", "partisan_tpu.bridge.port_server"]},
                       {packet, 4}, binary, exit_status]),
+            Extra = case Manager of
+                        hyparview -> [{reservable, true}];
+                        _ -> []
+                    end,
             ok = command(Port, {start, Manager,
                                 [{n_nodes, NNodes},
-                                 {payload_words, ?PAYLOAD_WORDS}]}),
+                                 {payload_words, ?PAYLOAD_WORDS}
+                                 | Extra]}),
             erlang:send_after(?ROUND_INTERVAL, self(), advance),
             {ok, #state{port=Port, owner=true, myid=MyId, n_nodes=NNodes,
                         manager=Manager, membership=[MyId],
@@ -283,6 +301,20 @@ handle_call({update_members, Id, Members}, _From,
     [ok = command(Port, {join, I, Id}) || I <- Missing],
     [ok = command(Port, {leave, I}) || I <- Extra],
     {reply, ok, State};
+
+handle_call({reserve, Id, Tag}, _From, #state{port=Port}=State) ->
+    {reply, command(Port, {reserve, Id, Tag}), State};
+
+handle_call({hv_partitions, Id}, _From, #state{port=Port}=State) ->
+    {reply, command(Port, {hv_partitions, Id}), State};
+
+handle_call({hv_inject_partition, Id, Ref, TTL}, _From,
+            #state{port=Port}=State) ->
+    {reply, command(Port, {hv_inject_partition, Id, Ref, TTL}), State};
+
+handle_call({hv_resolve_partition, Id, Ref}, _From,
+            #state{port=Port}=State) ->
+    {reply, command(Port, {hv_resolve_partition, Id, Ref}), State};
 
 handle_call({on_up, Name, Fun}, _From, #state{up_funs=Fs}=State) ->
     {reply, ok, State#state{up_funs=[{Name, Fun} | Fs]}};

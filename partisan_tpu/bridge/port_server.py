@@ -58,20 +58,20 @@ from . import etf
 from .etf import Atom
 
 _MANAGERS = {
-    "hyparview": lambda cfg: _mk("hyparview", cfg),
-    "full": lambda cfg: _mk("full", cfg),
-    "scamp_v1": lambda cfg: _mk("scamp_v1", cfg),
-    "scamp_v2": lambda cfg: _mk("scamp_v2", cfg),
-    "static": lambda cfg: _mk("static", cfg),
-    "client_server": lambda cfg: _mk("client_server", cfg),
+    "hyparview": lambda cfg, **kw: _mk("hyparview", cfg, **kw),
+    "full": lambda cfg, **kw: _mk("full", cfg),
+    "scamp_v1": lambda cfg, **kw: _mk("scamp_v1", cfg),
+    "scamp_v2": lambda cfg, **kw: _mk("scamp_v2", cfg),
+    "static": lambda cfg, **kw: _mk("static", cfg),
+    "client_server": lambda cfg, **kw: _mk("client_server", cfg),
 }
 
 
-def _mk(name: str, cfg: Config):
+def _mk(name: str, cfg: Config, **kw):
     # local imports keep server start cheap before `start` arrives
     if name == "hyparview":
         from ..models.hyparview import HyParView
-        return HyParView(cfg)
+        return HyParView(cfg, **kw)
     if name == "full":
         from ..models.full_membership import FullMembership
         return FullMembership(cfg)
@@ -112,10 +112,20 @@ class Session:
         bridge = {k: overrides.pop(k) for k in
                   ("data_plane", "payload_words", "store_cap", "ring_cap")
                   if k in overrides}
+        # hyparview reservation props: {reservable, true} enables the
+        # per-tag reserved-slot machinery; {tags, [T0, T1, ...]} is the
+        # node-tag table (-1 untagged) joiners carry
+        mgr_kw = {}
+        if overrides.pop("reservable", False):
+            mgr_kw["reservable"] = True
+        if "tags" in overrides:
+            mgr_kw["tags"] = [int(t) for t in overrides.pop("tags")]
         self.cfg = from_mapping(overrides)
         if str(manager) not in _MANAGERS:
             return (Atom("error"), Atom("unknown_manager"))
-        self.proto = _MANAGERS[str(manager)](self.cfg)
+        if mgr_kw and str(manager) != "hyparview":
+            return (Atom("error"), Atom("reservation_needs_hyparview"))
+        self.proto = _MANAGERS[str(manager)](self.cfg, **mgr_kw)
         if bridge.get("data_plane", True):
             from ..models.dataplane import DataPlane
             from ..models.stack import Stacked
@@ -231,6 +241,71 @@ class Session:
     def cmd_resolve_partition(self) -> Any:
         self.world = faults.resolve_partition(self.world)
         return Atom("ok")
+
+    # -------------------------- HyParView-protocol partition + reserve
+    # (the node-visible surface: inject/resolve TTL floods + partitions
+    # query, reference hyparview :244-254, 1731-1797; reserve/1 :398-411.
+    # cmd_partition above is the judge's-eye world mask — different tool.)
+
+    def _hyparview(self):
+        """(hv_proto, hv_state_subtree, attr_path from world.state)."""
+        from ..models.hyparview import HyParView
+        proto, sub, path = self.proto, self.world.state, []
+        while not isinstance(proto, HyParView):
+            nxt = getattr(proto, "lower", None)
+            if nxt is None:
+                raise ValueError("manager is not hyparview")
+            proto, sub, path = nxt, sub.lower, path + ["lower"]
+        return proto, sub, path
+
+    def _replace_sub(self, path, new_sub) -> None:
+        def rec(node, i):
+            if i == len(path):
+                return new_sub
+            child = getattr(node, path[i])
+            return node.replace(**{path[i]: rec(child, i + 1)})
+        self.world = self.world.replace(state=rec(self.world.state, 0))
+
+    def cmd_reserve(self, node: int, tag: int) -> Any:
+        """reserve/1 — SYNCHRONOUS like the reference's gen_server call
+        (:398-411): mutates the reservation table directly (a host-side
+        verb, like crash/partition) and reports
+        {error, no_available_slots} on overflow instead of silently
+        counting."""
+        import numpy as np
+        hv, sub, path = self._hyparview()
+        if not hv.reservable:
+            return (Atom("error"), Atom("not_reservable"))
+        node, tag = int(node), int(tag)
+        row = np.asarray(sub.rsv_tag[node])
+        if tag in row:
+            return Atom("ok")
+        free = np.flatnonzero(row < 0)
+        if free.size == 0:
+            return (Atom("error"), Atom("no_available_slots"))
+        self._replace_sub(path, sub.replace(
+            rsv_tag=sub.rsv_tag.at[node, int(free[0])].set(tag)))
+        return Atom("ok")
+
+    def cmd_hv_inject_partition(self, node: int, ref: int, ttl: int) -> Any:
+        from ..peer_service import send_ctl
+        self._hyparview()
+        self.world = send_ctl(self.world, self.proto, int(node),
+                              "ctl_part_inject", pref=int(ref),
+                              ttl=int(ttl))
+        return Atom("ok")
+
+    def cmd_hv_resolve_partition(self, node: int, ref: int) -> Any:
+        from ..peer_service import send_ctl
+        self._hyparview()
+        self.world = send_ctl(self.world, self.proto, int(node),
+                              "ctl_part_resolve", pref=int(ref))
+        return Atom("ok")
+
+    def cmd_hv_partitions(self, node: int) -> Any:
+        hv, sub, _ = self._hyparview()
+        return (Atom("ok"),
+                [tuple(p) for p in hv.partitions(sub, int(node))])
 
     def cmd_checkpoint(self, path) -> Any:
         ckpt.save(_as_str(path), self.cfg, self.world)

@@ -118,6 +118,13 @@ class ProtocolBase:
     def tick(self, cfg, node_id, row, rnd, key):
         return row, self.no_emit(self.tick_emit_cap)
 
+    def health_counters(self, state) -> Dict[str, jax.Array]:
+        """Protocol-owned degradation counters (slot-collision overwrites,
+        table overflows, probe stalls ...) merged into
+        metrics.world_health — every fidelity-losing approximation must
+        count its losses (SURVEY §7.3: never silent)."""
+        return {}
+
     # --- emission helpers (used inside handlers) ---------------------------
 
     def no_emit(self, cap: Optional[int] = None) -> Msgs:
@@ -224,10 +231,12 @@ def make_step(
                 sel.reshape((N,) + (1,) * (b.ndim - 1)), b, a), new, old)
 
     # delivery gather-chunk width (see Config.deliver_gather_cap).
-    # None = gated-dense delivery: per-type full-batch applies with
-    # emptiness conds — the fastest shape at small N, where gathers cost
-    # more than they save.  Set = chunked-gather delivery for big N.
-    G = None if cfg.deliver_gather_cap is None \
+    # None (or 0 = explicitly disabled) = gated-dense delivery: per-type
+    # full-batch applies with emptiness conds — the fastest shape at
+    # small N, where gathers cost more than they save.  Set = chunked-
+    # gather delivery for big N.  (G=0 must NOT reach the chunk loop:
+    # a zero-width gather makes no progress and the while_loop spins.)
+    G = None if not cfg.deliver_gather_cap \
         else min(cfg.deliver_gather_cap, N)
 
     # running-offset collect (active when cfg.node_emit_cap is set): per
@@ -235,8 +244,13 @@ def make_step(
     # position — replaces BOTH the [N, K*E] emission buffer and its
     # per-node compaction argsort (ROADMAP #1).  Entry order per node is
     # slot-major, exactly the order the stable per-node compact produced,
-    # so per-connection FIFO semantics are unchanged.
+    # so per-connection FIFO semantics are unchanged.  Clamped to the
+    # true per-node emission maximum (matching default_out_cap) so an
+    # over-generous cap can only shrink work, never inflate the buffer
+    # past the dense worst case.
     C = cfg.node_emit_cap
+    if C is not None:
+        C = min(C, K * E + T)
 
     def outbuf_write(outbuf, pos, drops, em, width):
         """Scatter em [N, width] into each node's running region of the

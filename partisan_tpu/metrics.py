@@ -66,6 +66,8 @@ def world_health(world: World, proto: ProtocolBase) -> Dict[str, jax.Array]:
         "inflight": world.msgs.count(),
         "convergence": convergence(masks, world.alive),
     }
+    for k, v in proto.health_counters(world.state).items():
+        out[k] = jnp.asarray(v).astype(jnp.int32)
     st = world.state
     views = None
     while views is None and st is not None:

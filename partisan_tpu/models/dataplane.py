@@ -190,6 +190,9 @@ class DataPlane(UpperProtocol):
                        clock=up.out_seq, partition_key=up.out_pk)
         return row, em
 
+    def health_counters(self, state: DataRow):
+        return {"fwd_send_dropped": jnp.sum(state.send_dropped)}
+
     # ---------------------------------------------------------- host surface
 
     def pad_payload(self, payload) -> np.ndarray:

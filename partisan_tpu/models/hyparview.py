@@ -53,6 +53,7 @@ from .. import prng
 HIGH, LOW = 1, 0
 _DC_SLOTS = 16      # direct-mapped disconnect-id map size (peer % slots)
 _EPOCH_SHIFT = 12   # disconnect id = epoch << 12 | counter
+_PART_SLOTS = 16    # per-node partition table capacity (overflow counted)
 
 
 @struct.dataclass
@@ -68,6 +69,17 @@ class HvState:
     sent_dc_id: jax.Array    # [N, D] with which id (map values)
     recv_dc_peer: jax.Array  # [N, D]
     recv_dc_id: jax.Array    # [N, D]
+    # per-tag reserved active slots (reference :88-101, reserve/1 :398-411)
+    rsv_tag: jax.Array       # [N, A] reserved tag per slot (-1 free)
+    rsv_peer: jax.Array      # [N, A] peer filling it (-1 open)
+    rsv_dropped: jax.Array   # [N] reserve attempts past max_active (counted)
+    # protocol-visible partition table (inject/resolve TTL flood,
+    # reference :244-254, 1731-1797)
+    part_ref: jax.Array      # [N, PT] partition reference ids (-1 free)
+    part_peer: jax.Array     # [N, PT] the neighbor marked partitioned
+    part_dropped: jax.Array  # [N] entries lost to a full table (counted)
+    dc_overwrites: jax.Array  # [N] dc-map slot collisions (approximation
+                              # fidelity loss — counted, never silent)
 
 
 # ---- direct-mapped (peer -> id) maps; collisions overwrite, degrading to
@@ -81,22 +93,43 @@ def _dc_get(peers: jax.Array, ids: jax.Array, p: jax.Array) -> jax.Array:
 
 
 def _dc_put(peers: jax.Array, ids: jax.Array, p: jax.Array, i: jax.Array):
+    """Returns (peers, ids, overwrote): ``overwrote`` flags a collision
+    that evicted a DIFFERENT peer's record — the fidelity-loss event of
+    the direct-mapped approximation, counted by callers (VERDICT r1:
+    silent-degradation structures must have counters)."""
     slot = jnp.where(p >= 0, p % _DC_SLOTS, 0)
     do = p >= 0
+    overwrote = do & (peers[slot] >= 0) & (peers[slot] != p)
     return (peers.at[slot].set(jnp.where(do, p, peers[slot])),
-            ids.at[slot].set(jnp.where(do, i, ids[slot])))
+            ids.at[slot].set(jnp.where(do, i, ids[slot])),
+            overwrote)
 
 
 class HyParView(ProtocolBase):
     msg_types = ("join", "forward_join", "neighbor", "disconnect",
                  "neighbor_request", "neighbor_accepted", "neighbor_rejected",
                  "shuffle", "shuffle_reply", "keepalive",
-                 "ctl_join", "ctl_leave")
+                 "part_inject", "part_resolve",
+                 "ctl_join", "ctl_leave", "ctl_reserve",
+                 "ctl_part_inject", "ctl_part_resolve")
     ctl_peer_field = "joiner"
 
-    def __init__(self, cfg: Config):
+    def __init__(self, cfg: Config, tags=None, reservable: bool = False):
+        """``tags``: optional [N] int32 node-tag table (-1 untagged) — the
+        node_spec tag of the reference (client/server etc).  ``reservable``
+        enables the per-tag reserved-slot machinery in _add_active
+        (reference :88-101); off by default so untagged deployments keep
+        the exact unreserved code path."""
         self.cfg = cfg
+        assert cfg.shuffle_k_active <= cfg.max_active_size and \
+            cfg.shuffle_k_passive <= cfg.max_passive_size, (
+                "shuffle sample sizes cannot exceed the view caps "
+                f"(k_active={cfg.shuffle_k_active} vs "
+                f"A={cfg.max_active_size}; k_passive="
+                f"{cfg.shuffle_k_passive} vs P={cfg.max_passive_size})")
         self.S = 1 + cfg.shuffle_k_active + cfg.shuffle_k_passive
+        self.tags = None if tags is None else jnp.asarray(tags, jnp.int32)
+        self.reservable = reservable
         self.data_spec: Dict = {
             "joiner": ((), jnp.int32),
             "ttl": ((), jnp.int32),
@@ -105,6 +138,8 @@ class HyParView(ProtocolBase):
             "dcid": ((), jnp.int32),    # sender's last-received dc id for dst
             "origin": ((), jnp.int32),  # shuffle originator
             "sample": ((self.S,), jnp.int32),
+            "tag": ((), jnp.int32, -1),   # ctl_reserve
+            "pref": ((), jnp.int32, -1),  # partition reference id
         }
         # join: 1 neighbor + (A-1) forward_joins + 1 eviction disconnect
         self.emit_cap = max(cfg.max_active_size + 2, 8)
@@ -116,9 +151,10 @@ class HyParView(ProtocolBase):
     def init(self, cfg: Config, key: jax.Array) -> HvState:
         n = cfg.n_nodes
         d = _DC_SLOTS
+        a = cfg.max_active_size
         return HvState(
-            active=jnp.full((n, cfg.max_active_size), -1, jnp.int32),
-            active_ttl=jnp.zeros((n, cfg.max_active_size), jnp.int32),
+            active=jnp.full((n, a), -1, jnp.int32),
+            active_ttl=jnp.zeros((n, a), jnp.int32),
             passive=jnp.full((n, cfg.max_passive_size), -1, jnp.int32),
             epoch=jnp.ones((n,), jnp.int32),
             dc_cnt=jnp.zeros((n,), jnp.int32),
@@ -128,7 +164,22 @@ class HyParView(ProtocolBase):
             sent_dc_id=jnp.full((n, d), -1, jnp.int32),
             recv_dc_peer=jnp.full((n, d), -1, jnp.int32),
             recv_dc_id=jnp.full((n, d), -1, jnp.int32),
+            rsv_tag=jnp.full((n, a), -1, jnp.int32),
+            rsv_peer=jnp.full((n, a), -1, jnp.int32),
+            rsv_dropped=jnp.zeros((n,), jnp.int32),
+            part_ref=jnp.full((n, _PART_SLOTS), -1, jnp.int32),
+            part_peer=jnp.full((n, _PART_SLOTS), -1, jnp.int32),
+            part_dropped=jnp.zeros((n,), jnp.int32),
+            dc_overwrites=jnp.zeros((n,), jnp.int32),
         )
+
+    def health_counters(self, state: HvState):
+        """Degradation counters surfaced through metrics.world_health."""
+        return {
+            "dc_overwrites": jnp.sum(state.dc_overwrites),
+            "rsv_dropped": jnp.sum(state.rsv_dropped),
+            "part_dropped": jnp.sum(state.part_dropped),
+        }
 
     def member_mask(self, row: HvState) -> jax.Array:
         """Active-view one-hot (the manager's members/0 = active view)."""
@@ -158,30 +209,77 @@ class HyParView(ProtocolBase):
         return row.replace(active_ttl=jnp.where(
             hit, cfg.keepalive_ttl, row.active_ttl))
 
+    def _tag_of(self, peer: jax.Array) -> jax.Array:
+        if self.tags is None:
+            return jnp.int32(-1)
+        n = self.tags.shape[0]
+        return jnp.where(peer >= 0, self.tags[jnp.clip(peer, 0, n - 1)], -1)
+
     def _add_active(self, cfg, me, row: HvState, peer: jax.Array,
                     key: jax.Array):
         """add_to_active_view (:1371-1420 + eviction :1466-1512): insert
         peer; when full, evict a uniformly random victim, demote it to the
         passive view and emit a ``disconnect`` with a fresh epoch-scoped id.
 
+        With ``reservable=True``, the reference's per-tag reserved slots
+        apply (:1397-1413, 1445-1460, 1477): a peer whose tag matches an
+        OPEN reservation fills it; open reservations count toward
+        fullness (is_full), so untagged peers see capacity
+        A - open_reservations; peers in FILLED reservations are never the
+        random eviction victim.  A filled slot is never un-filled — the
+        reference's remove_from_reserved is commented out (:1611).
+
         Returns (row, dc_dst, dc_id): dc_dst = -1 when nothing was evicted.
         """
         ok = (peer >= 0) & (peer != me) & ~row.left
         peer = jnp.where(ok, peer, -1)
         row = row.replace(passive=ps.remove(row.passive, peer))
-        new_active, evicted, _ = ps.insert_evict(row.active, peer, key)
-        row = row.replace(active=new_active)
+        if not self.reservable:
+            new_active, evicted, _ = ps.insert_evict(row.active, peer, key)
+            row = row.replace(active=new_active)
+        else:
+            A = row.active.shape[0]
+            ptag = self._tag_of(peer)
+            open_slot = (row.rsv_tag >= 0) & (row.rsv_peer < 0)
+            fill_hit = open_slot & (row.rsv_tag == ptag) & (ptag >= 0)
+            fills = jnp.any(fill_hit)
+            n_open = jnp.sum(open_slot) - fills.astype(jnp.int32)
+            present = ps.contains(row.active, peer)
+            want = (peer >= 0) & ~present
+            free = row.active < 0
+            has_free = jnp.any(free)
+            first_free = jnp.argmax(free)
+            need_evict = want & ((ps.size(row.active) + n_open >= A)
+                                 | ~has_free)
+            # random eviction among UNPROTECTED members (reserved peers
+            # are omitted, :1477)
+            protected = jnp.any(
+                row.active[None, :] == row.rsv_peer[:, None], axis=0) \
+                & (row.active >= 0)
+            elig = (row.active >= 0) & ~protected
+            g = jax.random.gumbel(key, row.active.shape)
+            vslot = jnp.argmax(jnp.where(elig, g, -jnp.inf))
+            can = want & jnp.where(need_evict, jnp.any(elig), has_free)
+            slot = jnp.where(need_evict, vslot, first_free)
+            evicted = jnp.where(can & need_evict, row.active[slot], -1)
+            active = row.active.at[slot].set(
+                jnp.where(can, peer, row.active[slot]))
+            rsv_peer = jnp.where(
+                (jnp.arange(A) == jnp.argmax(fill_hit)) & fills & can,
+                peer, row.rsv_peer)
+            row = row.replace(active=active, rsv_peer=rsv_peer)
         row = self._reset_ttl(cfg, row, peer)
         # demote the victim (disconnected peers land in passive, :926-972)
         k2 = prng.decision_key(key, 1)
         row = self._add_passive(cfg, me, row, evicted, k2)
         new_id = (row.epoch << _EPOCH_SHIFT) | (row.dc_cnt & ((1 << _EPOCH_SHIFT) - 1))
         did_evict = evicted >= 0
-        sp, si = _dc_put(row.sent_dc_peer, row.sent_dc_id,
-                         jnp.where(did_evict, evicted, -1), new_id)
+        sp, si, over = _dc_put(row.sent_dc_peer, row.sent_dc_id,
+                               jnp.where(did_evict, evicted, -1), new_id)
         row = row.replace(
             sent_dc_peer=sp, sent_dc_id=si,
             dc_cnt=row.dc_cnt + did_evict.astype(jnp.int32),
+            dc_overwrites=row.dc_overwrites + over.astype(jnp.int32),
         )
         return row, jnp.where(did_evict, evicted, -1), new_id
 
@@ -278,9 +376,11 @@ class HyParView(ProtocolBase):
         peer, mid = m.src, m.data["id"]
         last = _dc_get(row.recv_dc_peer, row.recv_dc_id, peer)
         valid = mid > last  # monotone id gate (is_valid_disconnect, :1622-1655)
-        rp, ri = _dc_put(row.recv_dc_peer, row.recv_dc_id,
-                         jnp.where(valid, peer, -1), mid)
-        row = row.replace(recv_dc_peer=rp, recv_dc_id=ri)
+        rp, ri, over = _dc_put(row.recv_dc_peer, row.recv_dc_id,
+                               jnp.where(valid, peer, -1), mid)
+        row = row.replace(recv_dc_peer=rp, recv_dc_id=ri,
+                          dc_overwrites=row.dc_overwrites
+                          + over.astype(jnp.int32))
         row = row.replace(active=jnp.where(
             valid & (row.active == peer), -1, row.active))
         row = self._add_passive(cfg, me, row, jnp.where(valid, peer, -1), key)
@@ -377,6 +477,98 @@ class HyParView(ProtocolBase):
             dc_cnt=row.dc_cnt + 1,
         )
         return row, dc
+
+    def handle_ctl_reserve(self, cfg, me, row: HvState, m: Msgs, key):
+        """reserve/1 (:398-411): register an open reserved slot for a
+        tag; at most max_active_size reservations, duplicates no-op, and
+        an over-capacity reserve is counted (the reference replies
+        {error, no_available_slots})."""
+        tag = m.data["tag"]
+        present = jnp.any((row.rsv_tag == tag) & (tag >= 0))
+        free = row.rsv_tag < 0
+        has_free = jnp.any(free)
+        do = (tag >= 0) & ~present & has_free
+        slot = jnp.argmax(free)
+        row = row.replace(
+            rsv_tag=row.rsv_tag.at[slot].set(
+                jnp.where(do, tag, row.rsv_tag[slot])),
+            rsv_dropped=row.rsv_dropped
+            + ((tag >= 0) & ~present & ~has_free).astype(jnp.int32))
+        return row, self.no_emit()
+
+    # ---------------------------------------------------- partition surface
+
+    def _mark_partitions(self, row: HvState, ref: jax.Array) -> HvState:
+        """Append (ref, peer) for every current active peer to the
+        partition table (handle_partition_injection :1748-1772);
+        duplicates skipped, overflow counted."""
+        for j in range(row.active.shape[0]):   # static unroll over A
+            p = row.active[j]
+            dup = jnp.any((row.part_ref == ref) & (row.part_peer == p))
+            want = (p >= 0) & (ref >= 0) & ~dup
+            free = row.part_ref < 0
+            has_free = jnp.any(free)
+            slot = jnp.argmax(free)
+            do = want & has_free
+            row = row.replace(
+                part_ref=row.part_ref.at[slot].set(
+                    jnp.where(do, ref, row.part_ref[slot])),
+                part_peer=row.part_peer.at[slot].set(
+                    jnp.where(do, p, row.part_peer[slot])),
+                part_dropped=row.part_dropped
+                + (want & ~has_free).astype(jnp.int32))
+        return row
+
+    def handle_part_inject(self, cfg, me, row: HvState, m: Msgs, key):
+        """Partition-injection flood (:1731-1772): mark every active
+        neighbor partitioned under the reference id; while TTL > 0
+        re-forward to the active view."""
+        ref, ttl = m.data["pref"], m.data["ttl"]
+        row = self._mark_partitions(row, ref)
+        fwd = self.emit(jnp.where(ttl > 0, row.active, -1),
+                        self.typ("part_inject"), pref=ref,
+                        ttl=jnp.maximum(ttl - 1, 0))
+        return row, fwd
+
+    def handle_part_resolve(self, cfg, me, row: HvState, m: Msgs, key):
+        """Resolution flood (:1773-1797): drop entries under the ref;
+        only a node whose table CHANGED re-propagates (the flood's
+        termination condition)."""
+        ref = m.data["pref"]
+        hit = (row.part_ref == ref) & (ref >= 0)
+        changed = jnp.any(hit)
+        row = row.replace(part_ref=jnp.where(hit, -1, row.part_ref),
+                          part_peer=jnp.where(hit, -1, row.part_peer))
+        fwd = self.emit(jnp.where(changed, row.active, -1),
+                        self.typ("part_resolve"), pref=ref)
+        return row, fwd
+
+    def handle_ctl_part_inject(self, cfg, me, row: HvState, m: Msgs, key):
+        """inject_partition(Origin, TTL) (:244-247): the origin marks its
+        neighbors and starts the flood."""
+        return self.handle_part_inject(cfg, me, row, m, key)
+
+    def handle_ctl_part_resolve(self, cfg, me, row: HvState, m: Msgs, key):
+        """resolve_partition(Reference) (:249-251)."""
+        return self.handle_part_resolve(cfg, me, row, m, key)
+
+    # host-side queries ----------------------------------------------------
+
+    def partitions(self, state: HvState, node: int):
+        """partitions/0 (:253-254): the node-visible partition set as
+        (ref, peer) pairs."""
+        import numpy as np
+        refs = np.asarray(state.part_ref[node])
+        peers = np.asarray(state.part_peer[node])
+        return [(int(r), int(p)) for r, p in zip(refs, peers) if r >= 0]
+
+    def reserved(self, state: HvState, node: int):
+        """The reservation table as {tag: peer_or_None}."""
+        import numpy as np
+        tags = np.asarray(state.rsv_tag[node])
+        peers = np.asarray(state.rsv_peer[node])
+        return {int(t): (int(p) if p >= 0 else None)
+                for t, p in zip(tags, peers) if t >= 0}
 
     # ------------------------------------------------------------------ timer
 

@@ -56,6 +56,9 @@ class PtState:
     known: jax.Array      # [N, A] membership snapshot for neighbor-up
                           # detection (new members join every eager set,
                           # plumtree_broadcast :314-336, 652-659)
+    bucket_evictions: jax.Array  # [N] root-bucket collisions that evicted
+                                 # an older tree (approximation fidelity
+                                 # loss — counted, never silent)
 
 
 class Plumtree(UpperProtocol):
@@ -132,7 +135,11 @@ class Plumtree(UpperProtocol):
             val=jnp.zeros((n, self.K), jnp.int32),
             next_seq=jnp.zeros((n,), jnp.int32),
             known=jnp.full((n, self.A), -1, jnp.int32),
+            bucket_evictions=jnp.zeros((n,), jnp.int32),
         )
+
+    def health_counters(self, state: PtState):
+        return {"pt_bucket_evictions": jnp.sum(state.bucket_evictions)}
 
     # ------------------------------------------------------- tree primitives
 
@@ -142,12 +149,14 @@ class Plumtree(UpperProtocol):
         with eager = current membership peers, lazy = {} (:652-659)."""
         slot = jnp.where(root >= 0, root % self.R, 0)
         owned = up.root_key[slot] == root
+        evicts = (root >= 0) & (up.root_key[slot] >= 0) & ~owned
         fresh_eager = peers
         eager = jnp.where(owned, up.eager[slot], fresh_eager)
         lazy = jnp.where(owned, up.lazy[slot], -1)
         up = up.replace(
             root_key=up.root_key.at[slot].set(jnp.where(root >= 0, root,
-                                                        up.root_key[slot])))
+                                                        up.root_key[slot])),
+            bucket_evictions=up.bucket_evictions + evicts.astype(jnp.int32))
         return up, slot, eager, lazy
 
     def _store(self, up: PtState, slot, eager, lazy) -> PtState:

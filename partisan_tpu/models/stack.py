@@ -137,3 +137,8 @@ class Stacked(ProtocolBase):
 
     def member_mask(self, row: StackState) -> jax.Array:
         return self.lower.member_mask(row.lower)
+
+    def health_counters(self, state: StackState):
+        out = dict(self.lower.health_counters(state.lower))
+        out.update(self.upper.health_counters(state.upper))
+        return out

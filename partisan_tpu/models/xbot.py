@@ -59,6 +59,9 @@ class XbState(HvState):
     rtt: jax.Array        # [N, P] RTT in rounds
     rtt_cur: jax.Array    # [N] round-robin eviction cursor
     last_rnd: jax.Array   # [N] round mirror (RTT computed at delivery)
+    probe_miss: jax.Array  # [N] optimization passes stalled because the
+                           # candidate had NO measurement yet (probe
+                           # coverage not keeping pace — counted)
 
 
 class XBotHyParView(HyParView):
@@ -110,7 +113,14 @@ class XBotHyParView(HyParView):
             rtt=jnp.full((n, self.rtt_cap), -1, jnp.int32),
             rtt_cur=jnp.zeros((n,), jnp.int32),
             last_rnd=jnp.zeros((n,), jnp.int32),
+            probe_miss=jnp.zeros((n,), jnp.int32),
         )
+
+    def health_counters(self, state):
+        out = dict(super().health_counters(state))
+        if self.measured:
+            out["xbot_probe_miss"] = jnp.sum(state.probe_miss)
+        return out
 
     # -- cost helpers --------------------------------------------------------
 
@@ -233,6 +243,14 @@ class XBotHyParView(HyParView):
         cand = ps.random_member(row.passive, prng.decision_key(key, 60))
         worst = self._worst_active(me, row)
         go = due & self._better(row, me, cand, worst) & (worst >= 0)
+        if self.measured:
+            # coverage check: an optimization pass whose candidate has
+            # no RTT yet cannot move (cost +inf) — count the stall so
+            # probe-lag is visible instead of silently halting progress
+            stalled = due & (cand >= 0) \
+                & (self._cost(row, me, cand) >= _UNMEASURED)
+            row = row.replace(probe_miss=row.probe_miss
+                              + stalled.astype(jnp.int32))
         opt = self.emit(jnp.where(go, cand, -1)[None],
                         self.typ("optimization"),
                         cap=self.tick_emit_cap, xb_old=worst)

@@ -1,0 +1,85 @@
+"""Degradation counters — every fidelity-losing approximation counts its
+losses and surfaces them through metrics.world_health (VERDICT r1 weak
+item 6: 'counted, never silent')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import metrics, peer_service as ps
+from partisan_tpu.models import hyparview as hv_mod
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.plumtree import Plumtree
+from partisan_tpu.models.stack import Stacked
+from partisan_tpu.models.xbot import XBotHyParView
+from partisan_tpu.peer_service import send_ctl
+
+
+class TestDcMapOverwrites:
+    def test_colliding_peers_counted(self):
+        """Two peers hashing to the same direct-mapped slot: the second
+        record evicts the first AND the collision is counted."""
+        peers = jnp.full((hv_mod._DC_SLOTS,), -1, jnp.int32)
+        ids = jnp.full((hv_mod._DC_SLOTS,), -1, jnp.int32)
+        p1, i1 = jnp.int32(3), jnp.int32(100)
+        p2 = jnp.int32(3 + hv_mod._DC_SLOTS)  # same slot
+        peers, ids, over1 = hv_mod._dc_put(peers, ids, p1, i1)
+        assert not bool(over1)
+        # same peer again: refresh, not a collision
+        peers, ids, over_same = hv_mod._dc_put(peers, ids, p1, i1 + 1)
+        assert not bool(over_same)
+        peers, ids, over2 = hv_mod._dc_put(peers, ids, p2, jnp.int32(200))
+        assert bool(over2)
+        # and the first record is gone (the fidelity loss being counted)
+        assert int(hv_mod._dc_get(peers, ids, p1)) == -1
+
+    def test_surfaced_in_world_health(self):
+        cfg = pt.Config(n_nodes=8, inbox_cap=16)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        h = metrics.world_health(world, proto)
+        assert int(h["dc_overwrites"]) == 0
+        assert "part_dropped" in h and "rsv_dropped" in h
+
+
+class TestPlumtreeBucketEvictions:
+    def test_root_collision_counted(self):
+        """n_roots=1: broadcasts from two different roots collide in the
+        single bucket; the eviction is counted, not silent."""
+        cfg = pt.Config(n_nodes=6, inbox_cap=16, shuffle_interval=5)
+        proto = Stacked(HyParView(cfg), Plumtree(cfg, n_keys=2, n_roots=1))
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto, [(i, 0) for i in range(1, 6)])
+        step = pt.make_step(cfg, proto, donate=False)
+        for _ in range(10):
+            world, _ = step(world)
+        world = send_ctl(world, proto, 0, "ctl_pt_broadcast",
+                         pt_key=0, pt_val=1)
+        world = send_ctl(world, proto, 3, "ctl_pt_broadcast",
+                         pt_key=1, pt_val=2)
+        for _ in range(8):
+            world, _ = step(world)
+        total = int(np.asarray(world.state.upper.bucket_evictions).sum())
+        assert total > 0, "root collision not counted"
+        h = metrics.world_health(world, proto)
+        assert int(h["pt_bucket_evictions"]) == total
+
+
+class TestXbotProbeCoverage:
+    def test_unmeasured_candidate_stall_counted(self):
+        """measured=True: early optimization passes fire before any RTT
+        probe of the candidate has completed — each stall increments
+        probe_miss instead of silently halting optimization."""
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, shuffle_interval=3,
+                        distance_interval=64)  # probes almost never fire
+        proto = XBotHyParView(cfg, measured=True)
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto, [(i, i - 1) for i in range(1, 16)])
+        step = pt.make_step(cfg, proto, donate=False)
+        for _ in range(30):
+            world, _ = step(world)
+        misses = int(np.asarray(world.state.probe_miss).sum())
+        assert misses > 0, "no probe stall was counted"
+        h = metrics.world_health(world, proto)
+        assert int(h["xbot_probe_miss"]) == misses

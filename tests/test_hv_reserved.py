@@ -1,0 +1,158 @@
+"""HyParView per-tag reserved slots + protocol-visible partitions —
+the round-2 parity additions (reference
+partisan_hyparview_peer_service_manager.erl :88-101 reserve/1 :398-411,
+partition inject/resolve flood :244-254, 1731-1797)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service as ps
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.peer_service import send_ctl
+
+
+def boot(n=16, rounds=20, tags=None, reservable=False, **cfg_kw):
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5, **cfg_kw)
+    proto = HyParView(cfg, tags=tags, reservable=reservable)
+    world = pt.init_world(cfg, proto)
+    world = ps.cluster(world, proto, [(i, i - 1) for i in range(1, n)])
+    step = pt.make_step(cfg, proto, donate=False)
+    for _ in range(rounds):
+        world, _ = step(world)
+    return cfg, proto, world, step
+
+
+class TestReservedSlots:
+    def test_tagged_join_fills_reservation_and_survives_churn(self):
+        """A reservation for tag 7 on node 0: the first joiner carrying
+        tag 7 fills the slot and is never the random eviction victim
+        afterwards, even under a join storm that overflows the active
+        view repeatedly (:1397-1413, :1477)."""
+        n = 16
+        tags = np.full((n,), -1, np.int32)
+        tags[5] = 7                      # node 5 carries tag 7
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg, tags=jnp.asarray(tags), reservable=True)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 0, "ctl_reserve", tag=7)
+        world, _ = step(world)
+        assert proto.reserved(world.state, 0) == {7: None}
+        # node 5 joins node 0 -> fills the reservation
+        world = ps.join(world, proto, 5, 0)
+        for _ in range(4):
+            world, _ = step(world)
+        assert proto.reserved(world.state, 0) == {7: 5}
+        assert 5 in np.flatnonzero(np.asarray(
+            ps.members(world, proto, 0)))
+        # join storm at node 0: many evictions, but never node 5
+        world = ps.cluster(world, proto,
+                           [(i, 0) for i in range(1, n) if i != 5],
+                           stagger=4)
+        for _ in range(20):
+            world, _ = step(world)
+        assert bool(ps.members(world, proto, 0)[5]), \
+            "reserved peer was evicted"
+
+    def test_open_reservations_reduce_capacity(self):
+        """Open reservations count toward fullness (is_full :1452-1460):
+        with A-1 reservations, untagged joiners can occupy at most one
+        active slot at the contact."""
+        n = 12
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, max_active_size=4)
+        proto = HyParView(cfg, reservable=True)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        for t in (1, 2, 3):
+            world = send_ctl(world, proto, 0, "ctl_reserve", tag=t)
+        world, _ = step(world)
+        world = ps.cluster(world, proto, [(i, 0) for i in range(1, 6)])
+        for _ in range(10):
+            world, _ = step(world)
+        active0 = int(np.asarray(ps.members(world, proto, 0)).sum())
+        assert active0 <= 1, \
+            f"untagged peers filled reserved capacity: {active0}"
+
+    def test_reserve_overflow_counted(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, max_active_size=2,
+                        shuffle_k_active=2)
+        proto = HyParView(cfg, reservable=True)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        for t in (1, 2, 3):                  # one more than max_active
+            world = send_ctl(world, proto, 0, "ctl_reserve", tag=t)
+        for _ in range(2):
+            world, _ = step(world)
+        assert int(world.state.rsv_dropped[0]) == 1
+        assert set(proto.reserved(world.state, 0)) == {1, 2}
+
+
+class TestPartitionSurface:
+    def test_inject_flood_marks_and_resolve_clears(self):
+        """inject_partition TTL flood: nodes within TTL hops mark their
+        active neighbors partitioned and the origin's table is readable
+        via partitions(); resolve_partition floods the clear
+        (:1731-1797)."""
+        cfg, proto, world, step = boot(n=16, rounds=25)
+        world = send_ctl(world, proto, 0, "ctl_part_inject",
+                         pref=99, ttl=2)
+        for _ in range(4):
+            world, _ = step(world)
+        p0 = proto.partitions(world.state, 0)
+        assert p0 and all(r == 99 for r, _ in p0)
+        # the flood reached beyond the origin
+        marked = [n for n in range(16)
+                  if proto.partitions(world.state, n)]
+        assert len(marked) > 1, marked
+        # resolution clears every table
+        world = send_ctl(world, proto, 0, "ctl_part_resolve", pref=99)
+        for _ in range(6):
+            world, _ = step(world)
+        for n in range(16):
+            assert proto.partitions(world.state, n) == [], n
+
+    def test_distinct_references_independent(self):
+        cfg, proto, world, step = boot(n=8, rounds=20)
+        world = send_ctl(world, proto, 1, "ctl_part_inject", pref=5, ttl=0)
+        world = send_ctl(world, proto, 1, "ctl_part_inject", pref=6, ttl=0)
+        for _ in range(2):
+            world, _ = step(world)
+        refs = {r for r, _ in proto.partitions(world.state, 1)}
+        assert refs == {5, 6}
+        world = send_ctl(world, proto, 1, "ctl_part_resolve", pref=5)
+        for _ in range(2):
+            world, _ = step(world)
+        refs = {r for r, _ in proto.partitions(world.state, 1)}
+        assert refs == {6}
+
+
+class TestPortSurface:
+    def test_reserve_and_partition_verbs(self):
+        from partisan_tpu.bridge.client import PortClient
+        from partisan_tpu.bridge.etf import Atom
+        with PortClient() as pc:
+            assert pc.start("hyparview", n_nodes=8, data_plane=False,
+                            reservable=True) == Atom("ok")
+            for i in range(1, 8):
+                pc.join(i, i - 1)
+            pc.advance(20)
+            # synchronous reserve: duplicate ok, overflow errors like the
+            # reference's {error, no_available_slots}
+            assert pc.call((Atom("reserve"), 0, 42)) == Atom("ok")
+            assert pc.call((Atom("reserve"), 0, 42)) == Atom("ok")
+            for t in range(5):          # fill the remaining A-1 slots
+                assert pc.call((Atom("reserve"), 0, 100 + t)) == Atom("ok")
+            assert pc.call((Atom("reserve"), 0, 999)) == \
+                (Atom("error"), Atom("no_available_slots"))
+            assert pc.call((Atom("hv_inject_partition"), 0, 7, 1)) == \
+                Atom("ok")
+            pc.advance(3)
+            ok, pairs = pc.call((Atom("hv_partitions"), 0))
+            assert ok == Atom("ok") and pairs and \
+                all(r == 7 for r, _ in pairs)
+            assert pc.call((Atom("hv_resolve_partition"), 0, 7)) == \
+                Atom("ok")
+            pc.advance(5)
+            ok, pairs = pc.call((Atom("hv_partitions"), 0))
+            assert ok == Atom("ok") and pairs == []
