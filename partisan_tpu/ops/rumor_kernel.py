@@ -69,6 +69,19 @@ def _flat_bit_roll(x: jax.Array, s: jax.Array, n: int) -> jax.Array:
     return jnp.where(r == 0, xw, (xw << r) | carry)
 
 
+def pz_bit(pz, shape, row_offset, active):
+    """Packed one-hot bit for patient zero ``pz`` within a [rows, 128]
+    word window starting at flat word row ``row_offset``; zeros when
+    ``active`` is False.  Shared by the VMEM and HBM kernels."""
+    wi = pz // WORD
+    row = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + row_offset
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    at_pz = (row == wi // LANES) & (lane == wi % LANES)
+    return jnp.where(at_pz & active,
+                     jnp.uint32(1) << (pz % WORD).astype(jnp.uint32),
+                     jnp.uint32(0))
+
+
 def _bernoulli_words(p: float, shape) -> jax.Array:
     """Packed Bernoulli(p) bits from the on-core PRNG — the shared
     bit-serial expansion (ops/bitset.bernoulli_expand) fed by
@@ -114,11 +127,7 @@ def _round_body(i, seed, inf, hot, alive, n, fanout, stop_k, churn):
     # sum to 0 while hot bits remain)
     dead = jnp.sum(((new_hot & alive) != 0).astype(jnp.int32)) == 0
     pz = (sbits[1, 0] % jnp.uint32(n)).astype(jnp.int32)
-    wi, bi = pz // WORD, (pz % WORD).astype(jnp.uint32)
-    row = jax.lax.broadcasted_iota(jnp.int32, inf.shape, 0)
-    lane = jax.lax.broadcasted_iota(jnp.int32, inf.shape, 1)
-    at_pz = (row == wi // LANES) & (lane == wi % LANES)
-    bit = jnp.where(at_pz & dead, jnp.uint32(1) << bi, jnp.uint32(0))
+    bit = pz_bit(pz, inf.shape, 0, dead)
     return new_inf | bit, new_hot | bit
 
 

@@ -1,0 +1,243 @@
+"""HBM-resident blocked rumor kernel — the big-N extension of
+``ops/rumor_kernel.py`` (ROADMAP #2 / VERDICT r1 next-step 6).
+
+The VMEM-resident mega-kernel tops out near N = 2^22: the whole packed
+state plus roll temporaries must fit in ~16 MB of VMEM.  This variant
+keeps the packed state in HBM and runs a ``grid = (rounds, blocks)``
+kernel: each step DMAs the block's working set into VMEM scratch,
+computes one epidemic round for that block, and writes the block back.
+DMAs are synchronous per step (gather, compute, write back — no
+cross-step overlap yet; ROADMAP #2 lists that overlap as remaining
+headroom).  The "ping-pong" below refers to the round-parity swap of
+the two HBM state buffers, not DMA double buffering.  Measured
+roll-compute-bound: ~13.6k rounds/s at 2^22, ~6.3k at 2^24, ~2.7k at
+2^26 on one chip — N is VMEM-unbounded (scales to ~10^8).
+
+Rendezvous decomposition: the flat-roll delivery of the VMEM kernel
+(partner = node + s mod n) would make every output block depend on an
+UNALIGNED window of two input blocks.  Instead the per-(round, fanout)
+shift decomposes as ``(q, r)``: partner = (block + q mod nb,
+offset + r mod BC) — a block-cyclic roll composed with an intra-block
+bit rotation.  Both factors are drawn uniformly (q over blocks, r over
+block bits), so the composite is a uniformly-drawn member of a
+permutation family with the same rendezvous statistics as the flat roll
+(each (q, r) IS a bijection of nodes; q aligns the DMA windows to block
+boundaries).  Shifts and restart patient-zeros are drawn HOST-side with
+jax.random and ride the scalar-prefetch lane, which also makes the
+deterministic configs (churn = 0) interpret-mode testable; only churn
+bits use the on-core PRNG.
+
+State ping-pongs between two HBM buffers by round parity (reads hit the
+previous round's buffer while writes fill the other), so there is no
+read-after-write hazard between blocks of the same round.  The restart
+reseed uses the PREVIOUS round's hot count (accumulated in SMEM scratch
+as blocks stream through) — one round of reseed latency vs the VMEM
+kernel, irrelevant to the sustained-gossip workload it serves.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .rumor_kernel import (CELL, LANES, _bernoulli_words,
+                           _flat_bit_roll, pz_bit)
+
+
+def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
+            # scratch
+            w_hot, w_alive, w_dup, b_inf, b_hot, b_alive, hotcnt, sems,
+            *, nb, B, fanout, stop_k, churn, all_alive):
+    BC = B * CELL
+    i = pl.program_id(0)          # round
+    b = pl.program_id(1)          # block
+    base = i * (2 * fanout + 2)   # per-round scalar record
+    even = i % 2 == 0
+
+    def cp(src, dst, slot):
+        d = pltpu.make_async_copy(src, dst, sems.at[slot])
+        d.start()
+        return d
+
+    # ---- gather: shifted hot/alive windows + own-block state.
+    # reads go to the PREVIOUS round's buffer (ping-pong by parity);
+    # round 0 reads the pristine inputs.
+    def window_reads(inf_src, hot_src):
+        ds = []
+        for j in range(fanout):
+            q = sref[base + 2 * j]
+            src_b = jax.lax.rem(b - q + nb, nb)
+            ds.append(cp(hot_src.at[pl.ds(src_b * B, B)],
+                         w_hot.at[j], 2 * j))
+            if not all_alive:
+                ds.append(cp(alive.at[pl.ds(src_b * B, B)],
+                             w_alive.at[j], 2 * j + 1))
+        # dup feedback window: roll(inf, -s0) -> read block (b + q0)
+        q0 = sref[base]
+        dup_b = jax.lax.rem(b + q0, nb)
+        ds.append(cp(inf_src.at[pl.ds(dup_b * B, B)], w_dup, 2 * fanout))
+        ds.append(cp(inf_src.at[pl.ds(b * B, B)], b_inf, 2 * fanout + 1))
+        ds.append(cp(hot_src.at[pl.ds(b * B, B)], b_hot, 2 * fanout + 2))
+        if not all_alive:
+            ds.append(cp(alive.at[pl.ds(b * B, B)], b_alive,
+                         2 * fanout + 3))
+        return ds
+
+    @pl.when(i == 0)
+    def _():
+        for d in window_reads(inf0, hot0):
+            d.wait()
+
+    @pl.when((i > 0) & even)
+    def _():
+        for d in window_reads(inf_b, hot_b):
+            d.wait()
+
+    @pl.when((i > 0) & ~even)
+    def _():
+        for d in window_reads(inf_a, hot_a):
+            d.wait()
+
+    # ---- hot-count bookkeeping for the restart reseed: reset the
+    # accumulator at each round's first block; the value consumed is the
+    # count accumulated over the PREVIOUS round's blocks.
+    @pl.when(b == 0)
+    def _():
+        hotcnt[1] = hotcnt[0]
+        hotcnt[0] = 0
+
+    # ---- one round for this block
+    hit = jnp.zeros((B, LANES), jnp.uint32)
+    for j in range(fanout):
+        r = sref[base + 2 * j + 1]
+        send_w = w_hot[j] if all_alive else (w_hot[j] & w_alive[j])
+        hit = hit | _flat_bit_roll(send_w, r, BC)
+
+    inf = b_inf[:]
+    hot = b_hot[:]
+    al = jnp.uint32(0xFFFFFFFF) if all_alive else b_alive[:]
+    send = hot & al
+    new_inf = inf | (hit & al)
+    r0 = sref[base + 1]
+    dup = _flat_bit_roll(w_dup[:], BC - jax.lax.rem(r0, BC), BC) & send
+    newly = new_inf & ~inf
+    new_hot = hot | newly
+    if stop_k <= 1:
+        new_hot = new_hot & ~dup
+    else:
+        pltpu.prng_seed(sref[base + 2 * fanout], i * nb + b)
+        coin = _bernoulli_words(1.0 / stop_k, (B, LANES))
+        new_hot = new_hot & ~(dup & coin)
+    if churn > 0.0:
+        pltpu.prng_seed(sref[base + 2 * fanout], 7777 + i * nb + b)
+        reborn = _bernoulli_words(churn, (B, LANES))
+        new_inf = new_inf & ~reborn
+        new_hot = new_hot & ~reborn
+
+    # restart: the previous round ended with zero hot senders -> seed the
+    # round's patient zero (if it lives in this block)
+    dead = (i > 0) & (hotcnt[1] == 0)
+    pz = sref[base + 2 * fanout + 1]
+    bit = pz_bit(pz, (B, LANES), b * B, dead)
+    new_inf = new_inf | bit
+    new_hot = new_hot | bit
+
+    hotcnt[0] = hotcnt[0] + jnp.sum(
+        ((new_hot & al) != 0).astype(jnp.int32))
+
+    # ---- write back to this round's output buffer
+    b_inf[:] = new_inf
+    b_hot[:] = new_hot
+
+    def write_out(inf_dst, hot_dst):
+        d1 = pltpu.make_async_copy(b_inf, inf_dst.at[pl.ds(b * B, B)],
+                                   sems.at[2 * fanout + 4])
+        d2 = pltpu.make_async_copy(b_hot, hot_dst.at[pl.ds(b * B, B)],
+                                   sems.at[2 * fanout + 5])
+        d1.start(); d2.start()
+        d1.wait(); d2.wait()
+
+    @pl.when(even)
+    def _():
+        write_out(inf_a, hot_a)
+
+    @pl.when(~even)
+    def _():
+        write_out(inf_b, hot_b)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
+                  stop_k: int = 1, churn: float = 0.0,
+                  block_rows: int = 1024, interpret: bool = False,
+                  all_alive: bool = False):
+    """Run ``n_rounds`` of rumor mongering with HBM-resident state.
+
+    ``packed`` is a models.demers.RumorWorldPacked; ``n`` must be a
+    multiple of ``block_rows * 4096``.  Returns the same type.
+
+    ``all_alive=True`` (caller-asserted: packed.alive is all-ones, as in
+    the churn benchmark, whose churn resets infection but never kills
+    nodes) skips every alive DMA and mask — ~30% of the HBM traffic.
+    """
+    R = n // CELL
+    B = min(block_rows, R)
+    assert R % B == 0, f"n/{CELL} = {R} rows must divide into {B}-row blocks"
+    nb = R // B
+    assert n_rounds >= 1
+
+    # host-side randomness: per-(round, fanout) (q, r) + seed + patient
+    # zero, packed as one int32 scalar-prefetch record per round
+    key = jax.random.fold_in(jax.random.PRNGKey(0xB10C), packed.rnd)
+    kq, kr, kp, ks = jax.random.split(key, 4)
+    q = jax.random.randint(kq, (n_rounds, fanout), 0, nb, jnp.int32)
+    r = jax.random.randint(kr, (n_rounds, fanout), 1, B * CELL, jnp.int32)
+    pz = jax.random.randint(kp, (n_rounds,), 0, n, jnp.int32)
+    seeds = jax.random.randint(ks, (n_rounds,), 0, 1 << 30, jnp.int32)
+    qr = jnp.stack([q, r], axis=-1).reshape(n_rounds, 2 * fanout)
+    sref = jnp.concatenate(
+        [qr, seeds[:, None], pz[:, None]], axis=1).reshape(-1)
+
+    shape = (R, LANES)
+    re2 = lambda x: x.reshape(shape)
+    kern = functools.partial(_kernel, nb=nb, B=B, fanout=fanout,
+                             stop_k=stop_k, churn=churn,
+                             all_alive=all_alive)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_rounds, nb),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((fanout, B, LANES), jnp.uint32),   # w_hot
+            # alive buffers shrink to dummies on the all_alive fast
+            # path — their 1.5 MB of VMEM is the block-size headroom
+            pltpu.VMEM((1, 1, 1) if all_alive
+                       else (fanout, B, LANES), jnp.uint32),  # w_alive
+            pltpu.VMEM((B, LANES), jnp.uint32),           # w_dup
+            pltpu.VMEM((B, LANES), jnp.uint32),           # b_inf
+            pltpu.VMEM((B, LANES), jnp.uint32),           # b_hot
+            pltpu.VMEM((1, 1) if all_alive
+                       else (B, LANES), jnp.uint32),      # b_alive
+            pltpu.SMEM((2,), jnp.int32),                  # hotcnt
+            pltpu.SemaphoreType.DMA((2 * fanout + 6,)),
+        ],
+    )
+    inf_a, hot_a, inf_b, hot_b = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(shape, jnp.uint32)] * 4,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(sref, re2(packed.infected), re2(packed.hot), re2(packed.alive))
+
+    inf, hot = (inf_a, hot_a) if (n_rounds - 1) % 2 == 0 else (inf_b, hot_b)
+    from ..models.demers import RumorWorldPacked
+    return RumorWorldPacked(
+        infected=inf.reshape(-1), hot=hot.reshape(-1),
+        alive=packed.alive, rnd=packed.rnd + n_rounds)

@@ -22,6 +22,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 import partisan_tpu as pt  # noqa: E402
@@ -194,6 +195,34 @@ def main() -> None:
                      f"infected={float(out.infected.mean()):.2f}"])
         print(f"{'rumor_mongering_1e6':28s} N={n:<7d} "
               f"{rounds/dt:9.1f} rounds/s")
+
+    if want("rumor_hbm") and jax.devices()[0].platform == "tpu":
+        # ROADMAP #2: the HBM-resident blocked kernel past the VMEM limit
+        # (2^22).  Roll-compute-bound: rounds/s scales ~1/N.
+        from partisan_tpu.models.demers import rumor_pack
+        from partisan_tpu.ops.rumor_kernel_hbm import rumor_run_hbm
+        import statistics
+        for logn, rounds in ((24, 3000), (26, 1000)):
+            n = 1 << logn
+            out = rumor_run_hbm(rumor_pack(rumor_init(n, 0)), rounds, n,
+                                2, 1, 0.01, 1024, False, True)
+            float(jnp.mean(jnp.bitwise_count(out.infected)))  # sync
+            rates, frac = [], 0.0
+            for t in range(3):   # median of 3: the tunnel is shared and
+                # trial-to-trial variance measured up to 4x
+                w0 = rumor_pack(rumor_init(n, (104729 * (t + 3)) % n))
+                t0 = time.perf_counter()
+                out = rumor_run_hbm(w0, rounds, n, 2, 1, 0.01, 1024,
+                                    False, True)
+                frac = float(jnp.mean(jnp.bitwise_count(out.infected)
+                                      / 32.0))
+                rates.append(rounds / (time.perf_counter() - t0))
+            rps = statistics.median(rates)
+            rows.append([f"rumor_hbm_2e{logn}", n, rounds,
+                         round(rounds / rps, 4), round(rps, 1),
+                         f"infected={frac:.2f},device=tpu"])
+            print(f"{f'rumor_hbm_2e{logn}':28s} N={n:<7d} "
+                  f"{rps:9.1f} rounds/s")
 
     new = not os.path.exists(args.out)
     with open(args.out, "a", newline="") as f:

@@ -1,0 +1,104 @@
+"""HBM-resident blocked rumor kernel (ops/rumor_kernel_hbm.py) —
+interpret-mode correctness against an independent numpy model of the
+same block-cyclic rendezvous semantics.  (churn > 0 uses the on-core
+PRNG, which interpret mode cannot reproduce — covered on real TPU by
+the bench/perf sweeps; see the repo measurement notes.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partisan_tpu.models.demers import rumor_init, rumor_pack, rumor_unpack
+from partisan_tpu.ops.rumor_kernel import CELL
+from partisan_tpu.ops.rumor_kernel_hbm import rumor_run_hbm
+
+
+def numpy_reference(inf, hot, alive, rounds, n, fanout, B_rows, start_rnd):
+    """The kernel's exact semantics on unpacked bool arrays: per (round,
+    fanout) a block-cyclic roll q + intra-block bit rotation r (same
+    host-side draws), stop_k=1 push-ack feedback, one-round-delayed
+    restart reseed."""
+    BC = B_rows * CELL
+    nb = n // BC
+    key = jax.random.fold_in(jax.random.PRNGKey(0xB10C), start_rnd)
+    kq, kr, kp, _ = jax.random.split(key, 4)
+    q = np.asarray(jax.random.randint(kq, (rounds, fanout), 0, nb))
+    r = np.asarray(jax.random.randint(kr, (rounds, fanout), 1, BC))
+    pz = np.asarray(jax.random.randint(kp, (rounds,), 0, n))
+
+    def perm_roll(x, qi, ri):
+        """bit j of result = bit at (block j//BC - qi, offset j%BC - ri)."""
+        blocks = x.reshape(nb, BC)
+        blocks = np.roll(blocks, qi, axis=0)     # block-cyclic part
+        blocks = np.roll(blocks, ri, axis=1)     # intra-block rotation
+        return blocks.reshape(-1)
+
+    prev_hot_alive = None
+    for i in range(rounds):
+        send = hot & alive
+        hit = np.zeros_like(send)
+        for j in range(fanout):
+            hit |= perm_roll(send, q[i, j], r[i, j])
+        new_inf = inf | (hit & alive)
+        dup = perm_roll(inf, -q[i, 0], -r[i, 0]) & send
+        newly = new_inf & ~inf
+        new_hot = (hot | newly) & ~dup
+        # restart is gated on the PREVIOUS round's surviving hot set
+        dead = i > 0 and prev_hot_alive == 0
+        if dead:
+            new_inf[pz[i]] = True
+            new_hot[pz[i]] = True
+        prev_hot_alive = int((new_hot & alive).sum())
+        inf, hot = new_inf, new_hot
+    return inf, hot
+
+
+@pytest.mark.slow
+class TestHbmKernelInterpret:
+    @pytest.mark.parametrize("rounds", [1, 2, 5])
+    def test_matches_numpy_reference(self, rounds):
+        n = 4 * CELL            # 4 blocks of 1 row each
+        w = rumor_init(n, patient_zero=7)
+        out = rumor_run_hbm(rumor_pack(w), rounds, n, fanout=2,
+                            stop_k=1, churn=0.0, block_rows=1,
+                            interpret=True)
+        got = rumor_unpack(out, n)
+        ref_inf, ref_hot = numpy_reference(
+            np.asarray(w.infected), np.asarray(w.hot),
+            np.asarray(w.alive), rounds, n, 2, 1, int(w.rnd))
+        np.testing.assert_array_equal(np.asarray(got.infected), ref_inf,
+                                      err_msg=f"infected @ {rounds}")
+        np.testing.assert_array_equal(np.asarray(got.hot), ref_hot,
+                                      err_msg=f"hot @ {rounds}")
+
+    def test_multi_row_blocks(self):
+        n = 4 * 2 * CELL        # 2 blocks of 4 rows
+        w = rumor_init(n, patient_zero=12345)
+        out = rumor_run_hbm(rumor_pack(w), 4, n, fanout=2, stop_k=1,
+                            churn=0.0, block_rows=4, interpret=True)
+        got = rumor_unpack(out, n)
+        ref_inf, ref_hot = numpy_reference(
+            np.asarray(w.infected), np.asarray(w.hot),
+            np.asarray(w.alive), 4, n, 2, 4, int(w.rnd))
+        np.testing.assert_array_equal(np.asarray(got.infected), ref_inf)
+        np.testing.assert_array_equal(np.asarray(got.hot), ref_hot)
+
+    def test_all_alive_fast_path_identical(self):
+        """all_alive=True (the perf-suite configuration) must produce
+        EXACTLY the masked path's output when alive is all-ones."""
+        n = 4 * CELL
+        w = rumor_init(n, patient_zero=9)
+        a = rumor_run_hbm(rumor_pack(w), 5, n, 2, 1, 0.0, 1, True, False)
+        b = rumor_run_hbm(rumor_pack(w), 5, n, 2, 1, 0.0, 1, True, True)
+        np.testing.assert_array_equal(np.asarray(a.infected),
+                                      np.asarray(b.infected))
+        np.testing.assert_array_equal(np.asarray(a.hot), np.asarray(b.hot))
+
+    def test_epidemic_spreads(self):
+        n = 2 * CELL
+        w = rumor_init(n, patient_zero=3)
+        out = rumor_run_hbm(rumor_pack(w), 12, n, fanout=2, stop_k=1,
+                            churn=0.0, block_rows=1, interpret=True)
+        frac = float(rumor_unpack(out, n).infected.mean())
+        assert frac > 0.5, frac
