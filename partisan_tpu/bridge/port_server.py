@@ -386,6 +386,14 @@ def serve(stdin: BinaryIO, stdout: BinaryIO) -> None:
 
 
 def main() -> None:
+    # honor JAX_PLATFORMS=cpu from the opener (PortClient sets it): the
+    # image's TPU plugin registers via jax.config at interpreter start
+    # and IGNORES the env var, so an explicit config.update is required
+    # before any jax use or the simulator silently runs over the tunnel
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     serve(sys.stdin.buffer, sys.stdout.buffer)
 
 

@@ -1,5 +1,6 @@
 from .chain import ChainWorker
 from .commit import AlsbergDay, BernsteinCTP, Skeen3PC, TwoPhaseCommit
+from .dataplane import DataPlane
 from .demers import (AntiEntropy, DirectMail, DirectMailAcked, rumor_init,
                      rumor_run)
 from .distance import Distance

@@ -13,9 +13,10 @@ for discovered-but-unknown nodes.
 Strategies:
   * :class:`FileSystemStrategy` — a shared directory as the artifact
     store; the docker-compose/Redis analog, exercised in CI.
-  * :class:`KubernetesStrategy` — pod discovery via the k8s API; needs
-    cluster credentials, so it is a documented stub here (the image has
-    no egress), same callback surface.
+  * :class:`KubernetesStrategy` — pod discovery via the k8s API
+    (label-selector queries + bearer token), with an injectable
+    ``api_client`` so the discovery logic runs and tests without
+    cluster credentials; artifacts delegate to a pluggable store.
 """
 
 from __future__ import annotations
@@ -60,14 +61,102 @@ class FileSystemStrategy:
 
 
 class KubernetesStrategy:
-    """Pod discovery through the Kubernetes API
-    (partisan_kubernetes_orchestration_strategy.erl).  Requires in-cluster
-    credentials; construction fails fast outside a cluster."""
+    """Pod discovery through the Kubernetes API — the rebuild of
+    ``partisan_kubernetes_orchestration_strategy.erl`` (:20-146):
 
-    def __init__(self) -> None:
-        raise NotImplementedError(
-            "kubernetes discovery needs in-cluster API access; use "
-            "FileSystemStrategy for local/compose deployments")
+      * ``clients()`` / ``servers()`` list pods whose labels match
+        ``tag=<client|server>,evaluation-timestamp=<ts>`` (the
+        reference's URL-encoded labelSelector, :56-66) via
+        ``GET $APISERVER/api/v1/pods?labelSelector=...`` with a bearer
+        token (:131-146);
+      * each pod with both ``metadata.name`` and ``status.podIP``
+        becomes a peer spec ``name@podIP:PEER_PORT`` (:86-130) —
+        malformed items are skipped exactly like the reference's
+        undefined checks;
+      * artifacts ride the pluggable store (the reference pushes them
+        through Redis EVEN under kubernetes, :33-54 — here any
+        OrchestrationStrategy store, e.g. FileSystemStrategy, plays
+        that role).
+
+    ``api_client(url, headers) -> (status, body_bytes)`` is injectable
+    so the discovery logic runs and tests WITHOUT cluster credentials
+    (this image has no egress); the default client reads APISERVER /
+    TOKEN from the environment like the reference and fails fast when
+    they are absent.
+    """
+
+    def __init__(self, artifact_store: Optional[OrchestrationStrategy]
+                 = None, api_client=None,
+                 api_server: Optional[str] = None,
+                 token: Optional[str] = None,
+                 peer_port: Optional[int] = None,
+                 evaluation_timestamp: int = 0):
+        self.store = artifact_store
+        self.api_server = api_server or os.environ.get("APISERVER")
+        self.token = token or os.environ.get("TOKEN")
+        self.peer_port = int(peer_port
+                             or os.environ.get("PEER_PORT", "9090"))
+        self.evaluation_timestamp = evaluation_timestamp
+        if api_client is not None:
+            self.api_client = api_client
+        else:
+            if not self.api_server or not self.token:
+                raise RuntimeError(
+                    "kubernetes discovery needs APISERVER and TOKEN (or "
+                    "an injected api_client); use FileSystemStrategy for "
+                    "local/compose deployments")
+            self.api_client = self._default_client
+
+    def _default_client(self, url: str, headers: Dict[str, str]):
+        import urllib.request
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=10) as resp:  # noqa: S310
+            return resp.status, resp.read()
+
+    # -- pod discovery (clients/1, servers/1) ------------------------------
+
+    def clients(self) -> List[Dict]:
+        return self._pods("client")
+
+    def servers(self) -> List[Dict]:
+        return self._pods("server")
+
+    def _pods(self, tag: str) -> List[Dict]:
+        selector = (f"tag%3D{tag},evaluation-timestamp%3D"
+                    f"{self.evaluation_timestamp}")
+        url = f"{self.api_server}/api/v1/pods?labelSelector={selector}"
+        headers = {"Authorization": f"Bearer {self.token}"}
+        try:
+            status, body = self.api_client(url, headers)
+        except Exception:  # noqa: BLE001 — discovery is best-effort
+            return []
+        if status != 200:
+            return []          # invalid response -> empty set (:74-79)
+        try:
+            doc = json.loads(body)
+        except ValueError:
+            return []
+        out = []
+        for item in doc.get("items") or []:
+            name = (item.get("metadata") or {}).get("name")
+            pod_ip = (item.get("status") or {}).get("podIP")
+            if not name or not pod_ip:
+                continue       # both required (:113-118)
+            out.append({"name": f"{name}@{pod_ip}",
+                        "host": pod_ip, "port": self.peer_port})
+        return out
+
+    # -- artifact store (the reference's Redis leg, :33-54) ----------------
+
+    def upload_artifact(self, name: str, payload: bytes) -> None:
+        if self.store is None:
+            raise RuntimeError("no artifact store configured")
+        self.store.upload_artifact(name, payload)
+
+    def download_artifacts(self) -> Dict[str, bytes]:
+        if self.store is None:
+            return {}
+        return self.store.download_artifacts()
 
 
 class OrchestrationBackend:
@@ -77,11 +166,15 @@ class OrchestrationBackend:
 
     def __init__(self, strategy: OrchestrationStrategy,
                  proto: ProtocolBase, my_node: int,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 node_table: Optional[Dict[str, int]] = None):
         self.strategy = strategy
         self.proto = proto
         self.my_node = my_node
         self.name = name or f"node-{my_node}"
+        # pod/peer name -> virtual node id (names live host-side only,
+        # SURVEY §5.6); used by discovery-capable strategies (kubernetes)
+        self.node_table = node_table or {}
 
     def poll(self, world: World) -> World:
         """Upload my membership artifact; join any discovered stranger."""
@@ -99,6 +192,18 @@ class OrchestrationBackend:
             peers: List[int] = [int(art.get("node", -1))] + \
                 [int(x) for x in art.get("members", [])]
             for p in peers:
+                if p >= 0 and p not in known:
+                    known.add(p)
+                    world = peer_service.join(world, self.proto,
+                                              self.my_node, p)
+
+        # pod discovery (kubernetes): join every discovered pod that maps
+        # to a virtual node id (the backend's refresh-membership timer,
+        # partisan_orchestration_backend.erl:38-70)
+        if hasattr(self.strategy, "clients"):
+            pods = self.strategy.clients() + self.strategy.servers()
+            for pod in pods:
+                p = self.node_table.get(pod["name"], -1)
                 if p >= 0 and p not in known:
                     known.add(p)
                     world = peer_service.join(world, self.proto,

@@ -196,33 +196,45 @@ def main() -> None:
         print(f"{'rumor_mongering_1e6':28s} N={n:<7d} "
               f"{rounds/dt:9.1f} rounds/s")
 
+    def time_kernel(name, run_fn, n, rounds):
+        """Shared fused-kernel timing discipline: warmup compile + sync,
+        then median of 3 trials on distinct inputs (tunnel variance is
+        up to 4x; see the measurement notes)."""
+        import statistics
+        from partisan_tpu.models.demers import rumor_pack
+        out = run_fn(rumor_pack(rumor_init(n, 0)))
+        float(jnp.mean(jnp.bitwise_count(out.infected)))  # sync
+        rates, frac = [], 0.0
+        for t in range(3):
+            w0 = rumor_pack(rumor_init(n, (104729 * (t + 3)) % n))
+            t0 = time.perf_counter()
+            out = run_fn(w0)
+            frac = float(jnp.mean(jnp.bitwise_count(out.infected) / 32.0))
+            rates.append(rounds / (time.perf_counter() - t0))
+        rps = statistics.median(rates)
+        rows.append([name, n, rounds, round(rounds / rps, 4),
+                     round(rps, 1), f"infected={frac:.2f},device=tpu"])
+        print(f"{name:28s} N={n:<7d} {rps:9.1f} rounds/s")
+
+    if want("rumor_fused") and jax.devices()[0].platform == "tpu":
+        # the bench.py headline kernel (VMEM-resident, N=2^20)
+        from partisan_tpu.ops.rumor_kernel import rumor_run_fused
+        n, rounds = 1 << 20, 20000
+        time_kernel("rumor_fused_pallas",
+                    lambda w: rumor_run_fused(w, rounds, n, 2, 1, 0.01),
+                    n, rounds)
+
     if want("rumor_hbm") and jax.devices()[0].platform == "tpu":
         # ROADMAP #2: the HBM-resident blocked kernel past the VMEM limit
         # (2^22).  Roll-compute-bound: rounds/s scales ~1/N.
-        from partisan_tpu.models.demers import rumor_pack
         from partisan_tpu.ops.rumor_kernel_hbm import rumor_run_hbm
-        import statistics
-        for logn, rounds in ((24, 3000), (26, 1000)):
-            n = 1 << logn
-            out = rumor_run_hbm(rumor_pack(rumor_init(n, 0)), rounds, n,
-                                2, 1, 0.01, 1024, False, True)
-            float(jnp.mean(jnp.bitwise_count(out.infected)))  # sync
-            rates, frac = [], 0.0
-            for t in range(3):   # median of 3: the tunnel is shared and
-                # trial-to-trial variance measured up to 4x
-                w0 = rumor_pack(rumor_init(n, (104729 * (t + 3)) % n))
-                t0 = time.perf_counter()
-                out = rumor_run_hbm(w0, rounds, n, 2, 1, 0.01, 1024,
-                                    False, True)
-                frac = float(jnp.mean(jnp.bitwise_count(out.infected)
-                                      / 32.0))
-                rates.append(rounds / (time.perf_counter() - t0))
-            rps = statistics.median(rates)
-            rows.append([f"rumor_hbm_2e{logn}", n, rounds,
-                         round(rounds / rps, 4), round(rps, 1),
-                         f"infected={frac:.2f},device=tpu"])
-            print(f"{f'rumor_hbm_2e{logn}':28s} N={n:<7d} "
-                  f"{rps:9.1f} rounds/s")
+        for logn, rnds in ((24, 3000), (26, 1000)):
+            nn = 1 << logn
+            time_kernel(
+                f"rumor_hbm_2e{logn}",
+                lambda w, nn=nn, rnds=rnds: rumor_run_hbm(
+                    w, rnds, nn, 2, 1, 0.01, 1024, False, True),
+                nn, rnds)
 
     new = not os.path.exists(args.out)
     with open(args.out, "a", newline="") as f:

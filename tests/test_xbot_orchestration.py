@@ -86,6 +86,98 @@ class TestOrchestration:
         assert json.loads(arts["a"])["node"] == 1
 
 
+def fake_pod_list(pods):
+    """The k8s API pod-list shape the reference parses
+    (partisan_kubernetes_orchestration_strategy.erl:86-118)."""
+    items = []
+    for name, ip in pods:
+        item = {}
+        if name is not None:
+            item["metadata"] = {"name": name}
+        if ip is not None:
+            item["status"] = {"podIP": ip}
+        items.append(item)
+    return json.dumps({"items": items}).encode()
+
+
+class TestKubernetesStrategy:
+    def mk(self, responder, **kw):
+        from partisan_tpu.orchestration import KubernetesStrategy
+        calls = []
+
+        def client(url, headers):
+            calls.append((url, headers))
+            return responder(url)
+
+        s = KubernetesStrategy(api_client=client,
+                               api_server="https://k8s:6443",
+                               token="tok", **kw)
+        return s, calls
+
+    def test_pod_parsing_and_selectors(self):
+        body = fake_pod_list([("web-0", "10.0.0.5"), ("web-1", "10.0.0.6"),
+                              ("broken", None), (None, "10.0.0.9")])
+        s, calls = self.mk(lambda url: (200, body),
+                           peer_port=9191, evaluation_timestamp=7)
+        pods = s.clients()
+        # malformed items (missing name or podIP) are skipped (:113-118)
+        assert pods == [
+            {"name": "web-0@10.0.0.5", "host": "10.0.0.5", "port": 9191},
+            {"name": "web-1@10.0.0.6", "host": "10.0.0.6", "port": 9191}]
+        url, headers = calls[0]
+        assert "labelSelector=tag%3Dclient,evaluation-timestamp%3D7" in url
+        assert headers["Authorization"] == "Bearer tok"
+        s.servers()
+        assert "tag%3Dserver" in calls[1][0]
+
+    def test_error_paths_yield_empty(self):
+        s, _ = self.mk(lambda url: (500, b""))
+        assert s.clients() == []
+        s2, _ = self.mk(lambda url: (200, b"not json"))
+        assert s2.clients() == []
+
+        def boom(url):
+            raise OSError("no route")
+        s3, _ = self.mk(boom)
+        assert s3.clients() == []
+
+    def test_requires_credentials_without_client(self, monkeypatch):
+        import pytest
+        from partisan_tpu.orchestration import KubernetesStrategy
+        monkeypatch.delenv("APISERVER", raising=False)
+        monkeypatch.delenv("TOKEN", raising=False)
+        with pytest.raises(RuntimeError):
+            KubernetesStrategy()
+
+    def test_backend_joins_discovered_pods(self, tmp_path):
+        """End-to-end: pod discovery + artifact store drive cluster
+        formation through OrchestrationBackend.poll."""
+        from partisan_tpu.orchestration import KubernetesStrategy
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = StaticManager(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+
+        body = fake_pod_list([("pod-a", "10.0.0.1"), ("pod-b", "10.0.0.2")])
+        store = FileSystemStrategy(str(tmp_path / "arts"))
+        strat = KubernetesStrategy(
+            artifact_store=store,
+            api_client=lambda url, headers: (200, body))
+        table = {"pod-a@10.0.0.1": 0, "pod-b@10.0.0.2": 1}
+        orch0 = OrchestrationBackend(strat, proto, my_node=0,
+                                     node_table=table)
+        orch1 = OrchestrationBackend(strat, proto, my_node=1,
+                                     node_table=table)
+        for _ in range(3):
+            world = orch0.poll(world)
+            world = orch1.poll(world)
+            for _ in range(3):
+                world, _ = step(world)
+        from partisan_tpu.events import members
+        assert 1 in members(world, proto, 0)
+        assert 0 in members(world, proto, 1)
+
+
 class TestXBotMeasured:
     def test_live_rtt_probing_prefers_near_half(self):
         """measured=True — the reference's `?XPARAM latency` mode with
