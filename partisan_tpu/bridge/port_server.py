@@ -121,6 +121,12 @@ class Session:
         if "tags" in overrides:
             mgr_kw["tags"] = [int(t) for t in overrides.pop("tags")]
         self.cfg = from_mapping(overrides)
+        # env tier beats the start argument for manager selection, like
+        # PEER_SERVICE beats the app-env default in partisan_config:init/0
+        # (src/partisan_config.erl:42-48); the start Manager arg is the
+        # app-env tier of this system
+        from ..config import env_overrides
+        manager = env_overrides().get("peer_service", str(manager))
         if str(manager) not in _MANAGERS:
             return (Atom("error"), Atom("unknown_manager"))
         if mgr_kw and str(manager) != "hyparview":

@@ -17,7 +17,8 @@ promotion 5 s -> 5 rounds.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Mapping, Optional, Tuple
+import os
+from typing import Any, Dict, Mapping, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +135,12 @@ class Config:
     #   notes).  None = always dense (bit-identical results either way;
     #   handlers see the same per-node PRNG keys on both paths).
 
+    # --- verification-harness flags (env tier, partisan_config.erl:37-151) --
+    tag: Optional[str] = None          # node tag (client/server), TAG env
+    replaying: bool = False            # trace replay mode, REPLAY env (:78-85)
+    shrinking: bool = False            # relaxed replay matching, SHRINKING env (:88-94)
+    trace_file: Optional[str] = None   # TRACE_FILE env (trace_orchestrator :450-457)
+
     # --- determinism --------------------------------------------------------
     seed: int = 1                      # per-node keys derive from this (support :163-166)
 
@@ -152,9 +159,74 @@ class Config:
 DEFAULT = Config()
 
 
-def from_mapping(m: Optional[Mapping[str, Any]] = None, **kw: Any) -> Config:
+# Reference manager module names -> port manager keys, so the PEER_SERVICE
+# env var accepts the exact values partisan_SUITE exports (e.g.
+# ``PEER_SERVICE=partisan_hyparview_peer_service_manager``,
+# test/partisan_support.erl:35-81) as well as our short names.
+_MANAGER_ALIASES = {
+    "partisan_pluggable_peer_service_manager": "full",
+    "partisan_default_peer_service_manager": "full",
+    "partisan_hyparview_peer_service_manager": "hyparview",
+    "partisan_hyparview_xbot_peer_service_manager": "hyparview",
+    "partisan_client_server_peer_service_manager": "client_server",
+    "partisan_static_peer_service_manager": "static",
+}
+
+
+def env_overrides(environ: Optional[Mapping[str, str]] = None
+                  ) -> Dict[str, Any]:
+    """The OS-env tier of the reference's three-tier config system
+    (``partisan_config:init/0``, src/partisan_config.erl:37-151): keys set
+    in the environment supersede app-level overrides, which supersede the
+    dataclass defaults.  Handled keys and their reference read sites:
+
+      PEER_SERVICE  manager selection (:42-48) — returned under the
+                    reserved key ``"peer_service"`` for the session layer
+                    (the port server's ``start``), translated from
+                    reference module names via _MANAGER_ALIASES
+      TAG           node tag (:67-75)
+      REPLAY        replay mode (:78-85)
+      SHRINKING     shrinking mode (:88-94)
+      TRACE_FILE    trace output path (trace_orchestrator :450-457)
+
+    The reference treats the literal string "false" as unset for all four
+    flag keys (``os:getenv(Key, "false")`` with a "false" guard clause);
+    any other set value enables REPLAY/SHRINKING.  That quirk is
+    preserved.
+    """
+    env = os.environ if environ is None else environ
+    out: Dict[str, Any] = {}
+    ps = env.get("PEER_SERVICE", "false")
+    if ps != "false":
+        out["peer_service"] = _MANAGER_ALIASES.get(ps, ps)
+    tag = env.get("TAG", "false")
+    if tag != "false":
+        out["tag"] = tag
+    if env.get("REPLAY", "false") != "false":
+        out["replaying"] = True
+    if env.get("SHRINKING", "false") != "false":
+        out["shrinking"] = True
+    tf = env.get("TRACE_FILE")
+    if tf:
+        out["trace_file"] = tf
+    return out
+
+
+def from_mapping(m: Optional[Mapping[str, Any]] = None,
+                 environ: Optional[Mapping[str, str]] = None,
+                 **kw: Any) -> Config:
     """Build a Config from a dict of overrides (the `partisan_config:set`
-    analog used by the test harness, cf. test/partisan_support.erl:109-330)."""
+    analog used by the test harness, cf. test/partisan_support.erl:109-330).
+
+    The OS-env tier (``env_overrides``) is applied on top, mirroring
+    ``partisan_config:init/0`` priority: env > app overrides > defaults.
+    Pass ``environ={}`` to disable it (hermetic tests).  The
+    ``peer_service`` env key is not a Config field — it is consumed by the
+    session layer (bridge/port_server.cmd_start) before this call.
+    """
     merged = dict(m or {})
     merged.update(kw)
+    env = env_overrides(environ)
+    env.pop("peer_service", None)
+    merged.update(env)
     return dataclasses.replace(DEFAULT, **merged)

@@ -218,6 +218,19 @@ class CausalDelivery(ProtocolBase):
     def __init__(self, cfg: Config, buf_cap: int = 8, log_cap: int = 16):
         self.cfg = cfg
         self.buf_cap, self.log_cap = buf_cap, log_cap
+        # dense [A] clocks on the wire and an [A, A] order buffer per node
+        # make causal labels an O(N^3) state feature — the reference has
+        # the same practical shape (per-label gen_servers holding orddict
+        # clocks; causal_test runs on 2-3 nodes,
+        # test/partisan_SUITE.erl:402).  Guard like FullMembership's so
+        # the limit is an error, not an allocation surprise; qos/dvv.py
+        # holds the fixed-slot sparse-clock prototype for larger actor
+        # sets (ROADMAP 8).
+        assert cfg.n_nodes <= 128, (
+            f"causal labels carry dense [N] clocks and [N, N] order "
+            f"buffers per node (O(N^3) total); a causal label over "
+            f"{cfg.n_nodes} > 128 nodes needs the sparse-clock path "
+            f"(qos/dvv.py)")
         a = cfg.n_nodes
         self.data_spec: Dict = {
             "payload": ((), jnp.int32),

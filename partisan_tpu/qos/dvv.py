@@ -1,0 +1,123 @@
+"""Fixed-slot sparse vector clocks — the dotted-version-vector-style
+compression prototype for large actor sets (ROADMAP 8; the scaling escape
+for qos/causal.py's dense ``[A]`` clocks).
+
+The reference's clocks are orddicts over *discovered* actors
+(``src/partisan_vclock.erl`` — entries exist only for actors that have
+incremented), so a clock's size tracks its causal history, not the cluster
+size.  The dense rebuild (qos/vclock.py) trades that for vectorization by
+materializing all A counters.  This module restores the sparse shape under
+fixed TPU-friendly dimensions: a clock is K slots of ``(actor, counter)``
+pairs (actor −1 = empty), where K bounds the number of *distinct actors in
+one causal history* — typically the handful of nodes that write to a
+label, independent of cluster size.  That is exactly the compression DVVs
+exploit (Preguiça et al., "Dotted Version Vectors": per-entry dots bound
+growth by writers, not replicas).
+
+Semantics match qos/vclock.py (absent actor = counter 0).  Slot exhaustion
+(more than K distinct actors in one history) cannot be represented; every
+op returns an ``ok`` flag the caller must surface — the same
+count-don't-silence rule as the engine's fixed-shape buffers
+(SURVEY §7.3).  tests/test_qos.py drives the equivalence property: any
+increment/merge program over ≤ K actors yields bitwise-identical
+descends/dominates/compare results to the dense clocks.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fresh(k_slots: int) -> Tuple[jax.Array, jax.Array]:
+    """The empty clock: (actors [K] int32 = −1, counters [K] int32 = 0)."""
+    return (jnp.full((k_slots,), -1, jnp.int32),
+            jnp.zeros((k_slots,), jnp.int32))
+
+
+def counter_of(actors: jax.Array, counters: jax.Array,
+               actor: jax.Array) -> jax.Array:
+    """The actor's counter, 0 when absent (orddict miss default)."""
+    hit = actors == actor
+    return jnp.sum(jnp.where(hit, counters, 0))
+
+
+def increment(actors: jax.Array, counters: jax.Array, actor: jax.Array
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """partisan_vclock:increment/2.  Returns (actors', counters', ok);
+    ok = False (clock unchanged) when the actor is new and no slot is
+    free, or when actor < 0 (the empty-slot sentinel — matching free
+    slots by value would corrupt the clock)."""
+    hit = (actors == actor) & (actor >= 0)
+    present = jnp.any(hit)
+    free = actors < 0
+    has_free = jnp.any(free)
+    slot = jnp.where(present, jnp.argmax(hit), jnp.argmax(free))
+    ok = (present | has_free) & (actor >= 0)
+    actors = actors.at[slot].set(jnp.where(ok, actor, actors[slot]))
+    counters = counters.at[slot].add(jnp.where(ok, 1, 0))
+    return actors, counters, ok
+
+
+def merge(a_act: jax.Array, a_cnt: jax.Array,
+          b_act: jax.Array, b_cnt: jax.Array
+          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """partisan_vclock:merge/1 — pointwise max over the union of actors.
+    Result lives in a's slot layout first, b's new actors appended into
+    free slots.  Returns (actors, counters, ok); ok = False when the
+    union needs more than K slots (result then holds a ⊔ the b-entries
+    that fit — callers must treat it as poisoned)."""
+    K = a_act.shape[0]
+    # max b's counters into a's existing entries
+    b_in_a = jax.vmap(lambda x: counter_of(b_act, b_cnt, x))(a_act)
+    a_cnt = jnp.where(a_act >= 0, jnp.maximum(a_cnt, b_in_a), a_cnt)
+    # append b's actors absent from a, in slot order
+    is_new = (b_act >= 0) & jax.vmap(
+        lambda x: ~jnp.any(a_act == x))(b_act)
+    free = a_act < 0
+    n_free = jnp.sum(free)
+    # rank of each free slot among free slots / of each new actor among new
+    new_rank = jnp.cumsum(is_new) - 1
+    # target slot for new actor j: the (new_rank[j])-th free slot; entries
+    # that are not new (or don't fit) scatter to index K, dropped — value
+    # masking alone would leave duplicate indices in tgt (a non-new entry
+    # sharing a later new entry's slot), whose write order is undefined
+    free_slots = jnp.nonzero(free, size=K, fill_value=K - 1)[0]
+    fits = is_new & (new_rank < n_free)
+    tgt = jnp.where(fits, free_slots[jnp.clip(new_rank, 0, K - 1)], K)
+    a_act = a_act.at[tgt].set(b_act, mode="drop")
+    a_cnt = a_cnt.at[tgt].set(b_cnt, mode="drop")
+    ok = ~jnp.any(is_new & ~fits)
+    return a_act, a_cnt, ok
+
+
+def descends(a_act: jax.Array, a_cnt: jax.Array,
+             b_act: jax.Array, b_cnt: jax.Array) -> jax.Array:
+    """partisan_vclock:descends/2 — a >= b on every actor of b."""
+    a_for_b = jax.vmap(lambda x: counter_of(a_act, a_cnt, x))(b_act)
+    return jnp.all(jnp.where(b_act >= 0, a_for_b >= b_cnt, True))
+
+
+def dominates(a_act: jax.Array, a_cnt: jax.Array,
+              b_act: jax.Array, b_cnt: jax.Array) -> jax.Array:
+    """Strict descent (partisan_vclock:dominates/2)."""
+    return descends(a_act, a_cnt, b_act, b_cnt) \
+        & ~descends(b_act, b_cnt, a_act, a_cnt)
+
+
+def equal(a_act: jax.Array, a_cnt: jax.Array,
+          b_act: jax.Array, b_cnt: jax.Array) -> jax.Array:
+    return descends(a_act, a_cnt, b_act, b_cnt) \
+        & descends(b_act, b_cnt, a_act, a_cnt)
+
+
+def to_dense(actors: jax.Array, counters: jax.Array,
+             n_actors: int) -> jax.Array:
+    """Expand to a qos/vclock.py dense clock (the equivalence bridge).
+    Actors outside [0, n_actors) scatter-drop rather than aliasing into
+    the last slot (count-don't-silence: the caller picked n_actors)."""
+    dense = jnp.zeros((n_actors,), jnp.int32)
+    ok = actors >= 0
+    return dense.at[actors].max(jnp.where(ok, counters, 0), mode="drop")

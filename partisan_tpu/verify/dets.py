@@ -1,0 +1,211 @@
+"""Reference trace-file interop — import traces recorded by the Erlang
+implementation (``src/partisan_trace_file.erl:26-65``) so that a schedule
+found by one checker can drive the other.
+
+The reference persists traces with ``dets``: a table holding
+``{num_keys, K}`` plus numbered records ``{N, Entry}`` for N in 1..K
+(partisan_trace_file.erl:49-65), where each ``Entry`` is one of the trace
+orchestrator's line shapes (partisan_trace_orchestrator.erl:134-150,
+509-540):
+
+    {pre_interposition_fun, {TracingNode, InterpositionType, OriginNode,
+                             MessagePayload}}
+        InterpositionType = forward_message: TracingNode is the SENDER and
+        OriginNode the destination (the pre fun fires on the send path,
+        partisan_pluggable_peer_service_manager.erl:560-583);
+        receive_message: TracingNode is the RECEIVER, OriginNode the sender.
+    {enter_command, ...} / {exit_command, ...}
+        harness bookkeeping — imported but not mapped to wire entries.
+
+The on-disk container is a dets v9 file: a hash table whose objects are
+``term_to_binary`` blobs embedded in slot structures.  This reader does
+NOT reimplement the dets hash layout (it is an OTP-internal format that
+has drifted across releases); it *carves* the external-term-format blobs
+out of the raw bytes — every stored object begins with the ETF version
+magic 131, and a trace file is written once, append-only (the writer rms
+any existing file first, partisan_trace_file.erl:56-60), so carving
+recovers exactly the inserted objects.  The numbered-record scheme then
+reorders and validates them: we require num_keys and the full 1..K range,
+so a partial carve fails loudly instead of yielding a silently truncated
+schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from ..bridge import etf
+from ..bridge.etf import Atom
+from .trace import TraceEntry
+
+
+@dataclasses.dataclass(frozen=True)
+class RefTraceLine:
+    """One decoded reference trace line, pre-mapping."""
+    kind: str                 # pre_interposition_fun | enter_command | ...
+    tracing_node: Optional[str] = None
+    interposition_type: Optional[str] = None   # forward_message | receive_message
+    origin_node: Optional[str] = None
+    payload: Any = None       # the protocol message term
+
+    @property
+    def payload_head(self) -> Optional[str]:
+        """The message-type atom the checker keys schedules on (the head
+        of the payload tuple, e.g. ``forward_message`` / ``prepare``)."""
+        p = self.payload
+        if isinstance(p, tuple) and p and isinstance(p[0], Atom):
+            return str(p[0])
+        if isinstance(p, Atom):
+            return str(p)
+        return None
+
+
+def carve_terms(data: bytes) -> List[Any]:
+    """Extract every decodable external-term-format blob from raw bytes.
+
+    dets object slots frame each blob with internal size/status words; we
+    skip straight to the 131 magic and let the ETF grammar bound each
+    term.  False positives (a 131 byte inside another blob's payload)
+    decode as garbage terms that the numbered-record validation below
+    rejects; overlapping matches are avoided by resuming the scan after
+    each successful decode.
+    """
+    out: List[Any] = []
+    i = 0
+    n = len(data)
+    while i < n:
+        j = data.find(b"\x83", i)
+        if j < 0:
+            break
+        try:
+            term, used = etf.decode_prefix(data[j:])
+        except Exception:  # noqa: BLE001 — not a real term boundary
+            i = j + 1
+            continue
+        out.append(term)
+        i = j + used
+    return out
+
+
+def parse_ref_trace(data: bytes) -> List[RefTraceLine]:
+    """Decode a reference dets trace file's bytes into ordered lines.
+
+    Validates the numbered-record contract of partisan_trace_file:write/2:
+    a ``{num_keys, K}`` record and exactly one ``{N, Entry}`` for each
+    N in 1..K.
+    """
+    records: Dict[int, Any] = {}
+    num_keys: Optional[int] = None
+    for term in carve_terms(data):
+        if not (isinstance(term, tuple) and len(term) == 2):
+            continue
+        k, v = term
+        if k == Atom("num_keys") and isinstance(v, int):
+            num_keys = v
+        elif isinstance(k, int) and not isinstance(k, bool) and k >= 1:
+            records[k] = v
+    if num_keys is None:
+        raise ValueError("no num_keys record — not a partisan trace file")
+    missing = [n for n in range(1, num_keys + 1) if n not in records]
+    if missing:
+        raise ValueError(
+            f"trace carve incomplete: missing records {missing[:8]} "
+            f"of 1..{num_keys}")
+    lines = []
+    for n in range(1, num_keys + 1):
+        lines.append(_parse_line(records[n]))
+    return lines
+
+
+def _parse_line(entry: Any) -> RefTraceLine:
+    if (isinstance(entry, tuple) and len(entry) == 2
+            and entry[0] == Atom("pre_interposition_fun")
+            and isinstance(entry[1], tuple) and len(entry[1]) == 4):
+        node, itype, origin, payload = entry[1]
+        return RefTraceLine(
+            kind="pre_interposition_fun",
+            tracing_node=str(node),
+            interposition_type=str(itype),
+            origin_node=str(origin),
+            payload=payload)
+    head = entry[0] if isinstance(entry, tuple) and entry else entry
+    return RefTraceLine(kind=str(head), payload=entry)
+
+
+def ref_trace_to_entries(
+        lines: List[RefTraceLine],
+        node_ids: Mapping[str, int],
+        typ_of: Mapping[str, int]) -> List[TraceEntry]:
+    """Map reference pre_interposition lines onto :class:`TraceEntry`.
+
+    ``node_ids`` maps Erlang node names to virtual node ids (the port
+    bridge's integer-id table, SURVEY §5.6); ``typ_of`` maps payload-head
+    atoms to this engine's wire tags (``proto.typ``).  Only
+    forward_message lines become entries — they are the send events the
+    reference's model checker enumerates omissions over
+    (test/filibuster_SUITE.erl:697-930); receive_message lines duplicate
+    them one hop later and harness bookkeeping lines carry no wire
+    identity.  The reference is asynchronous so lines carry no round;
+    imported entries use rnd = -1 ("any round") and schedule matching
+    falls back to (src, dst, typ) — see :func:`imported_schedule_filter`.
+    Unknown nodes or payload heads raise: a schedule that silently maps
+    to nothing would "pass" vacuously.
+    """
+    out: List[TraceEntry] = []
+    for ln in lines:
+        if ln.kind != "pre_interposition_fun":
+            continue
+        if ln.interposition_type != "forward_message":
+            continue
+        if ln.tracing_node not in node_ids:
+            raise KeyError(f"unknown node {ln.tracing_node!r}")
+        if ln.origin_node not in node_ids:
+            raise KeyError(f"unknown node {ln.origin_node!r}")
+        head = ln.payload_head
+        if head is None or head not in typ_of:
+            raise KeyError(f"unmapped message type {head!r}")
+        out.append(TraceEntry(
+            rnd=-1,
+            src=node_ids[ln.tracing_node],
+            dst=node_ids[ln.origin_node],
+            typ=typ_of[head],
+            channel=0,
+            hash=zlib.crc32(etf.encode(ln.payload)) & 0x7FFFFFFF))
+    return out
+
+
+def imported_schedule_filter(entries: List[TraceEntry]
+                             ) -> Callable[[Tuple[int, int, int, int]], bool]:
+    """A ModelChecker ``candidate_filter`` that restricts omission
+    candidates to the (src, dst, typ) identities of an imported reference
+    schedule — the round-agnostic match that replays an asynchronous
+    reference schedule against the round-synchronous engine."""
+    keys = {(e.src, e.dst, e.typ) for e in entries}
+    return lambda k: (k[1], k[2], k[3]) in keys
+
+
+# --------------------------------------------------------------- test aid
+
+def synthesize_dets_bytes(lines: List[Any]) -> bytes:
+    """Build bytes with the dets object framing the carver sees: each
+    ``{N, Entry}`` record as a size/status-framed ``term_to_binary`` blob
+    after an opaque header.  This mirrors how objects sit in a real dets
+    file (32-bit size + status words, then the ETF blob) WITHOUT the hash
+    directory, which the reader deliberately ignores.  Used by tests; a
+    trace written by an actual BEAM carves identically because carving
+    keys on the ETF blobs alone.
+    """
+    out = bytearray()
+    # opaque header: dets v9 reserves the first kilobytes for the hash
+    # directory; fill with values that cannot alias the ETF magic
+    out += bytes([0x00, 0x01, 0x02] * 80)
+    records = [(Atom("num_keys"), len(lines))]
+    records += [(n + 1, ln) for n, ln in enumerate(lines)]
+    for rec in records:
+        blob = etf.encode(rec)
+        out += len(blob).to_bytes(4, "big")       # slot size word
+        out += (0x3C5A).to_bytes(4, "big")        # status word (active)
+        out += blob
+    return bytes(out)
