@@ -77,6 +77,35 @@ class PortClient:
         assert ok == Atom("ok")
         return h
 
+    def batch(self, *terms) -> List[Any]:
+        """One multi-command frame (SURVEY §7.3 batching): returns the
+        reply list."""
+        ok, replies = self.call((Atom("batch"), list(terms)))
+        assert ok == Atom("ok")
+        return replies
+
+    def csend(self, src: int, dst: int, payload: int, delay: int = 0) -> Any:
+        return self.call((Atom("csend"), src, dst, payload, delay))
+
+    def clog(self, node: int):
+        """-> (delivered_payloads, total_delivered) of the causal label."""
+        ok, log, n = self.call((Atom("clog"), node))
+        assert ok == Atom("ok")
+        return list(log), n
+
+    def interpose(self, kind: str, verb: str, **props) -> Any:
+        plist = [(Atom(k), Atom(v) if isinstance(v, str) else v)
+                 for k, v in props.items()]
+        return self.call((Atom("interpose"), Atom(kind), Atom(verb), plist))
+
+    def pt_broadcast(self, node: int, key: int, val: int) -> Any:
+        return self.call((Atom("pt_broadcast"), node, key, val))
+
+    def pt_read(self, node: int, key: int) -> int:
+        ok, v = self.call((Atom("pt_read"), node, key))
+        assert ok == Atom("ok")
+        return v
+
     def stop(self) -> None:
         try:
             self.call(Atom("stop"))

@@ -97,6 +97,14 @@ class Config:
     # (per-handler emission caps live on each protocol class, which alone
     # knows its fan-out; only the shared routing cap lives here)
     inbox_cap: int = 16                # max messages a node processes per round
+    auto_tune: bool = True
+    # ^ derive the engine performance knobs below (node_emit_cap,
+    #   deliver_gather_cap) from N when they are unset, so a naive
+    #   Config(n_nodes=...) hits the measured-optimal program shape the
+    #   way the reference runs its whole suite on config defaults
+    #   (test/partisan_SUITE.erl).  See engine.autotune for the rule;
+    #   False = the knobs mean exactly what they say (None = unbounded /
+    #   gated-dense).  Explicitly-set knobs always win over the rule.
     node_emit_cap: Optional[int] = None
     # ^ per-node emission budget per round (handler + tick emissions
     #   combined): when set, the engine collects emissions with a

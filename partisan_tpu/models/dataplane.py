@@ -42,7 +42,9 @@ from flax import struct
 from ..config import Config
 from ..ops.msg import Msgs
 from ..qos.ack import retransmit_due
+from ..ops import padded_set as ps
 from ..ops import ring
+from .. import prng
 from .stack import StackState, UpperProtocol
 
 
@@ -64,15 +66,42 @@ class DataRow:
     out_pk: jax.Array      # [N, R] original partition key (lane affinity)
     next_seq: jax.Array    # [N] monotone clock source (1-based; 0 = no ack)
     send_dropped: jax.Array  # [N] acked sends lost to a full ring (counted)
+    relay_expired: jax.Array  # [N] relays dropped at TTL 0 / no next hop
+                              # (the reference logs-and-drops, hyparview
+                              # :1154-1157; here counted, never silent)
+    # relay exactly-once plane: the reference's spanning-tree fan is
+    # acyclic so a relayed message reaches its target once; the
+    # partial-view fan here can reach it through several neighbors, so
+    # each relay carries a per-source nonce and targets dedup against a
+    # small seen-ring (overwritten entries make re-delivery possible
+    # again — at-least-once, like every other ring here)
+    relay_seq: jax.Array   # [N] monotone nonce source (1-based)
+    seen_src: jax.Array    # [N, RS] origin of recently relay-delivered
+    seen_nonce: jax.Array  # [N, RS] its nonce
+    out_nonce: jax.Array   # [N, R] nonce pinned per outstanding slot so
+                           # relayed RETRANSMITS dedup like originals
 
 
 class DataPlane(UpperProtocol):
     """``ctl_fwd`` (host-injected at the SOURCE row) runs the send-side
     pipeline in-step; ``fwd`` delivers into the destination's store ring;
     ``fwd_ack`` clears the outstanding slot.  Retransmission rides
-    ``tick_upper``."""
+    ``tick_upper``.
 
-    msg_types = ("fwd", "fwd_ack", "ctl_fwd")
+    With ``cfg.broadcast=True``, sends to a destination OUTSIDE the lower
+    layer's member view take the transitive relay path (``do_tree_forward``,
+    src/partisan_pluggable_peer_service_manager.erl:1500-1539 + relay
+    handling in the hyparview manager :1138-1163): the source fans a
+    ``relay`` carrying the full message to its active peers with
+    ``ttl = cfg.relay_ttl`` (?RELAY_TTL 5, partisan.hrl:9); each hop
+    delivers directly when the target is in ITS view, else forwards to
+    one random active peer with TTL-1.  The reference fans every hop over
+    its (acyclic) spanning-tree out-links; a partial-view overlay has no
+    global tree, so intermediate hops walk instead of fanning — same
+    reachability, no exponential flood, and expiry is counted
+    (``relay_expired``), never silent."""
+
+    msg_types = ("fwd", "fwd_ack", "relay", "ctl_fwd")
 
     def __init__(self, cfg: Config, payload_words: int = 4,
                  store_cap: int = 32, ring_cap: int = 8):
@@ -87,8 +116,18 @@ class DataPlane(UpperProtocol):
             "clock": ((), jnp.int32),                # 0 = no ack requested
             "ack": ((), jnp.int32),                  # ctl_fwd: request ack?
             "partition_key": ((), jnp.int32, -1),    # -1 = unkeyed
+            # relay plumbing (only exercised when cfg.broadcast)
+            "target": ((), jnp.int32, -1),           # final destination
+            "origin": ((), jnp.int32),               # original sender (spec
+            # shared with hyparview's shuffle originator — specs must agree
+            # to stack, models/stack.py union rule)
+            "ttl": ((), jnp.int32),
+            "rnonce": ((), jnp.int32),               # relay dedup nonce
         }
-        self.emit_cap = 1
+        # send side fans a relay over the active view when the dst is
+        # outside it; the fan width is the lower layer's view cap
+        self.relay_fan = cfg.max_active_size if cfg.broadcast else 0
+        self.emit_cap = max(1, self.relay_fan) + 1
         self.tick_emit_cap = ring_cap
 
     # ------------------------------------------------------------------ state
@@ -110,6 +149,11 @@ class DataPlane(UpperProtocol):
             out_pk=jnp.full((n, R), -1, jnp.int32),
             next_seq=jnp.ones((n,), jnp.int32),
             send_dropped=jnp.zeros((n,), jnp.int32),
+            relay_expired=jnp.zeros((n,), jnp.int32),
+            relay_seq=jnp.ones((n,), jnp.int32),
+            seen_src=jnp.full((n, 8), -1, jnp.int32),
+            seen_nonce=jnp.zeros((n, 8), jnp.int32),
+            out_nonce=jnp.zeros((n, R), jnp.int32),
         )
 
     # --------------------------------------------------------------- handlers
@@ -142,13 +186,37 @@ class DataPlane(UpperProtocol):
         # an acked send that could not be stored is NOT shipped (it could
         # never be retransmitted); the drop is counted above
         ship = ~want_ack | stored
-        em = self.emit(jnp.where(ship, dst, -1)[None], self.typ("fwd"),
-                       channel=m.channel,
+        wire_clock = jnp.where(stored, seq, 0)
+        if not cfg.broadcast:
+            em = self.emit(jnp.where(ship, dst, -1)[None], self.typ("fwd"),
+                           channel=m.channel,
+                           server_ref=m.data["server_ref"],
+                           payload=m.data["payload"],
+                           clock=wire_clock,
+                           partition_key=m.data["partition_key"])
+            return self.up(row, up), em
+        # transitive relay (pluggable :1500-1539): a dst outside the
+        # member view has no connection — fan a relay over the active view
+        peers = self.active_peers(row)
+        direct = jnp.any(peers == dst) | (dst == me)
+        nonce = up.relay_seq
+        up = up.replace(
+            relay_seq=up.relay_seq + (ship & ~direct).astype(jnp.int32),
+            out_nonce=ring.masked_set(up.out_nonce, slot,
+                                      stored & ~direct, nonce))
+        fw = self.emit(jnp.where(ship & direct, dst, -1)[None],
+                       self.typ("fwd"), channel=m.channel,
                        server_ref=m.data["server_ref"],
-                       payload=m.data["payload"],
-                       clock=jnp.where(stored, seq, 0),
+                       payload=m.data["payload"], clock=wire_clock,
                        partition_key=m.data["partition_key"])
-        return self.up(row, up), em
+        rl = self.emit(jnp.where(ship & ~direct, peers, -1),
+                       self.typ("relay"), cap=self.relay_fan,
+                       channel=m.channel, target=dst, origin=me,
+                       ttl=cfg.relay_ttl, rnonce=nonce,
+                       server_ref=m.data["server_ref"],
+                       payload=m.data["payload"], clock=wire_clock,
+                       partition_key=m.data["partition_key"])
+        return self.up(row, up), self.merge(fw, rl)
 
     def handle_fwd(self, cfg, me, row: StackState, m: Msgs, key):
         """Receive side: process_forward into the store ring (util
@@ -173,6 +241,63 @@ class DataPlane(UpperProtocol):
         return self.up(row, up.replace(out_valid=up.out_valid & ~hit)), \
             self.no_emit()
 
+    def handle_relay(self, cfg, me, row: StackState, m: Msgs, key):
+        """relay hop (hyparview :1138-1163): target in my active view (or
+        myself) -> deliver; else TTL walk to a random active peer.  The
+        final hop stays a ``relay`` addressed AT the target so delivery
+        records the ORIGIN as the message source, not the last hop (the
+        reference relays the original message term for the same reason).
+        Acks go straight back to the origin — they ride the direct route,
+        whose failure the origin's retransmit timer already covers."""
+        up: DataRow = row.upper
+        target, ttl = m.data["target"], m.data["ttl"]
+        origin, nonce = m.data["origin"], m.data["rnonce"]
+        at_me = target == me
+        # exactly-once across the redundant fan: copies of one relayed
+        # send share (origin, nonce); a copy already delivered is still
+        # ACKED (the original reached its destination) but not re-stored
+        # nonce 0 = unnonced (a retransmit of an originally-direct send
+        # whose dst later left the view): no dedup, at-least-once
+        dup = (nonce > 0) & jnp.any(
+            (up.seen_src == origin) & (up.seen_nonce == nonce))
+        deliver = at_me & ~dup
+        # local delivery into the store ring (src = origin)
+        slot = up.recv_count % self.S
+        st = lambda a, v: a.at[slot].set(jnp.where(deliver, v, a[slot]))
+        sslot = up.recv_count % up.seen_src.shape[0]
+        sn = lambda a, v: a.at[sslot].set(jnp.where(deliver, v, a[sslot]))
+        up = up.replace(
+            st_src=st(up.st_src, origin),
+            st_ref=st(up.st_ref, m.data["server_ref"]),
+            st_pay=st(up.st_pay, m.data["payload"]),
+            recv_count=up.recv_count + deliver.astype(jnp.int32),
+            seen_src=sn(up.seen_src, origin),
+            seen_nonce=sn(up.seen_nonce, nonce),
+        )
+        ack = self.emit(
+            jnp.where(at_me & (m.data["clock"] > 0),
+                      m.data["origin"], -1)[None],
+            self.typ("fwd_ack"), clock=m.data["clock"])
+        # forward: direct when the target is a neighbor, else walk
+        peers = self.active_peers(row)
+        in_view = jnp.any(peers == target)
+        nxt = ps.random_member(peers, prng.decision_key(key, 3),
+                               exclude=jnp.stack(
+                                   [m.src, me, m.data["origin"]]))
+        can_walk = ~in_view & (ttl > 0) & (nxt >= 0)
+        hop = jnp.where(in_view, target, jnp.where(can_walk, nxt, -1))
+        expired = ~at_me & ~in_view & ~can_walk
+        up = up.replace(relay_expired=up.relay_expired
+                        + expired.astype(jnp.int32))
+        fwd = self.emit(jnp.where(at_me, -1, hop)[None], self.typ("relay"),
+                        channel=m.channel, target=target,
+                        origin=m.data["origin"],
+                        ttl=jnp.maximum(ttl - 1, 0),
+                        server_ref=m.data["server_ref"],
+                        payload=m.data["payload"], clock=m.data["clock"],
+                        partition_key=m.data["partition_key"])
+        return self.up(row, up), self.merge(ack, fwd)
+
     def tick_upper(self, cfg, me, row: StackState, rnd, key):
         """Retransmit timer (pluggable :905-942): re-emit every outstanding
         slot whose age reaches the interval — floored at the simulated
@@ -184,14 +309,35 @@ class DataPlane(UpperProtocol):
         age, due = retransmit_due(up.out_valid, up.out_age,
                                   max(cfg.retransmit_interval, 3))
         row = self.up(row, up.replace(out_age=age))
-        em = self.emit(jnp.where(due, up.out_dst, -1), self.typ("fwd"),
+        if not cfg.broadcast:
+            em = self.emit(jnp.where(due, up.out_dst, -1), self.typ("fwd"),
+                           cap=self.tick_emit_cap, channel=up.out_chan,
+                           server_ref=up.out_ref, payload=up.out_pay,
+                           clock=up.out_seq, partition_key=up.out_pk)
+            return row, em
+        # relay-aware retransmit (the reference's retransmit re-enters
+        # forward_message, which itself tree-forwards when disconnected —
+        # pluggable :905-942 over :1309-1363): a due slot whose dst left
+        # the view re-enters the relay path through ONE random neighbor
+        # per attempt (width stays R; the walk spreads across retries)
+        peers = self.active_peers(row)
+        direct = jax.vmap(lambda d: jnp.any(peers == d))(up.out_dst) \
+            | (up.out_dst == me)
+        hops = jax.vmap(lambda j: ps.random_member(
+            peers, prng.decision_key(key, 100 + j)))(jnp.arange(self.R))
+        dsts = jnp.where(direct, up.out_dst, hops)
+        typs = jnp.where(direct, self.typ("fwd"), self.typ("relay"))
+        em = self.emit(jnp.where(due & (dsts >= 0), dsts, -1), typs,
                        cap=self.tick_emit_cap, channel=up.out_chan,
                        server_ref=up.out_ref, payload=up.out_pay,
-                       clock=up.out_seq, partition_key=up.out_pk)
+                       clock=up.out_seq, partition_key=up.out_pk,
+                       target=up.out_dst, origin=me,
+                       ttl=cfg.relay_ttl, rnonce=up.out_nonce)
         return row, em
 
     def health_counters(self, state: DataRow):
-        return {"fwd_send_dropped": jnp.sum(state.send_dropped)}
+        return {"fwd_send_dropped": jnp.sum(state.send_dropped),
+                "relay_expired": jnp.sum(state.relay_expired)}
 
     # ---------------------------------------------------------- host surface
 

@@ -1,0 +1,364 @@
+"""Dense-representation HyParView — the TPU-fast re-layout of the
+membership protocol itself (VERDICT r2 #1; the rumor-kernel recipe of
+ops/rumor_kernel.py applied to view state).
+
+``models/hyparview.py`` proves the full 17-message state machine
+(epoch/disconnect-id gates, TTL walks, reservation slots) against the
+reference, message for message; its COO message-passing shape is
+scatter-latency-bound on a chip (~17 rounds/s at N=4096, ROADMAP 1b).
+This module re-expresses ONE ROUND of the same protocol dynamics as
+whole-array operations over the packed view state — no per-message
+routing, no scatter conflicts, O(N·(A+P) + N log N) work per round:
+
+  repair     the reactive EXIT-prune + demote path (reference
+             hyparview :609-654, pluggable :971-984): an edge survives
+             iff both endpoints are alive and list each other; pruned
+             peers demote to the passive view (:926-972).  Because every
+             mutation below adds edges two-sided in the same round,
+             asymmetry arises exactly where the reference would have an
+             in-flight DISCONNECT: an eviction (or death) on one side is
+             seen by the other side one round later — the message delay
+             of the reference, without the message.
+  promote    the neighbor_request handshake (:975-1089) + periodic
+             random promotion (:542-561) + join retry: an under-min
+             node proposes to a random passive candidate; the candidate
+             accepts when it has room or the proposer is isolated
+             (priority HIGH, forcing a random eviction :1466-1512).
+             Proposals route to their targets with ONE sort
+             (reverse_select below) instead of per-message delivery.
+  shuffle    passive-view maintenance (:572-607, 1091-1136): the
+             ARWL-hop random walk runs as `arwl` chained gathers; the
+             walk endpoint and origin exchange mixed active/passive
+             samples and fold them into their passive views
+             (merge_exchange :1589-1595) — both directions, the reverse
+             one routed by the same sort trick.
+  churn      the fault plane of the big-N benchmark configs: Bernoulli
+             deaths and rebirths; a reborn node rejoins through a random
+             live contact seeded into its passive view (the join path).
+
+What is deliberately NOT carried over from the engine path (and why that
+is faithful): epoch/disconnect-id maps exist to reject STALE view ops
+arriving after churn — in a round-synchronous dense step every view op
+lands in the round it was made, so staleness is structurally impossible;
+TTL forward-join walks become the shuffle-walk + promotion pair, which is
+how the reference's own steady state maintains views once joins settle.
+The parity bar is distributional (SURVEY §7.3 "two RNG semantics"):
+tests/test_hyparview_dense.py asserts connectivity, symmetry and
+view-size distributions against the engine path at N=64-256.
+
+Scale: state is [N, A+P] int32; the only superlinear cost is three
+N-element sorts per round.  N=2^16 fits one chip comfortably; beyond
+that shard the node axis (parallel/mesh.py) — gathers become
+collective-permutes, the sorts become sharded sorts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..ops import padded_set as ps
+from ..ops.bitset import mix32 as _mix
+
+
+@struct.dataclass
+class DenseHvState:
+    active: jax.Array    # [N, A] padded peer set (symmetric at rest)
+    passive: jax.Array   # [N, P] padded peer set
+    alive: jax.Array     # [N] bool — churn plane
+    rnd: jax.Array       # scalar int32
+
+
+def dense_init(cfg: Config, seeds_per_node: int = 2) -> DenseHvState:
+    """Bootstrap: empty active views; each node's passive view seeded with
+    ``seeds_per_node`` random contacts (the orchestration-layer peer
+    discovery that hands every reference node its initial join targets,
+    orchestration.py / partisan_orchestration_backend.erl) — promotion
+    then performs the joins through the normal protocol path."""
+    n = cfg.n_nodes
+    key = jax.random.PRNGKey(cfg.seed ^ 0xD5E11)
+    seeds = jax.random.randint(key, (n, seeds_per_node), 0, n, jnp.int32)
+    # avoid self-contacts
+    seeds = jnp.where(seeds == jnp.arange(n, dtype=jnp.int32)[:, None],
+                      (seeds + 1) % n, seeds)
+    passive = jnp.full((n, cfg.max_passive_size), -1, jnp.int32)
+    passive = passive.at[:, :seeds_per_node].set(seeds)
+    return DenseHvState(
+        active=jnp.full((n, cfg.max_active_size), -1, jnp.int32),
+        passive=passive,
+        alive=jnp.ones((n,), bool),
+        rnd=jnp.int32(0),
+    )
+
+
+def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
+                   ) -> jax.Array:
+    """Route per-node proposals to their targets without scatter
+    conflicts: node i proposes to ``targets[i]`` (−1 = none); each target
+    learns up to ``c`` proposers, ties broken uniformly at random.
+    Returns ``[n, c]`` proposer ids (−1 pad).  One lexsort + one
+    searchsorted + one scatter — the ops/msg.build_inbox recipe with the
+    inbox collapsed to ids, O(n log n), no [n, n] anything."""
+    m = targets.shape[0]
+    valid = (targets >= 0) & (targets < n)
+    sk = jnp.where(valid, targets, n)
+    r = _mix(jnp.arange(m, dtype=jnp.uint32) ^ salt)
+    order = jnp.lexsort((r, sk))
+    st = sk[order]
+    starts = jnp.searchsorted(st, jnp.arange(n), side="left")
+    pos = jnp.arange(m) - starts[jnp.clip(st, 0, n - 1)]
+    ok = (st < n) & (pos < c)
+    flat = jnp.where(ok, st * c + jnp.clip(pos, 0, c - 1), n * c)
+    out = jnp.full((n * c + 1,), -1, jnp.int32)
+    out = out.at[flat].set(order.astype(jnp.int32))
+    return out[: n * c].reshape((n, c))
+
+
+def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
+    """views[idx] with idx < 0 yielding an all-empty row."""
+    n = views.shape[0]
+    rows = views[jnp.clip(idx, 0, n - 1)]
+    return jnp.where((idx >= 0)[..., None], rows, -1)
+
+
+def make_dense_round(cfg: Config, churn: float = 0.0):
+    """Compile one dense round: ``state -> state``.  Deterministic from
+    (cfg.seed, state.rnd) like the engine's rounds."""
+    N = cfg.n_nodes
+    A = cfg.max_active_size
+    P = cfg.max_passive_size
+    ids = jnp.arange(N, dtype=jnp.int32)
+
+    def nkeys(key, salt):
+        return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            jax.random.fold_in(key, salt), ids)
+
+    def bulk_passive_merge(active, passive, cands, key):
+        """Fold [N, K] candidate peers into the [N, P] passive views in
+        ONE fused op (add_to_passive_view :1422-1448: not me, not in
+        either view, random-evict when full).  A sequence of K
+        random-evict inserts ends at a random-ish subset of the union;
+        this computes that subset directly — random rank over the
+        deduplicated union, keep P — one sort + one top-P instead of
+        ~6K scatter/gather kernels (the N=2^16 round was launch-bound
+        on exactly those; the distributional parity tests cover the
+        substitution)."""
+        W = passive.shape[1] + cands.shape[1]
+        cat = jnp.concatenate([passive, cands], axis=1)       # [N, W]
+        ok = (cat >= 0) & (cat != ids[:, None])
+        ok &= ~jnp.any(cat[:, :, None] == active[:, None, :], axis=-1)
+        # dedup within the row: entry j is a duplicate iff an earlier
+        # valid column holds the same peer — a [W, W] pairwise compare
+        # vectorizes better on the VPU than row sorts (width ~64)
+        eq = (cat[:, :, None] == cat[:, None, :]) \
+            & ok[:, :, None] & ok[:, None, :]
+        earlier = jnp.arange(W)[:, None] > jnp.arange(W)[None, :]
+        ok &= ~jnp.any(eq & earlier[None, :, :], axis=2)
+        pri = jnp.where(ok, jax.random.uniform(key, cat.shape), -1.0)
+        _, keep = jax.lax.top_k(pri, passive.shape[1])
+        return jnp.take_along_axis(jnp.where(ok, cat, -1), keep, axis=1)
+
+    def step(state: DenseHvState) -> DenseHvState:
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed ^ 0xDE45E), state.rnd)
+        active, passive, alive = state.active, state.passive, state.alive
+
+        # ---- churn: restart-in-place, the BASELINE #5 fault plane (the
+        # rumor kernel's "fresh susceptibles": a churned node loses all
+        # state and rejoins through a contact, it does not linger dead —
+        # a Bernoulli ALIVE-flip would equilibrate at 50% standing dead,
+        # which is a different experiment).  Long-lived crashes remain
+        # expressible through the `alive` plane (faults.crash analog).
+        if churn > 0.0:
+            ck = jax.random.fold_in(key, 0)
+            reset = (jax.random.uniform(ck, (N,)) < churn) & alive
+            active = jnp.where(reset[:, None], -1, active)
+            contact = jax.random.randint(
+                jax.random.fold_in(key, 1), (N,), 0, N, jnp.int32)
+            contact = jnp.where(contact == ids, (contact + 1) % N, contact)
+            passive = jnp.where(reset[:, None], -1, passive)
+            passive = passive.at[:, 0].set(
+                jnp.where(reset, contact, passive[:, 0]))
+
+        # ---- repair: liveness + symmetry prune, demote to passive
+        peer_rows = _gather_rows(active, active)            # [N, A, A]
+        mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
+        ok_edge = (active >= 0) & alive[jnp.clip(active, 0, N - 1)] \
+            & mutual & alive[:, None]
+        pruned = jnp.where((active >= 0) & ~ok_edge
+                           & alive[jnp.clip(active, 0, N - 1)],
+                           active, -1)  # demote only live asymmetric peers
+        active = jnp.where(ok_edge, active, -1)
+        demote = [pruned]  # all passive-bound peers merge once, at the end
+
+        # ---- isolation re-subscribe: a live node with BOTH views empty
+        # has no protocol path back (its rebirth contact may itself have
+        # died) — reseed one random contact, retried every round until one is
+        # live (the SCAMP isolation-detection re-subscribe / configured
+        # join contact retry, scamp_v2 :130-178, pluggable :944-969)
+        lonely = alive & (jnp.sum(active >= 0, axis=1) == 0) \
+            & (jnp.sum(passive >= 0, axis=1) == 0)
+        fresh = jax.random.randint(
+            jax.random.fold_in(key, 40), (N,), 0, N, jnp.int32)
+        fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)
+        passive = passive.at[:, 0].set(
+            jnp.where(lonely, fresh, passive[:, 0]))
+
+        # ---- promotion / join (neighbor_request :975-1089)
+        sizes = jnp.sum(active >= 0, axis=1)
+        isolated = sizes == 0
+        due = (((state.rnd + ids) % cfg.random_promotion_interval) == 0) \
+            | isolated
+        cand = jax.vmap(ps.random_member)(passive, nkeys(key, 3))
+        in_act = jax.vmap(ps.contains)(active, cand)
+        cand = jnp.where(in_act, -1, cand)
+        # propose while under max_active: promotion doubles as the join
+        # path here (dense bootstrap has no separate join storm), and
+        # joins in the reference add at the target regardless of the
+        # proposer's fill level (:703-771); under-min urgency is carried
+        # by the priority bit instead
+        propose = alive & due & (sizes < A) & (cand >= 0)
+        target = jnp.where(propose, cand, -1)
+        # failed-connect analog: a proposal to a dead candidate is
+        # refused below AND the candidate is dropped from passive
+        # (the reference drops unconnectable promotion candidates)
+        t_dead = propose & ~alive[jnp.clip(target, 0, N - 1)]
+        passive = jnp.where(
+            (passive == jnp.where(t_dead, target, -2)[:, None]),
+            -1, passive)
+        chosen = reverse_select(
+            jnp.where(t_dead, -1, target),
+            jax.random.bits(jax.random.fold_in(key, 4), (), jnp.uint32),
+            N, 2)                                           # [N, 2]
+        acc = jnp.zeros((N, 2), bool)
+        for j in range(2):
+            p_j = chosen[:, j]
+            high = jnp.sum(_gather_rows(active, p_j[:, None])[:, 0] >= 0,
+                           axis=-1) == 0                    # proposer isolated
+            room = jnp.sum(active >= 0, axis=1) < A
+            a_j = (p_j >= 0) & alive & (room | high)
+            acc = acc.at[:, j].set(a_j)
+            kj = nkeys(key, 5 + j)
+            active, evicted, _ = jax.vmap(ps.insert_evict)(
+                active, jnp.where(a_j, p_j, -1), kj)
+            # eviction demotes the victim on the evictor's side
+            # (:1466-1512); the victim's own side heals at next repair
+            demote.append(evicted[:, None])
+        # proposer side: did my target accept me?
+        tc = jnp.clip(target, 0, N - 1)
+        accepted = propose & ~t_dead & (
+            ((chosen[tc, 0] == ids) & acc[tc, 0])
+            | ((chosen[tc, 1] == ids) & acc[tc, 1]))
+        active, ev2, _ = jax.vmap(ps.insert_evict)(
+            active, jnp.where(accepted, target, -1), nkeys(key, 9))
+        demote.append(ev2[:, None])
+        # (a promoted peer leaves the passive view automatically: the
+        # final bulk merge masks out every entry now present in active —
+        # move_peer_from_passive_to_active :1678-1709)
+
+        # ---- shuffle (passive_view_maintenance :572-607)
+        due_s = alive & (((state.rnd + ids) % cfg.shuffle_interval) == 0)
+        # every node's own sample: me ++ k_a active ++ k_p passive
+        samp = jnp.concatenate([
+            ids[:, None],
+            jax.vmap(ps.random_k, in_axes=(0, 0, None))(
+                active, nkeys(key, 11), cfg.shuffle_k_active),
+            jax.vmap(ps.random_k, in_axes=(0, 0, None))(
+                passive, nkeys(key, 12), cfg.shuffle_k_passive),
+        ], axis=1)                                          # [N, S]
+        # ARWL-hop walk through active views (one gather per hop)
+        e = ids
+        for h in range(cfg.arwl):
+            rows = _gather_rows(active, e)
+            kh = nkeys(key, 13 + h)
+            step_to = jax.vmap(
+                lambda r, k, ex: ps.random_member(r, k, exclude=ex)
+            )(rows, kh, jnp.stack([ids, e], axis=1))
+            e = jnp.where(step_to >= 0, step_to, e)
+        ep = jnp.where(due_s & (e != ids) & alive[jnp.clip(e, 0, N - 1)],
+                       e, -1)
+        # forward merge: origin folds the endpoint's sample (shuffle_reply)
+        fwd_samp = jnp.where((ep >= 0)[:, None],
+                             samp[jnp.clip(ep, 0, N - 1)], -1)
+        demote.append(fwd_samp)
+        # reverse merge: endpoints fold origin samples (the shuffle body),
+        # up to 2 origins per endpoint per round (collisions wait for the
+        # next stagger slot — the engine path serializes them the same way
+        # through the inbox)
+        rchosen = reverse_select(
+            ep, jax.random.bits(jax.random.fold_in(key, 31), (), jnp.uint32),
+            N, 2)
+        for j in range(2):
+            o_j = rchosen[:, j]
+            demote.append(jnp.where((o_j >= 0)[:, None],
+                                    samp[jnp.clip(o_j, 0, N - 1)], -1))
+
+        # ---- single fused passive merge for every phase's candidates
+        passive = bulk_passive_merge(
+            active, passive, jnp.concatenate(demote, axis=1),
+            jax.random.fold_in(key, 50))
+
+        return DenseHvState(active=active, passive=passive, alive=alive,
+                            rnd=state.rnd + 1)
+
+    return jax.jit(step)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def run_dense(state: DenseHvState, n_rounds: int, cfg: Config,
+              churn: float = 0.0) -> DenseHvState:
+    """Whole-run-on-device: lax.scan over rounds (the benchmark path)."""
+    step = make_dense_round(cfg, churn)
+
+    def body(s, _):
+        return step(s), None
+
+    out, _ = jax.lax.scan(body, state, None, length=n_rounds)
+    return out
+
+
+# ------------------------------------------------------------- health
+
+def connectivity(state: DenseHvState) -> Dict[str, jax.Array]:
+    """On-device health: BFS reachability over the active overlay from
+    node 0 (restricted to live nodes), symmetry rate, view-size stats —
+    the hyparview_membership_check (test/partisan_SUITE.erl:2044-2109)
+    as array reductions."""
+    active, alive = state.active, state.alive
+    n = active.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    # BFS via repeated gather-OR: log-diameter iterations suffice; cap at
+    # 2*ceil(log2 n) + 2 for safety
+    iters = 2 * max(int(jnp.ceil(jnp.log2(max(n, 2)))), 1) + 2
+    start = jnp.argmax(alive).astype(jnp.int32)  # some live node
+    reach = ids == start
+
+    def body(_, r):
+        nb = _gather_rows(active, jnp.where(r, ids, -1))  # rows of reached
+        hit = jnp.zeros((n,), bool).at[
+            jnp.clip(nb, 0, n - 1)].max(nb >= 0, mode="drop")
+        return r | (hit & alive)
+
+    reach = jax.lax.fori_loop(0, iters, body, reach)
+    peer_rows = _gather_rows(active, active)
+    mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
+    occ = active >= 0
+    sizes = jnp.sum(occ, axis=1)
+    live = jnp.sum(alive)
+    return {
+        "connected": jnp.sum(reach & alive) == live,
+        "reached": jnp.sum(reach & alive),
+        "live": live,
+        "symmetry": jnp.sum(mutual & occ) / jnp.maximum(jnp.sum(occ), 1),
+        "mean_active": jnp.sum(jnp.where(alive, sizes, 0))
+        / jnp.maximum(live, 1),
+        "isolated": jnp.sum(alive & (sizes == 0)),
+        "mean_passive": jnp.sum(jnp.where(
+            alive, jnp.sum(state.passive >= 0, axis=1), 0))
+        / jnp.maximum(live, 1),
+    }

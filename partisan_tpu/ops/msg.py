@@ -182,6 +182,38 @@ def monotonic_elide(m: Msgs, n_nodes: int, mono_mask: jax.Array,
     return m.replace(valid=m.valid & keep)
 
 
+def _route(m: Msgs, n_nodes: int, inbox_cap: int,
+           key: Optional[jax.Array],
+           n_channels: int, parallelism: int):
+    """Shared routing core of build_inbox / build_inbox_idx: stable
+    lexsort by destination, then per-connection random, then emission
+    round + position (stability) — delivery order randomized ACROSS
+    connections but FIFO WITHIN a (src, dst, channel, lane) connection,
+    TCP's guarantee.  Returns (order, ok, overflow, flat_idx, dump):
+    sorted-position i holds message ``order[i]``; ``flat_idx[i]`` is its
+    [N * cap (+1 dump)] inbox cell."""
+    M = m.cap
+    deliver = m.valid & (m.delay <= 0)
+    sort_key = jnp.where(deliver, m.dst, n_nodes)  # undeliverable -> end
+    if key is not None:
+        salt = jax.random.bits(key, (), jnp.uint32)
+        grand = _mix(jnp.uint32(_conn_key(m, n_nodes, n_channels,
+                                          parallelism)) ^ salt)
+    else:
+        grand = jnp.zeros((M,), jnp.uint32)
+    order = jnp.lexsort((m.born, grand, sort_key))
+    sdst = sort_key[order]
+    starts = jnp.searchsorted(sdst, jnp.arange(n_nodes), side="left")
+    pos = jnp.arange(M) - starts[jnp.clip(sdst, 0, n_nodes - 1)]
+    ok = (sdst < n_nodes) & (pos < inbox_cap)
+    overflow = jnp.sum((sdst < n_nodes)
+                       & (pos >= inbox_cap)).astype(jnp.int32)
+    dump = n_nodes * inbox_cap  # one trash slot for masked-out writes
+    flat_idx = jnp.where(ok, jnp.clip(sdst, 0, n_nodes - 1) * inbox_cap
+                         + jnp.clip(pos, 0, inbox_cap - 1), dump)
+    return order, ok, overflow, flat_idx, dump
+
+
 def build_inbox(
     m: Msgs, n_nodes: int, inbox_cap: int,
     key: Optional[jax.Array] = None,
@@ -199,37 +231,14 @@ def build_inbox(
     reference's nondeterministic network interleaving (the trace orchestrator's
     whole job is taming exactly this, src/partisan_trace_orchestrator.erl);
     with a fixed key the schedule is deterministic and replayable.  Order is
-    randomized ACROSS connections but FIFO WITHIN a (src, dst, channel,
-    lane) connection — TCP's guarantee, which the reference gets from its
-    per-connection gen_server send loops.
+    randomized ACROSS connections but FIFO WITHIN a connection — see
+    :func:`_route`.
     """
-    M = m.cap
-    deliver = m.valid & (m.delay <= 0)
-    held_valid = m.valid & (m.delay > 0)
-    held = m.replace(valid=held_valid, delay=jnp.maximum(m.delay - 1, 0))
-
-    sort_key = jnp.where(deliver, m.dst, n_nodes)  # undeliverable -> end
-    if key is not None:
-        salt = jax.random.bits(key, (), jnp.uint32)
-        grand = _mix(jnp.uint32(_conn_key(m, n_nodes, n_channels,
-                                          parallelism)) ^ salt)
-    else:
-        grand = jnp.zeros((M,), jnp.uint32)
-    # stable lexsort: by destination, then per-connection random, then
-    # emission round + position (stability) => FIFO inside a connection
-    # even when delayed (held) traffic mixes with fresh emissions
-    order = jnp.lexsort((m.born, grand, sort_key))
+    held = m.replace(valid=m.valid & (m.delay > 0),
+                     delay=jnp.maximum(m.delay - 1, 0))
+    order, ok, overflow, flat_idx, dump = _route(
+        m, n_nodes, inbox_cap, key, n_channels, parallelism)
     ms = _take(m, order)
-    sdst = sort_key[order]
-
-    starts = jnp.searchsorted(sdst, jnp.arange(n_nodes), side="left")
-    pos = jnp.arange(M) - starts[jnp.clip(sdst, 0, n_nodes - 1)]
-    ok = (sdst < n_nodes) & (pos < inbox_cap)
-    overflow = jnp.sum((sdst < n_nodes) & (pos >= inbox_cap)).astype(jnp.int32)
-
-    dump = n_nodes * inbox_cap  # one trash slot for masked-out writes
-    flat_idx = jnp.where(ok, jnp.clip(sdst, 0, n_nodes - 1) * inbox_cap
-                         + jnp.clip(pos, 0, inbox_cap - 1), dump)
 
     def scatter(x: jax.Array) -> jax.Array:
         out = jnp.zeros((dump + 1,) + x.shape[1:], dtype=x.dtype)
@@ -239,6 +248,33 @@ def build_inbox(
     inbox = jax.tree_util.tree_map(scatter, ms)
     inbox = inbox.replace(valid=scatter(ok))
     return inbox, held, overflow
+
+
+def build_inbox_idx(
+    m: Msgs, n_nodes: int, inbox_cap: int,
+    key: Optional[jax.Array] = None,
+    n_channels: int = 1, parallelism: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Index-form routing: :func:`build_inbox`'s sort, but the inbox holds
+    flat-buffer INDICES ``[N, inbox_cap] int32`` (empty slot = ``m.cap``)
+    plus a ``[N, inbox_cap] bool`` validity mask, instead of materializing
+    every payload field at ``[N, inbox_cap, ...]``.  The engine gathers
+    fields from the flat buffer at delivery time, and only for slots/rows
+    that actually hold a message — at big N x wide payloads the full
+    materialization dominated the round (measured: SCAMP N=1024
+    inbox_cap=16 spent ~40% of its round there; ROADMAP r3).  Held
+    (delayed) traffic is split by the caller (engine), so unlike
+    build_inbox this returns no held buffer.  Returns
+    ``(idx, valid, overflow)``; delivery order semantics are identical to
+    build_inbox by construction — both consume :func:`_route`.
+    """
+    order, ok, overflow, flat_idx, dump = _route(
+        m, n_nodes, inbox_cap, key, n_channels, parallelism)
+    idx = jnp.full((dump + 1,), m.cap, jnp.int32).at[flat_idx].set(
+        order.astype(jnp.int32))[:dump].reshape((n_nodes, inbox_cap))
+    vld = jnp.zeros((dump + 1,), bool).at[flat_idx].set(
+        ok)[:dump].reshape((n_nodes, inbox_cap))
+    return idx, vld, overflow
 
 
 def inject(buf: Msgs, em: Msgs, src) -> Tuple[Msgs, jax.Array]:

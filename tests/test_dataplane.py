@@ -159,3 +159,69 @@ class TestPayloadHelpers:
             world, _ = step(world)
         assert ps.receive_messages(world, proto, 2)[0] == \
             [(0, 3, [8, 0, 0, 0])]
+
+
+class TestTransitiveRelay:
+    """Tree-forward relay fallback (pluggable :1500-1539, hyparview
+    :1138-1163): an app message whose direct edge is cut still reaches a
+    destination OUTSIDE the sender's partial view by relaying through a
+    live common neighbor (VERDICT r2 missing #2)."""
+
+    def boot(self, broadcast, seed=3):
+        from partisan_tpu.verify import faults
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, shuffle_interval=4,
+                        broadcast=broadcast, seed=seed)
+        lower = HyParView(cfg)
+        proto = Stacked(lower, DataPlane(cfg))
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto, [(i, 0) for i in range(1, 16)])
+        return cfg, proto, world
+
+    def pick_nonneighbor(self, world, src):
+        act = np.asarray(world.state.lower.active[src])
+        peers = {int(p) for p in act if p >= 0}
+        for t in range(16):
+            if t != src and t not in peers:
+                return t
+        raise AssertionError("active view covers all nodes")
+
+    def test_partial_partition_delivers_via_relay(self):
+        from partisan_tpu.verify import faults
+        cfg, proto, world = self.boot(broadcast=True)
+        warm = pt.make_step(cfg, proto, donate=False)
+        for _ in range(20):
+            world, _ = warm(world)
+        src = 2
+        dst = self.pick_nonneighbor(world, src)
+        # cut the direct edge src->dst (a partial partition: every other
+        # path stays up); the relay must route around it
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_recv=faults.send_omission(
+                                src=src, dst=dst))
+        world = ps.forward_message(world, proto, src=src, dst=dst,
+                                   server_ref=9, payload=[1, 2])
+        for _ in range(2 + cfg.relay_ttl * 2):
+            world, _ = step(world)
+        recs, _, _ = ps.receive_messages(world, proto, dst)
+        assert (src, 9, [1, 2, 0, 0]) in recs, (src, dst, recs)
+
+    def test_without_broadcast_the_same_cut_loses_the_message(self):
+        """The control: relay disabled -> the blocked direct edge is the
+        only route and the message is lost (the reference behaves the
+        same with broadcast disabled, pluggable :1335-1341)."""
+        from partisan_tpu.verify import faults
+        cfg, proto, world = self.boot(broadcast=False)
+        warm = pt.make_step(cfg, proto, donate=False)
+        for _ in range(20):
+            world, _ = warm(world)
+        src = 2
+        dst = self.pick_nonneighbor(world, src)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_recv=faults.send_omission(
+                                src=src, dst=dst))
+        world = ps.forward_message(world, proto, src=src, dst=dst,
+                                   server_ref=9, payload=[1, 2])
+        for _ in range(12):
+            world, _ = step(world)
+        recs, _, _ = ps.receive_messages(world, proto, dst)
+        assert (src, 9, [1, 2, 0, 0]) not in recs
