@@ -332,19 +332,27 @@ def connectivity(state: DenseHvState) -> Dict[str, jax.Array]:
     active, alive = state.active, state.alive
     n = active.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
-    # BFS via repeated gather-OR: log-diameter iterations suffice; cap at
-    # 2*ceil(log2 n) + 2 for safety
-    iters = 2 * max(int(jnp.ceil(jnp.log2(max(n, 2)))), 1) + 2
+    # BFS via gather-OR to FIXPOINT: one hop per iteration, stop when
+    # the reached set stops growing (a capped loop would misreport
+    # long-diameter degraded overlays as disconnected)
     start = jnp.argmax(alive).astype(jnp.int32)  # some live node
-    reach = ids == start
+    reach0 = ids == start
 
-    def body(_, r):
+    def expand(r):
         nb = _gather_rows(active, jnp.where(r, ids, -1))  # rows of reached
         hit = jnp.zeros((n,), bool).at[
             jnp.clip(nb, 0, n - 1)].max(nb >= 0, mode="drop")
         return r | (hit & alive)
 
-    reach = jax.lax.fori_loop(0, iters, body, reach)
+    def cond(c):
+        return c[1]
+
+    def body(c):
+        r, _ = c
+        r2 = expand(r)
+        return r2, jnp.any(r2 != r)
+
+    reach, _ = jax.lax.while_loop(cond, body, (reach0, jnp.bool_(True)))
     peer_rows = _gather_rows(active, active)
     mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
     occ = active >= 0

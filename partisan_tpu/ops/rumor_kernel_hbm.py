@@ -13,19 +13,27 @@ the two HBM state buffers, not DMA double buffering.  Measured
 roll-compute-bound: ~13.6k rounds/s at 2^22, ~6.3k at 2^24, ~2.7k at
 2^26 on one chip — N is VMEM-unbounded (scales to ~10^8).
 
-Rendezvous decomposition: the flat-roll delivery of the VMEM kernel
-(partner = node + s mod n) would make every output block depend on an
-UNALIGNED window of two input blocks.  Instead the per-(round, fanout)
-shift decomposes as ``(q, r)``: partner = (block + q mod nb,
-offset + r mod BC) — a block-cyclic roll composed with an intra-block
-bit rotation.  Both factors are drawn uniformly (q over blocks, r over
-block bits), so the composite is a uniformly-drawn member of a
-permutation family with the same rendezvous statistics as the flat roll
-(each (q, r) IS a bijection of nodes; q aligns the DMA windows to block
-boundaries).  Shifts and restart patient-zeros are drawn HOST-side with
-jax.random and ride the scalar-prefetch lane, which also makes the
-deterministic configs (churn = 0) interpret-mode testable; only churn
-bits use the on-core PRNG.
+Rendezvous decomposition (round 3 — VERDICT r2 #4): the flat-roll
+delivery of the VMEM kernel (partner = node + s mod n) would make every
+output block depend on an UNALIGNED window of two input blocks.  The
+per-(round, fanout) shift decomposes as ``(q, r)``: partner =
+(row + q mod R, bit + r mod CELL) — a ROW translation composed with an
+intra-ROW bit rotation.  Both factors are drawn uniformly (q over all R
+rows, r over the 4096 bits of a row), so the composite is a
+uniformly-drawn member of a permutation family with the same rendezvous
+statistics as the flat roll (each (q, r) IS a bijection of nodes).  The
+round-2 version kept q block-aligned and paid for the residual row
+component with DYNAMIC axis-0 ``pltpu.roll``s on every [B, 128] window —
+the measured bottleneck ("roll-compute-bound", ROADMAP #2).  Now the row
+component rides the DMA source offset instead: the state buffers carry a
+B-row HALO (rows R..R+B-1 mirror rows 0..B-1, rewritten by block 0 each
+round), so any B-row window starting in [0, R) reads without wrap, and
+the in-VMEM work drops to ONE dynamic lane rotation (axis 1) plus a
+static ±1 lane roll per fanout — no dynamic row rolls at all.  Shifts
+and restart patient-zeros are drawn HOST-side with jax.random and ride
+the scalar-prefetch lane, which also makes the deterministic configs
+(churn = 0) interpret-mode testable; only churn bits use the on-core
+PRNG.
 
 State ping-pongs between two HBM buffers by round parity (reads hit the
 previous round's buffer while writes fill the other), so there is no
@@ -44,15 +52,26 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .rumor_kernel import (CELL, LANES, _bernoulli_words,
-                           _flat_bit_roll, pz_bit)
+from .rumor_kernel import CELL, LANES, WORD, _bernoulli_words, pz_bit
+
+
+def _row_bit_roll(x: jax.Array, s: jax.Array) -> jax.Array:
+    """Rotation of each ROW's 4096 bits: out bit j = in bit
+    (j - s) mod CELL.  One dynamic lane roll + one static lane roll —
+    the whole point of the halo/row-offset decomposition is that no
+    dynamic axis-0 roll survives."""
+    q = s // WORD
+    r = (s % WORD).astype(jnp.uint32)
+    xw = pltpu.roll(x, q, axis=1)
+    prev = pltpu.roll(xw, 1, axis=1)
+    carry = prev >> jnp.where(r == 0, jnp.uint32(1), jnp.uint32(WORD) - r)
+    return jnp.where(r == 0, xw, (xw << r) | carry)
 
 
 def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
             # scratch
             w_hot, w_alive, w_dup, b_inf, b_hot, b_alive, hotcnt, sems,
-            *, nb, B, fanout, stop_k, churn, all_alive):
-    BC = B * CELL
+            *, nb, B, R, fanout, stop_k, churn, all_alive):
     i = pl.program_id(0)          # round
     b = pl.program_id(1)          # block
     base = i * (2 * fanout + 2)   # per-round scalar record
@@ -63,23 +82,24 @@ def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
         d.start()
         return d
 
-    # ---- gather: shifted hot/alive windows + own-block state.
+    # ---- gather: row-shifted hot/alive windows + own-block state.
     # reads go to the PREVIOUS round's buffer (ping-pong by parity);
-    # round 0 reads the pristine inputs.
+    # round 0 reads the pristine inputs.  Windows start at an arbitrary
+    # row in [0, R); the B-row halo guarantees no wrap.
     def window_reads(inf_src, hot_src):
         ds = []
         for j in range(fanout):
-            q = sref[base + 2 * j]
-            src_b = jax.lax.rem(b - q + nb, nb)
-            ds.append(cp(hot_src.at[pl.ds(src_b * B, B)],
+            q = sref[base + 2 * j]            # row offset, [0, R)
+            src_r = jax.lax.rem(b * B + R - q, R)
+            ds.append(cp(hot_src.at[pl.ds(src_r, B)],
                          w_hot.at[j], 2 * j))
             if not all_alive:
-                ds.append(cp(alive.at[pl.ds(src_b * B, B)],
+                ds.append(cp(alive.at[pl.ds(src_r, B)],
                              w_alive.at[j], 2 * j + 1))
-        # dup feedback window: roll(inf, -s0) -> read block (b + q0)
+        # dup feedback window: the inverse translation -> rows (+q0)
         q0 = sref[base]
-        dup_b = jax.lax.rem(b + q0, nb)
-        ds.append(cp(inf_src.at[pl.ds(dup_b * B, B)], w_dup, 2 * fanout))
+        dup_r = jax.lax.rem(b * B + q0, R)
+        ds.append(cp(inf_src.at[pl.ds(dup_r, B)], w_dup, 2 * fanout))
         ds.append(cp(inf_src.at[pl.ds(b * B, B)], b_inf, 2 * fanout + 1))
         ds.append(cp(hot_src.at[pl.ds(b * B, B)], b_hot, 2 * fanout + 2))
         if not all_alive:
@@ -113,9 +133,9 @@ def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
     # ---- one round for this block
     hit = jnp.zeros((B, LANES), jnp.uint32)
     for j in range(fanout):
-        r = sref[base + 2 * j + 1]
+        r = sref[base + 2 * j + 1]            # intra-row bits, [1, CELL)
         send_w = w_hot[j] if all_alive else (w_hot[j] & w_alive[j])
-        hit = hit | _flat_bit_roll(send_w, r, BC)
+        hit = hit | _row_bit_roll(send_w, r)
 
     inf = b_inf[:]
     hot = b_hot[:]
@@ -123,7 +143,7 @@ def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
     send = hot & al
     new_inf = inf | (hit & al)
     r0 = sref[base + 1]
-    dup = _flat_bit_roll(w_dup[:], BC - jax.lax.rem(r0, BC), BC) & send
+    dup = _row_bit_roll(w_dup[:], CELL - r0) & send
     newly = new_inf & ~inf
     new_hot = hot | newly
     if stop_k <= 1:
@@ -160,6 +180,16 @@ def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
                                    sems.at[2 * fanout + 5])
         d1.start(); d2.start()
         d1.wait(); d2.wait()
+        # block 0 also refreshes the halo mirror (rows R..R+B-1), which
+        # is what lets every window read skip wrap handling
+        @pl.when(b == 0)
+        def _():
+            h1 = pltpu.make_async_copy(b_inf, inf_dst.at[pl.ds(R, B)],
+                                       sems.at[2 * fanout + 4])
+            h2 = pltpu.make_async_copy(b_hot, hot_dst.at[pl.ds(R, B)],
+                                       sems.at[2 * fanout + 5])
+            h1.start(); h2.start()
+            h1.wait(); h2.wait()
 
     @pl.when(even)
     def _():
@@ -191,20 +221,23 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
     assert n_rounds >= 1
 
     # host-side randomness: per-(round, fanout) (q, r) + seed + patient
-    # zero, packed as one int32 scalar-prefetch record per round
+    # zero, packed as one int32 scalar-prefetch record per round.
+    # q = row translation over ALL R rows (the DMA offset), r = intra-row
+    # bit rotation — see the decomposition note in the module docstring.
     key = jax.random.fold_in(jax.random.PRNGKey(0xB10C), packed.rnd)
     kq, kr, kp, ks = jax.random.split(key, 4)
-    q = jax.random.randint(kq, (n_rounds, fanout), 0, nb, jnp.int32)
-    r = jax.random.randint(kr, (n_rounds, fanout), 1, B * CELL, jnp.int32)
+    q = jax.random.randint(kq, (n_rounds, fanout), 0, R, jnp.int32)
+    r = jax.random.randint(kr, (n_rounds, fanout), 1, CELL, jnp.int32)
     pz = jax.random.randint(kp, (n_rounds,), 0, n, jnp.int32)
     seeds = jax.random.randint(ks, (n_rounds,), 0, 1 << 30, jnp.int32)
     qr = jnp.stack([q, r], axis=-1).reshape(n_rounds, 2 * fanout)
     sref = jnp.concatenate(
         [qr, seeds[:, None], pz[:, None]], axis=1).reshape(-1)
 
-    shape = (R, LANES)
-    re2 = lambda x: x.reshape(shape)
-    kern = functools.partial(_kernel, nb=nb, B=B, fanout=fanout,
+    shape = (R + B, LANES)     # +B = the halo mirror of rows 0..B-1
+    halo = lambda x: jnp.concatenate(
+        [x.reshape(R, LANES), x.reshape(R, LANES)[:B]], axis=0)
+    kern = functools.partial(_kernel, nb=nb, B=B, R=R, fanout=fanout,
                              stop_k=stop_k, churn=churn,
                              all_alive=all_alive)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -234,10 +267,10 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(sref, re2(packed.infected), re2(packed.hot), re2(packed.alive))
+    )(sref, halo(packed.infected), halo(packed.hot), halo(packed.alive))
 
     inf, hot = (inf_a, hot_a) if (n_rounds - 1) % 2 == 0 else (inf_b, hot_b)
     from ..models.demers import RumorWorldPacked
     return RumorWorldPacked(
-        infected=inf.reshape(-1), hot=hot.reshape(-1),
+        infected=inf[:R].reshape(-1), hot=hot[:R].reshape(-1),
         alive=packed.alive, rnd=packed.rnd + n_rounds)

@@ -16,23 +16,24 @@ from partisan_tpu.ops.rumor_kernel_hbm import rumor_run_hbm
 
 def numpy_reference(inf, hot, alive, rounds, n, fanout, B_rows, start_rnd):
     """The kernel's exact semantics on unpacked bool arrays: per (round,
-    fanout) a block-cyclic roll q + intra-block bit rotation r (same
-    host-side draws), stop_k=1 push-ack feedback, one-round-delayed
+    fanout) a ROW translation q + intra-row bit rotation r (same
+    host-side draws; the round-3 halo decomposition — independent of the
+    kernel's block_rows), stop_k=1 push-ack feedback, one-round-delayed
     restart reseed."""
-    BC = B_rows * CELL
-    nb = n // BC
+    del B_rows  # the permutation no longer depends on the DMA blocking
+    R = n // CELL
     key = jax.random.fold_in(jax.random.PRNGKey(0xB10C), start_rnd)
     kq, kr, kp, _ = jax.random.split(key, 4)
-    q = np.asarray(jax.random.randint(kq, (rounds, fanout), 0, nb))
-    r = np.asarray(jax.random.randint(kr, (rounds, fanout), 1, BC))
+    q = np.asarray(jax.random.randint(kq, (rounds, fanout), 0, R))
+    r = np.asarray(jax.random.randint(kr, (rounds, fanout), 1, CELL))
     pz = np.asarray(jax.random.randint(kp, (rounds,), 0, n))
 
     def perm_roll(x, qi, ri):
-        """bit j of result = bit at (block j//BC - qi, offset j%BC - ri)."""
-        blocks = x.reshape(nb, BC)
-        blocks = np.roll(blocks, qi, axis=0)     # block-cyclic part
-        blocks = np.roll(blocks, ri, axis=1)     # intra-block rotation
-        return blocks.reshape(-1)
+        """bit j of result = bit at (row j//CELL - qi, bit j%CELL - ri)."""
+        rows = x.reshape(R, CELL)
+        rows = np.roll(rows, qi, axis=0)         # row translation
+        rows = np.roll(rows, ri, axis=1)         # intra-row rotation
+        return rows.reshape(-1)
 
     prev_hot_alive = None
     for i in range(rounds):
