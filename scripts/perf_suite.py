@@ -142,7 +142,7 @@ def main() -> None:
         time_engine("scamp_v2_r1cfg", cfg1, ScampV2(cfg1), max(R, 150),
                     scamp_health, rows)
 
-    if want("hv_dense"):
+    if want("hv_dense") and jax.devices()[0].platform == "tpu":
         # VERDICT r3 #1: the dense-representation HyParView re-layout —
         # membership itself TPU-fast (bar: N=4096 >= 100 rounds/s on the
         # chip; engine-path COO measured ~17, ROADMAP 1b).  1%/round
@@ -187,7 +187,7 @@ def main() -> None:
                          f"churn=0.01"])
             print(f"{name:28s} N={n:<7d} {rps:9.1f} rounds/s  ({health})")
 
-    if want("pt_dense"):
+    if want("pt_dense") and jax.devices()[0].platform == "tpu":
         # VERDICT r2 weak #6: broadcast layer at TPU scale — plumtree
         # over the DENSE HyParView (fused membership+broadcast scan)
         # with 1%/round churn, plus a single-shot coverage-depth row.

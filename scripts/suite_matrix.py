@@ -617,7 +617,7 @@ def port_hyparview_partition_test():
     assert pc.call((_A("partition"),
                     [list(range(8)), list(range(8, 16))])) == _A("ok")
     pc.advance(10)
-    assert pc.call(_A("resolve_partition")) == _A("ok")
+    assert pc.call((_A("resolve_partition"),)) == _A("ok")
     pc.advance(30)
     assert bool(graph.is_connected(_port_adjacency(pc, n))), \
         "overlay did not heal through the port path"
