@@ -64,15 +64,19 @@ def autotune(cfg: Config, proto: "ProtocolBase") -> Config:
     buffer is small — leave everything alone.  At N >= 512 the dominant
     costs are the [N, K*E] emission flatten/argsort and full-batch
     handler dispatch, so switch to the running-offset collect
-    (node_emit_cap) and chunked-gather delivery (deliver_gather_cap) at
-    the measured-optimal widths.  8 is a *budget*, not a bound on
-    correctness: steady-state gossip emits ~O(1) messages per node per
-    round; bursts beyond it are dropped-and-counted (out_dropped) and
-    every shipped protocol's periodic repair absorbs the loss (measured:
-    SCAMP v2 N=1024 converges connected at 51-59 rounds/s with this
-    shape vs 1.4 untuned).  Protocols that genuinely sustain wider
-    per-node emission set the knobs explicitly (they always win), or set
-    auto_tune=False / deliver_gather_cap=0 to keep the dense paths.
+    (node_emit_cap) and chunked-gather delivery (deliver_gather_cap).
+    The emission budget comes from the protocol's ``autotune_emit_hint``
+    (default 8, the measured steady-state optimum): steady-state gossip
+    emits ~O(1) messages per node per round and bursts beyond the budget
+    are dropped-and-counted (out_dropped), but a protocol whose
+    FIDELITY needs wider bursts declares it — SCAMP's join-storm
+    contact must fan each staggered subscription to its whole partial
+    view in one round, so ScampV1/V2 declare 32 (8 starved the walks to
+    a near-star overlay; 32 preserves the view-size distribution at
+    ~10x the uncapped rate — tests/test_scamp.py
+    test_scamp_v2_1024_nodes).  Protocols that sustain wider emission
+    set the knobs explicitly (they always win), or set auto_tune=False
+    / deliver_gather_cap=0 to keep the dense paths.
 
     init_world and make_step both route through this, so the scan-carry
     buffer shape always agrees between them.
@@ -81,10 +85,12 @@ def autotune(cfg: Config, proto: "ProtocolBase") -> Config:
         return cfg
     kw = {}
     if cfg.node_emit_cap is None:
-        # 8 is the measured-optimal budget; a protocol whose true
+        # the protocol's declared burst budget (default 8, the
+        # measured-optimal steady-state width); a protocol whose true
         # per-round maximum is smaller keeps its exact bound
         kw["node_emit_cap"] = min(
-            8, cfg.inbox_cap * proto.emit_cap + proto.tick_emit_cap)
+            proto.autotune_emit_hint,
+            cfg.inbox_cap * proto.emit_cap + proto.tick_emit_cap)
     if cfg.deliver_gather_cap is None and cfg.deliver_gate:
         kw["deliver_gather_cap"] = 8
     return cfg.replace(**kw) if kw else cfg
@@ -134,6 +140,12 @@ class ProtocolBase:
     emit_cap: int = 4
     tick_emit_cap: int = 4
     ctl_peer_field: str = "peer"  # payload field carrying ctl_join/leave target
+    # per-node per-round emission budget :func:`autotune` grants when the
+    # user leaves node_emit_cap unset.  8 covers steady-state gossip
+    # (~O(1) emissions/node/round); a protocol whose correctness depends
+    # on wider BURSTS — e.g. SCAMP's join-storm subscription fanout —
+    # raises it (speed traded for fidelity; see the autotune docstring)
+    autotune_emit_hint: int = 8
 
     def typ(self, name: str) -> int:
         # _typ_offset is set by models/stack.Stacked so a stacked upper

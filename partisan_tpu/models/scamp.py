@@ -89,6 +89,14 @@ class ScampV1(ProtocolBase):
         # merge would run an argsort per node per ROUND (the dominant
         # steady-state cost at N=1024, scripts/profile_engine.py)
         self.tick_emit_cap = self.P + 1 + self.emit_cap
+        # autotune burst budget: a join-storm contact must re-forward
+        # each staggered subscription to its whole partial view plus
+        # c + 1 extra copies in the round it arrives (join, v2 :64-117)
+        # — 8/round starves the walks and the overlay settles near a
+        # star (measured: mean view 1.7 vs 2.5 uncapped at N=1024);
+        # 32 preserves the view-size distribution at ~10x the uncapped
+        # round rate
+        self.autotune_emit_hint = 32
 
     # ------------------------------------------------------------------ state
 

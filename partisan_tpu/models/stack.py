@@ -90,6 +90,12 @@ class Stacked(ProtocolBase):
         self.emit_cap = max(lower.emit_cap, upper.emit_cap)
         self.tick_emit_cap = lower.tick_emit_cap + upper.tick_emit_cap
         self.ctl_peer_field = lower.ctl_peer_field
+        # sum, not max, for the same reason tick_emit_cap sums: during a
+        # lower-layer burst (e.g. SCAMP's join storm) a max-sized budget
+        # would let the lower layer consume every slot and starve the
+        # upper layer's same-round emissions
+        self.autotune_emit_hint = \
+            lower.autotune_emit_hint + upper.autotune_emit_hint
         # rewire both sub-protocols to emit in the stacked message space
         # (recursively: a lower that is itself a Stacked propagates the
         # unioned spec/caps down to ITS sub-protocols, so three-layer

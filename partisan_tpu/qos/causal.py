@@ -230,7 +230,7 @@ class CausalDelivery(ProtocolBase):
             f"causal labels carry dense [N] clocks and [N, N] order "
             f"buffers per node (O(N^3) total); a causal label over "
             f"{cfg.n_nodes} > 128 nodes needs the sparse-clock path "
-            f"(qos/dvv.py)")
+            f"(qos/causal_sparse.py CausalDeliverySparse)")
         a = cfg.n_nodes
         self.data_spec: Dict = {
             "payload": ((), jnp.int32),

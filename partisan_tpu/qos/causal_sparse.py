@@ -1,0 +1,244 @@
+"""Sparse-clock causal delivery — ``src/partisan_causality_backend.erl``
+re-laid over the fixed-slot sparse clocks of qos/dvv.py (ROADMAP 8: the
+scaling escape from qos/causal.py's dense ``[A]`` clocks and ``[A, A]``
+order buffers).
+
+The dense rebuild (qos/causal.py) is exact but O(N³) in total state, so
+it carries a construction-time N ≤ 128 guard.  This variant keeps the
+reference's *actual* data shape: orddict clocks whose size tracks the
+causal history, not the cluster (``src/partisan_vclock.erl`` — entries
+exist only for actors that incremented), and an order buffer keyed by
+the destinations actually written to (``src/partisan_causality_backend.erl``
+:115-139 — an orddict from peer to last-sent clock).  Under fixed TPU
+shapes that becomes:
+
+  clock         K slots of (actor, counter) — K bounds the distinct
+                WRITERS in one causal history (the DVV compression:
+                growth bounded by writers, not replicas)
+  order buffer  D slots of (dst, clock) — D bounds the distinct
+                destinations one node sends causal messages to
+
+Total state is O(N·D·K) — a causal label over thousands of nodes with a
+handful of writers costs what the reference's orddicts cost.  Slot
+exhaustion cannot be represented; every op surfaces an ``ok`` flag and
+the row counts failures (``clock_overflow``, ``ob_dropped``) instead of
+silently corrupting order — the engine's count-don't-silence rule
+(SURVEY §7.3).  A message emitted past an exhausted order buffer ships
+WITHOUT a dependency (delivered eagerly, order not enforced), which is
+the explicit, counted analog of the reference crashing its per-label
+gen_server on resource exhaustion.
+
+Delivery semantics are bit-compatible with qos/causal.py for histories
+that fit K/D — tests/test_causal_sparse.py drives both protocols through
+identical scenarios and asserts identical logs — while
+test_scales_past_dense_cap runs N = 512, four times the dense guard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..engine import ProtocolBase
+from ..ops import ring
+from ..ops.msg import Msgs
+from . import dvv
+
+
+@struct.dataclass
+class CausalSparseRow:
+    vc_act: jax.Array       # [K] local clock actors (-1 empty)
+    vc_cnt: jax.Array       # [K] local clock counters
+    ob_dst: jax.Array       # [D] order-buffer destination keys (-1 empty)
+    ob_act: jax.Array       # [D, K] last clock sent per destination
+    ob_cnt: jax.Array       # [D, K]
+    pend_valid: jax.Array   # [B] buffered messages
+    pend_src: jax.Array     # [B]
+    pend_payload: jax.Array  # [B]
+    pend_has_dep: jax.Array  # [B] bool
+    pend_dep_act: jax.Array  # [B, K] dependency clock
+    pend_dep_cnt: jax.Array  # [B, K]
+    pend_clk_act: jax.Array  # [B, K] message clock
+    pend_clk_cnt: jax.Array  # [B, K]
+    log: jax.Array          # [L] delivered payloads, delivery order
+    log_src: jax.Array      # [L]
+    log_n: jax.Array        # scalar — total delivered (may exceed L)
+    pend_dropped: jax.Array   # scalar — full pending ring
+    ob_dropped: jax.Array     # scalar — sends past a full dst table
+    clock_overflow: jax.Array  # scalar — clock ops that exceeded K slots
+
+
+def init_rows(n_nodes: int, k_slots: int = 8, d_slots: int = 16,
+              buf_cap: int = 8, log_cap: int = 16) -> CausalSparseRow:
+    """Batched [N, ...] sparse causal state (one label)."""
+    n, k, d = n_nodes, k_slots, d_slots
+    return CausalSparseRow(
+        vc_act=jnp.full((n, k), -1, jnp.int32),
+        vc_cnt=jnp.zeros((n, k), jnp.int32),
+        ob_dst=jnp.full((n, d), -1, jnp.int32),
+        ob_act=jnp.full((n, d, k), -1, jnp.int32),
+        ob_cnt=jnp.zeros((n, d, k), jnp.int32),
+        pend_valid=jnp.zeros((n, buf_cap), bool),
+        pend_src=jnp.zeros((n, buf_cap), jnp.int32),
+        pend_payload=jnp.zeros((n, buf_cap), jnp.int32),
+        pend_has_dep=jnp.zeros((n, buf_cap), bool),
+        pend_dep_act=jnp.full((n, buf_cap, k), -1, jnp.int32),
+        pend_dep_cnt=jnp.zeros((n, buf_cap, k), jnp.int32),
+        pend_clk_act=jnp.full((n, buf_cap, k), -1, jnp.int32),
+        pend_clk_cnt=jnp.zeros((n, buf_cap, k), jnp.int32),
+        log=jnp.full((n, log_cap), -1, jnp.int32),
+        log_src=jnp.full((n, log_cap), -1, jnp.int32),
+        log_n=jnp.zeros((n,), jnp.int32),
+        pend_dropped=jnp.zeros((n,), jnp.int32),
+        ob_dropped=jnp.zeros((n,), jnp.int32),
+        clock_overflow=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def emit(row: CausalSparseRow, me: jax.Array, dst: jax.Array
+         ) -> Tuple[CausalSparseRow, jax.Array, jax.Array, jax.Array,
+                    jax.Array, jax.Array]:
+    """The emit half (:115-139) on ONE node's row.  Returns
+    (row', dep_act, dep_cnt, has_dep, clk_act, clk_cnt)."""
+    vc_act, vc_cnt, ok_inc = dvv.increment(row.vc_act, row.vc_cnt, me)
+    # dependency = the order-buffer entry for dst (absent on first send)
+    hit = (row.ob_dst == dst) & (dst >= 0)
+    has_dep = jnp.any(hit)
+    dep_act = jnp.where(
+        has_dep, jnp.sum(jnp.where(hit[:, None], row.ob_act, 0), axis=0), -1)
+    dep_cnt = jnp.sum(jnp.where(hit[:, None], row.ob_cnt, 0), axis=0)
+    # store the NEW clock under dst: existing slot, else first free
+    free = row.ob_dst < 0
+    slot = jnp.where(has_dep, jnp.argmax(hit), jnp.argmax(free))
+    ok_slot = has_dep | jnp.any(free)
+    row = row.replace(
+        vc_act=vc_act, vc_cnt=vc_cnt,
+        ob_dst=row.ob_dst.at[slot].set(
+            jnp.where(ok_slot, dst, row.ob_dst[slot])),
+        ob_act=row.ob_act.at[slot].set(
+            jnp.where(ok_slot, vc_act, row.ob_act[slot])),
+        ob_cnt=row.ob_cnt.at[slot].set(
+            jnp.where(ok_slot, vc_cnt, row.ob_cnt[slot])),
+        ob_dropped=row.ob_dropped + (~ok_slot).astype(jnp.int32),
+        clock_overflow=row.clock_overflow + (~ok_inc).astype(jnp.int32),
+    )
+    return row, dep_act, dep_cnt, has_dep, vc_act, vc_cnt
+
+
+def receive(row: CausalSparseRow, src, payload, dep_act, dep_cnt, has_dep,
+            clk_act, clk_cnt) -> Tuple[CausalSparseRow, jax.Array]:
+    """Buffer an incoming causal message (:143-154)."""
+    ok, slot = ring.alloc(row.pend_valid)
+    wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+    row = row.replace(
+        pend_valid=wr(row.pend_valid, True),
+        pend_src=wr(row.pend_src, src),
+        pend_payload=wr(row.pend_payload, payload),
+        pend_has_dep=wr(row.pend_has_dep, has_dep),
+        pend_dep_act=wr(row.pend_dep_act, dep_act),
+        pend_dep_cnt=wr(row.pend_dep_cnt, dep_cnt),
+        pend_clk_act=wr(row.pend_clk_act, clk_act),
+        pend_clk_cnt=wr(row.pend_clk_cnt, clk_cnt),
+        pend_dropped=row.pend_dropped + (~ok).astype(jnp.int32),
+    )
+    return row, ~ok
+
+
+def drain(row: CausalSparseRow, me: jax.Array
+          ) -> Tuple[CausalSparseRow, jax.Array]:
+    """Deliver every buffered message whose dependency the local clock
+    dominates (:232-254); two passes so same-round chains resolve, like
+    qos/causal.py's drain."""
+    B = row.pend_valid.shape[0]
+    L = row.log.shape[0]
+
+    def try_slot(i, carry):
+        row, n = carry
+        deliverable = row.pend_valid[i] & (
+            ~row.pend_has_dep[i]
+            | dvv.dominates(row.vc_act, row.vc_cnt,
+                            row.pend_dep_act[i], row.pend_dep_cnt[i]))
+        m_act, m_cnt, ok_m = dvv.merge(
+            row.vc_act, row.vc_cnt,
+            row.pend_clk_act[i], row.pend_clk_cnt[i])
+        m_act, m_cnt, ok_i = dvv.increment(m_act, m_cnt, me)
+        li = jnp.clip(row.log_n, 0, L - 1)
+        record = deliverable & (row.log_n < L)
+        row = row.replace(
+            vc_act=jnp.where(deliverable, m_act, row.vc_act),
+            vc_cnt=jnp.where(deliverable, m_cnt, row.vc_cnt),
+            pend_valid=row.pend_valid.at[i].set(
+                row.pend_valid[i] & ~deliverable),
+            log=row.log.at[li].set(jnp.where(
+                record, row.pend_payload[i], row.log[li])),
+            log_src=row.log_src.at[li].set(jnp.where(
+                record, row.pend_src[i], row.log_src[li])),
+            log_n=row.log_n + deliverable.astype(jnp.int32),
+            clock_overflow=row.clock_overflow
+            + (deliverable & (~ok_m | ~ok_i)).astype(jnp.int32),
+        )
+        return row, n + deliverable.astype(jnp.int32)
+
+    n0 = jnp.int32(0)
+    row, n = jax.lax.fori_loop(0, B, try_slot, (row, n0))
+    row, n = jax.lax.fori_loop(0, B, try_slot, (row, n))
+    return row, n
+
+
+class CausalDeliverySparse(ProtocolBase):
+    """Runnable sparse-clock causal layer — the same ``ctl_csend`` /
+    ``causal`` surface as qos/causal.py's CausalDelivery, wire fields in
+    (actor, counter)-slot form.  No cluster-size cap: state scales with
+    writers (K) and destinations (D), not N."""
+
+    msg_types = ("causal", "ctl_csend")
+
+    def __init__(self, cfg: Config, k_slots: int = 8, d_slots: int = 16,
+                 buf_cap: int = 8, log_cap: int = 16):
+        self.cfg = cfg
+        self.K, self.D = k_slots, d_slots
+        self.buf_cap, self.log_cap = buf_cap, log_cap
+        k = k_slots
+        self.data_spec: Dict = {
+            "payload": ((), jnp.int32),
+            "peer": ((), jnp.int32),
+            "dep_act": ((k,), jnp.int32),
+            "dep_cnt": ((k,), jnp.int32),
+            "has_dep": ((), jnp.int32),
+            "clk_act": ((k,), jnp.int32),
+            "clk_cnt": ((k,), jnp.int32),
+            "cdelay": ((), jnp.int32),
+        }
+        self.emit_cap = 1
+        self.tick_emit_cap = 1
+
+    def init(self, cfg: Config, key: jax.Array) -> CausalSparseRow:
+        return init_rows(cfg.n_nodes, self.K, self.D,
+                         self.buf_cap, self.log_cap)
+
+    def handle_ctl_csend(self, cfg, me, row: CausalSparseRow, m: Msgs, key):
+        dst = m.data["peer"]
+        row, dep_act, dep_cnt, has_dep, clk_act, clk_cnt = \
+            emit(row, me, dst)
+        em = self.emit(dst[None], self.typ("causal"),
+                       payload=m.data["payload"],
+                       dep_act=dep_act, dep_cnt=dep_cnt,
+                       has_dep=has_dep.astype(jnp.int32),
+                       clk_act=clk_act, clk_cnt=clk_cnt,
+                       delay=m.data["cdelay"])
+        return row, em
+
+    def handle_causal(self, cfg, me, row: CausalSparseRow, m: Msgs, key):
+        row, _ = receive(row, m.src, m.data["payload"],
+                         m.data["dep_act"], m.data["dep_cnt"],
+                         m.data["has_dep"] > 0,
+                         m.data["clk_act"], m.data["clk_cnt"])
+        return row, self.no_emit()
+
+    def tick(self, cfg, me, row: CausalSparseRow, rnd, key):
+        row, _ = drain(row, me)
+        return row, self.no_emit(self.tick_emit_cap)

@@ -1,0 +1,57 @@
+"""engine.autotune knob derivation (VERDICT r2 weak #2): pure config
+math, so the burst-budget contract is pinned without the slow 1024-node
+integration test (tests/test_scamp.py::test_scamp_v2_1024_nodes is the
+behavioral backstop)."""
+
+import partisan_tpu as pt
+from partisan_tpu.engine import autotune
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.plumtree import Plumtree
+from partisan_tpu.models.scamp import ScampV2
+from partisan_tpu.models.stack import Stacked
+
+
+def test_small_n_untouched():
+    cfg = pt.Config(n_nodes=64, inbox_cap=8)
+    out = autotune(cfg, HyParView(cfg))
+    assert out.node_emit_cap is None
+    assert out.deliver_gather_cap is None
+
+
+def test_default_hint_is_8():
+    cfg = pt.Config(n_nodes=1024, inbox_cap=8)
+    out = autotune(cfg, HyParView(cfg))
+    assert out.node_emit_cap == 8
+    assert out.deliver_gather_cap == 8
+
+
+def test_scamp_declares_join_storm_burst():
+    """SCAMP's join-storm fanout needs 32 slots/round — 8 starves the
+    subscription walks to a near-star overlay (ROADMAP 1c)."""
+    cfg = pt.Config(n_nodes=1024, inbox_cap=16, periodic_interval=5)
+    proto = ScampV2(cfg)
+    assert proto.autotune_emit_hint == 32
+    assert autotune(cfg, proto).node_emit_cap == 32
+
+
+def test_stacked_sums_hints():
+    """Budgets SUM across layers (like tick_emit_cap): a lower-layer
+    burst must not be able to starve the upper layer's emissions."""
+    cfg = pt.Config(n_nodes=1024, inbox_cap=8)
+    st = Stacked(HyParView(cfg), Plumtree(cfg, n_keys=1))
+    assert st.autotune_emit_hint == 16
+    assert autotune(cfg, st).node_emit_cap == 16
+
+
+def test_explicit_knobs_win():
+    cfg = pt.Config(n_nodes=1024, inbox_cap=8, node_emit_cap=4,
+                    deliver_gather_cap=2)
+    out = autotune(cfg, HyParView(cfg))
+    assert out.node_emit_cap == 4
+    assert out.deliver_gather_cap == 2
+
+
+def test_auto_tune_off():
+    cfg = pt.Config(n_nodes=1024, inbox_cap=8, auto_tune=False)
+    out = autotune(cfg, HyParView(cfg))
+    assert out.node_emit_cap is None

@@ -1,0 +1,102 @@
+"""Sparse-clock causal delivery (qos/causal_sparse.py): same delivery
+semantics as the dense backend for histories that fit the slot budget,
+no cluster-size cap, explicit overflow counters."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu.peer_service import send_ctl
+from partisan_tpu.qos.causal import CausalDelivery
+from partisan_tpu.qos.causal_sparse import CausalDeliverySparse
+
+
+def _run(proto_cls, n_nodes, sends, rounds, **kw):
+    cfg = pt.Config(n_nodes=n_nodes, inbox_cap=8)
+    proto = proto_cls(cfg, **kw)
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False, randomize_delivery=False)
+    for src, peer, payload, delay in sends:
+        world = send_ctl(world, proto, src, "ctl_csend",
+                         peer=peer, payload=payload, cdelay=delay)
+    for _ in range(rounds):
+        world, _ = step(world)
+    return world
+
+
+class TestCausalSparse:
+    def test_fifo_under_reordering(self):
+        """causal_test (test/partisan_SUITE.erl:402) with sparse clocks:
+        wire delays reverse arrival order; delivery stays in send order."""
+        w = _run(CausalDeliverySparse, 4,
+                 [(0, 1, 1, 6), (0, 1, 2, 3), (0, 1, 3, 0)], 14)
+        assert int(w.state.log_n[1]) == 3
+        assert list(np.asarray(w.state.log[1])[:3]) == [1, 2, 3]
+
+    def test_log_equivalence_with_dense(self):
+        """Any program whose history fits the slot budget delivers
+        identically through the dense and sparse backends (the dvv
+        equivalence property lifted to the full protocol)."""
+        sends = [(0, 1, 1, 6), (0, 1, 2, 3), (0, 1, 3, 0),
+                 (2, 1, 9, 2), (0, 3, 5, 0), (2, 3, 6, 4)]
+        wd = _run(CausalDelivery, 4, sends, 16)
+        ws = _run(CausalDeliverySparse, 4, sends, 16)
+        assert (np.asarray(wd.state.log_n)
+                == np.asarray(ws.state.log_n)).all()
+        assert (np.asarray(wd.state.log)
+                == np.asarray(ws.state.log)).all()
+        assert (np.asarray(wd.state.log_src)
+                == np.asarray(ws.state.log_src)).all()
+        assert not np.asarray(ws.state.clock_overflow).any()
+        assert not np.asarray(ws.state.ob_dropped).any()
+
+    def test_scales_past_dense_cap(self):
+        """N = 512 — four times the dense backend's guard (qos/causal.py
+        asserts N <= 128); state is O(N·D·K), not O(N³)."""
+        n = 512
+        with pytest.raises(AssertionError):
+            CausalDelivery(pt.Config(n_nodes=n, inbox_cap=8))
+        w = _run(CausalDeliverySparse, n,
+                 [(0, 300, 1, 4), (0, 300, 2, 0), (450, 300, 7, 0)], 12)
+        assert int(w.state.log_n[300]) == 3
+        log = list(np.asarray(w.state.log[300])[:3])
+        # 0's stream stays ordered; 450's independent send interleaves
+        assert log.index(1) < log.index(2)
+        assert 7 in log
+
+    def test_transitive_chain(self):
+        cfg = pt.Config(n_nodes=3, inbox_cap=8)
+        proto = CausalDeliverySparse(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 0, "ctl_csend",
+                         peer=1, payload=10, cdelay=0)
+        for _ in range(4):
+            world, _ = step(world)
+        world = send_ctl(world, proto, 1, "ctl_csend",
+                         peer=2, payload=11, cdelay=0)
+        for _ in range(4):
+            world, _ = step(world)
+        assert int(world.state.log_n[1]) == 1
+        assert int(world.state.log_n[2]) == 1
+
+    def test_ob_exhaustion_counted_not_silent(self):
+        """Sends past a full destination table ship dependency-free and
+        are COUNTED (the count-don't-silence rule) — delivery still
+        happens, only the ordering guarantee degrades."""
+        w = _run(CausalDeliverySparse, 8,
+                 [(0, d, d, 0) for d in range(1, 5)], 10,
+                 d_slots=2)
+        assert int(np.asarray(w.state.ob_dropped[0])) == 2
+        for d in range(1, 5):
+            assert int(w.state.log_n[d]) == 1
+
+    def test_clock_overflow_counted(self):
+        """More distinct writers than K slots: delivery keeps working,
+        overflow is counted at the nodes whose clocks ran out."""
+        n = 8
+        sends = [(s, 7, 10 + s, 0) for s in range(5)]
+        w = _run(CausalDeliverySparse, n, sends, 10, k_slots=2)
+        assert int(w.state.log_n[7]) == 5
+        assert int(np.asarray(w.state.clock_overflow[7])) > 0

@@ -307,7 +307,7 @@ class TestCausalCap:
         label over >128 nodes must fail loudly at construction like
         FullMembership's cap, not at allocation."""
         import pytest
-        with pytest.raises(AssertionError, match="dvv"):
+        with pytest.raises(AssertionError, match="sparse-clock"):
             CausalDelivery(pt.Config(n_nodes=256))
 
     def test_sentinel_actor_refused(self):
