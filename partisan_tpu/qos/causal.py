@@ -345,7 +345,12 @@ class CausalAcked(CausalDelivery):
         return row.replace(causal=crow), ack_rep
 
     def handle_causal_ack(self, cfg, me, row: CausalAckedRow, m: Msgs, key):
-        hit = row.out_valid & (row.out_seq == m.data["seq"])
+        # seqs are per-DESTINATION streams (next_seq_to is indexed by
+        # dst), so every stream starts at 1 and the ack must match
+        # (dst, seq) — seq alone would clear other destinations' unacked
+        # same-seq messages, losing them with no retransmit
+        hit = row.out_valid & (row.out_dst == m.src) \
+            & (row.out_seq == m.data["seq"])
         return row.replace(out_valid=row.out_valid & ~hit), self.no_emit()
 
     def tick(self, cfg, me, row: CausalAckedRow, rnd, key):

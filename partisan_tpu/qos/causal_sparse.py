@@ -46,6 +46,7 @@ from ..config import Config
 from ..engine import ProtocolBase
 from ..ops import ring
 from ..ops.msg import Msgs
+from . import ack as ack_mod
 from . import dvv
 
 
@@ -56,6 +57,10 @@ class CausalSparseRow:
     ob_dst: jax.Array       # [D] order-buffer destination keys (-1 empty)
     ob_act: jax.Array       # [D, K] last clock sent per destination
     ob_cnt: jax.Array       # [D, K]
+    ob_seq: jax.Array       # [D] next stream seq per destination (the
+                            # CausalAckedSparse seq source; same key
+                            # domain as the order buffer, so seqs cost
+                            # no extra table)
     pend_valid: jax.Array   # [B] buffered messages
     pend_src: jax.Array     # [B]
     pend_payload: jax.Array  # [B]
@@ -64,12 +69,18 @@ class CausalSparseRow:
     pend_dep_cnt: jax.Array  # [B, K]
     pend_clk_act: jax.Array  # [B, K] message clock
     pend_clk_cnt: jax.Array  # [B, K]
+    pend_seq: jax.Array     # [B] per-stream wire seq (0 = unsequenced)
+    ls_src: jax.Array       # [S] last-seq table keys (senders; -1 empty)
+    ls_seq: jax.Array       # [S] last seq delivered per sender
     log: jax.Array          # [L] delivered payloads, delivery order
     log_src: jax.Array      # [L]
     log_n: jax.Array        # scalar — total delivered (may exceed L)
     pend_dropped: jax.Array   # scalar — full pending ring
     ob_dropped: jax.Array     # scalar — sends past a full dst table
     clock_overflow: jax.Array  # scalar — clock ops that exceeded K slots
+    ls_dropped: jax.Array     # scalar — sequenced deliveries past a full
+                              # sender table (FIFO/dedup degrades to
+                              # dominance-only for that sender, counted)
 
 
 def init_rows(n_nodes: int, k_slots: int = 8, d_slots: int = 16,
@@ -82,6 +93,7 @@ def init_rows(n_nodes: int, k_slots: int = 8, d_slots: int = 16,
         ob_dst=jnp.full((n, d), -1, jnp.int32),
         ob_act=jnp.full((n, d, k), -1, jnp.int32),
         ob_cnt=jnp.zeros((n, d, k), jnp.int32),
+        ob_seq=jnp.ones((n, d), jnp.int32),
         pend_valid=jnp.zeros((n, buf_cap), bool),
         pend_src=jnp.zeros((n, buf_cap), jnp.int32),
         pend_payload=jnp.zeros((n, buf_cap), jnp.int32),
@@ -90,20 +102,36 @@ def init_rows(n_nodes: int, k_slots: int = 8, d_slots: int = 16,
         pend_dep_cnt=jnp.zeros((n, buf_cap, k), jnp.int32),
         pend_clk_act=jnp.full((n, buf_cap, k), -1, jnp.int32),
         pend_clk_cnt=jnp.zeros((n, buf_cap, k), jnp.int32),
+        pend_seq=jnp.zeros((n, buf_cap), jnp.int32),
+        ls_src=jnp.full((n, d), -1, jnp.int32),
+        ls_seq=jnp.zeros((n, d), jnp.int32),
         log=jnp.full((n, log_cap), -1, jnp.int32),
         log_src=jnp.full((n, log_cap), -1, jnp.int32),
         log_n=jnp.zeros((n,), jnp.int32),
         pend_dropped=jnp.zeros((n,), jnp.int32),
         ob_dropped=jnp.zeros((n,), jnp.int32),
         clock_overflow=jnp.zeros((n,), jnp.int32),
+        ls_dropped=jnp.zeros((n,), jnp.int32),
     )
 
 
-def emit(row: CausalSparseRow, me: jax.Array, dst: jax.Array
+def _ls_lookup(row: CausalSparseRow, src) -> Tuple[jax.Array, jax.Array]:
+    """(known, last_seq) for a sender — 0 when absent (first stream
+    message is seq 1)."""
+    hit = (row.ls_src == src) & (src >= 0)
+    return jnp.any(hit), jnp.sum(jnp.where(hit, row.ls_seq, 0))
+
+
+def emit(row: CausalSparseRow, me: jax.Array, dst: jax.Array,
+         sequenced: bool = False
          ) -> Tuple[CausalSparseRow, jax.Array, jax.Array, jax.Array,
-                    jax.Array, jax.Array]:
+                    jax.Array, jax.Array, jax.Array]:
     """The emit half (:115-139) on ONE node's row.  Returns
-    (row', dep_act, dep_cnt, has_dep, clk_act, clk_cnt)."""
+    (row', dep_act, dep_cnt, has_dep, clk_act, clk_cnt, seq).
+    ``sequenced`` draws a per-destination stream seq from the order
+    buffer's slot (CausalAckedSparse); seq 0 = unsequenced — the value
+    shipped when the destination table is full (counted, and the
+    receiver falls back to dominance-only delivery for that message)."""
     vc_act, vc_cnt, ok_inc = dvv.increment(row.vc_act, row.vc_cnt, me)
     # dependency = the order-buffer entry for dst (absent on first send)
     hit = (row.ob_dst == dst) & (dst >= 0)
@@ -115,6 +143,8 @@ def emit(row: CausalSparseRow, me: jax.Array, dst: jax.Array
     free = row.ob_dst < 0
     slot = jnp.where(has_dep, jnp.argmax(hit), jnp.argmax(free))
     ok_slot = has_dep | jnp.any(free)
+    seq = jnp.where(ok_slot, row.ob_seq[slot], 0) if sequenced \
+        else jnp.int32(0)
     row = row.replace(
         vc_act=vc_act, vc_cnt=vc_cnt,
         ob_dst=row.ob_dst.at[slot].set(
@@ -123,16 +153,24 @@ def emit(row: CausalSparseRow, me: jax.Array, dst: jax.Array
             jnp.where(ok_slot, vc_act, row.ob_act[slot])),
         ob_cnt=row.ob_cnt.at[slot].set(
             jnp.where(ok_slot, vc_cnt, row.ob_cnt[slot])),
+        ob_seq=row.ob_seq.at[slot].add(
+            jnp.where(ok_slot & bool(sequenced), 1, 0)),
         ob_dropped=row.ob_dropped + (~ok_slot).astype(jnp.int32),
         clock_overflow=row.clock_overflow + (~ok_inc).astype(jnp.int32),
     )
-    return row, dep_act, dep_cnt, has_dep, vc_act, vc_cnt
+    return row, dep_act, dep_cnt, has_dep, vc_act, vc_cnt, seq
 
 
 def receive(row: CausalSparseRow, src, payload, dep_act, dep_cnt, has_dep,
-            clk_act, clk_cnt) -> Tuple[CausalSparseRow, jax.Array]:
-    """Buffer an incoming causal message (:143-154)."""
+            clk_act, clk_cnt, seq=None) -> Tuple[CausalSparseRow, jax.Array]:
+    """Buffer an incoming causal message (:143-154).  ``seq`` > 0 enables
+    retransmission dedup (CausalAckedSparse); an already-delivered seq is
+    ignored without counting as a drop."""
+    seq = jnp.int32(0) if seq is None else seq
+    _, last = _ls_lookup(row, src)
+    dup = (seq > 0) & (seq <= last)
     ok, slot = ring.alloc(row.pend_valid)
+    ok = ok & ~dup
     wr = lambda a, v: ring.masked_set(a, slot, ok, v)
     row = row.replace(
         pend_valid=wr(row.pend_valid, True),
@@ -143,22 +181,40 @@ def receive(row: CausalSparseRow, src, payload, dep_act, dep_cnt, has_dep,
         pend_dep_cnt=wr(row.pend_dep_cnt, dep_cnt),
         pend_clk_act=wr(row.pend_clk_act, clk_act),
         pend_clk_cnt=wr(row.pend_clk_cnt, clk_cnt),
-        pend_dropped=row.pend_dropped + (~ok).astype(jnp.int32),
+        pend_seq=wr(row.pend_seq, seq),
+        pend_dropped=row.pend_dropped + (~ok & ~dup).astype(jnp.int32),
     )
-    return row, ~ok
+    return row, ~ok & ~dup
 
 
 def drain(row: CausalSparseRow, me: jax.Array
           ) -> Tuple[CausalSparseRow, jax.Array]:
     """Deliver every buffered message whose dependency the local clock
     dominates (:232-254); two passes so same-round chains resolve, like
-    qos/causal.py's drain."""
+    qos/causal.py's drain.  Sequenced messages (seq > 0) additionally
+    deliver in exact per-sender stream order via the sparse last-seq
+    table — dominance alone lets a successor overtake a delayed
+    predecessor through transitive clock advancement (the dense
+    backend's drain documents the same trap).  A sequenced delivery for
+    a sender the full table cannot admit degrades to dominance-only and
+    is counted (ls_dropped), never silent."""
     B = row.pend_valid.shape[0]
     L = row.log.shape[0]
 
     def try_slot(i, carry):
         row, n = carry
-        deliverable = row.pend_valid[i] & (
+        src_i = row.pend_src[i]
+        known, last = _ls_lookup(row, src_i)
+        seq_i = row.pend_seq[i]
+        # retransmission that crossed its ack: drop without delivering
+        dup = row.pend_valid[i] & (seq_i > 0) & (seq_i <= last)
+        row = row.replace(pend_valid=row.pend_valid.at[i].set(
+            row.pend_valid[i] & ~dup))
+        free = row.ls_src < 0
+        has_free = jnp.any(free)
+        degraded = (seq_i > 0) & ~known & ~has_free
+        in_order = (seq_i == 0) | (seq_i == last + 1) | degraded
+        deliverable = row.pend_valid[i] & in_order & (
             ~row.pend_has_dep[i]
             | dvv.dominates(row.vc_act, row.vc_cnt,
                             row.pend_dep_act[i], row.pend_dep_cnt[i]))
@@ -168,6 +224,11 @@ def drain(row: CausalSparseRow, me: jax.Array
         m_act, m_cnt, ok_i = dvv.increment(m_act, m_cnt, me)
         li = jnp.clip(row.log_n, 0, L - 1)
         record = deliverable & (row.log_n < L)
+        # last-seq table update: existing slot keeps the max; an unknown
+        # sender takes a free slot (degraded deliveries skip the table)
+        track = deliverable & (seq_i > 0) & ~degraded
+        ls_slot = jnp.where(known, jnp.argmax(row.ls_src == src_i),
+                            jnp.argmax(free))
         row = row.replace(
             vc_act=jnp.where(deliverable, m_act, row.vc_act),
             vc_cnt=jnp.where(deliverable, m_cnt, row.vc_cnt),
@@ -178,8 +239,15 @@ def drain(row: CausalSparseRow, me: jax.Array
             log_src=row.log_src.at[li].set(jnp.where(
                 record, row.pend_src[i], row.log_src[li])),
             log_n=row.log_n + deliverable.astype(jnp.int32),
+            ls_src=row.ls_src.at[ls_slot].set(jnp.where(
+                track, src_i, row.ls_src[ls_slot])),
+            ls_seq=row.ls_seq.at[ls_slot].set(jnp.where(
+                track, jnp.maximum(row.ls_seq[ls_slot], seq_i),
+                row.ls_seq[ls_slot])),
             clock_overflow=row.clock_overflow
             + (deliverable & (~ok_m | ~ok_i)).astype(jnp.int32),
+            ls_dropped=row.ls_dropped
+            + (deliverable & degraded).astype(jnp.int32),
         )
         return row, n + deliverable.astype(jnp.int32)
 
@@ -222,7 +290,7 @@ class CausalDeliverySparse(ProtocolBase):
 
     def handle_ctl_csend(self, cfg, me, row: CausalSparseRow, m: Msgs, key):
         dst = m.data["peer"]
-        row, dep_act, dep_cnt, has_dep, clk_act, clk_cnt = \
+        row, dep_act, dep_cnt, has_dep, clk_act, clk_cnt, _ = \
             emit(row, me, dst)
         em = self.emit(dst[None], self.typ("causal"),
                        payload=m.data["payload"],
@@ -242,3 +310,140 @@ class CausalDeliverySparse(ProtocolBase):
     def tick(self, cfg, me, row: CausalSparseRow, rnd, key):
         row, _ = drain(row, me)
         return row, self.no_emit(self.tick_emit_cap)
+
+
+@struct.dataclass
+class CausalAckedSparseRow:
+    causal: CausalSparseRow
+    # reemit storage: the wire copy of every unacked causal message —
+    # byte-identical dep/clock on retransmit is why the backend stores
+    # emitted messages instead of re-stamping (causality_backend
+    # :107-113; same shape as the dense CausalAckedRow, clocks in
+    # (actor, counter)-slot form)
+    out_valid: jax.Array    # [R]
+    out_dst: jax.Array      # [R]
+    out_payload: jax.Array  # [R]
+    out_dep_act: jax.Array  # [R, K]
+    out_dep_cnt: jax.Array  # [R, K]
+    out_has_dep: jax.Array  # [R]
+    out_clk_act: jax.Array  # [R, K]
+    out_clk_cnt: jax.Array  # [R, K]
+    out_seq: jax.Array      # [R]
+    out_age: jax.Array      # [R]
+    send_dropped: jax.Array  # scalar — full-ring losses, surfaced
+
+
+class CausalAckedSparse(CausalDeliverySparse):
+    """The `with_causal_send_and_ack` composition with sparse clocks:
+    at-least-once via stored-wire-copy reemit + causal order, no cluster
+    cap.  Stream seqs ride the order buffer's destination slots
+    (ob_seq), so the acked layer adds no dense [A] table; the receiver's
+    last-seq dedup table is sparse too (drain's ls_* fields)."""
+
+    msg_types = ("causal", "causal_ack", "ctl_csend")
+
+    def __init__(self, cfg: Config, k_slots: int = 8, d_slots: int = 16,
+                 buf_cap: int = 8, log_cap: int = 16, ring_cap: int = 8):
+        super().__init__(cfg, k_slots, d_slots, buf_cap, log_cap)
+        self.R = ring_cap
+        self.data_spec = dict(self.data_spec)
+        self.data_spec["seq"] = ((), jnp.int32)
+        self.tick_emit_cap = ring_cap
+
+    def init(self, cfg: Config, key: jax.Array) -> CausalAckedSparseRow:
+        n, k, r = cfg.n_nodes, self.K, self.R
+        return CausalAckedSparseRow(
+            causal=super().init(cfg, key),
+            out_valid=jnp.zeros((n, r), bool),
+            out_dst=jnp.zeros((n, r), jnp.int32),
+            out_payload=jnp.zeros((n, r), jnp.int32),
+            out_dep_act=jnp.full((n, r, k), -1, jnp.int32),
+            out_dep_cnt=jnp.zeros((n, r, k), jnp.int32),
+            out_has_dep=jnp.zeros((n, r), bool),
+            out_clk_act=jnp.full((n, r, k), -1, jnp.int32),
+            out_clk_cnt=jnp.zeros((n, r, k), jnp.int32),
+            out_seq=jnp.zeros((n, r), jnp.int32),
+            out_age=jnp.zeros((n, r), jnp.int32),
+            send_dropped=jnp.zeros((n,), jnp.int32),
+        )
+
+    def handle_ctl_csend(self, cfg, me, row: CausalAckedSparseRow,
+                         m: Msgs, key):
+        dst = m.data["peer"]
+        # allocate the reemit slot FIRST: on a full ring the send must
+        # not happen at all — stamping the clock/order buffer for a
+        # message that never reaches the wire would wedge every later
+        # message to this destination behind an unsatisfiable dependency
+        ok, slot = ring.alloc(row.out_valid)
+        crow, dep_act, dep_cnt, has_dep, clk_act, clk_cnt, seq = \
+            emit(row.causal, me, dst, sequenced=True)
+        # a destination the full ob table cannot admit gets seq 0 —
+        # unsequenced means unackable (acks match by seq) and
+        # non-dedupable at the receiver, so the at-least-once contract
+        # cannot hold: refuse the send outright and count it, like the
+        # full-ring case
+        ok = ok & (seq > 0)
+        crow = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(ok, new, old), crow, row.causal)
+        seq = jnp.where(ok, seq, 0)
+        wr = lambda a_, v: ring.masked_set(a_, slot, ok, v)
+        row = row.replace(
+            causal=crow,
+            out_valid=wr(row.out_valid, True),
+            out_dst=wr(row.out_dst, dst),
+            out_payload=wr(row.out_payload, m.data["payload"]),
+            out_dep_act=wr(row.out_dep_act, dep_act),
+            out_dep_cnt=wr(row.out_dep_cnt, dep_cnt),
+            out_has_dep=wr(row.out_has_dep, has_dep),
+            out_clk_act=wr(row.out_clk_act, clk_act),
+            out_clk_cnt=wr(row.out_clk_cnt, clk_cnt),
+            out_seq=wr(row.out_seq, seq),
+            out_age=wr(row.out_age, 0),
+            send_dropped=row.send_dropped + (~ok).astype(jnp.int32),
+        )
+        em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("causal"),
+                       payload=m.data["payload"],
+                       dep_act=dep_act, dep_cnt=dep_cnt,
+                       has_dep=has_dep.astype(jnp.int32),
+                       clk_act=clk_act, clk_cnt=clk_cnt,
+                       seq=seq, delay=m.data["cdelay"])
+        return row, em
+
+    def handle_causal(self, cfg, me, row: CausalAckedSparseRow,
+                      m: Msgs, key):
+        # a message LOST to a full pending ring must NOT be acked — the
+        # sender's reemit timer is the recovery path for exactly that
+        crow, dropped = receive(row.causal, m.src, m.data["payload"],
+                                m.data["dep_act"], m.data["dep_cnt"],
+                                m.data["has_dep"] > 0,
+                                m.data["clk_act"], m.data["clk_cnt"],
+                                seq=m.data["seq"])
+        ack_rep = self.emit(jnp.where(dropped, -1, m.src)[None],
+                            self.typ("causal_ack"), seq=m.data["seq"])
+        return row.replace(causal=crow), ack_rep
+
+    def handle_causal_ack(self, cfg, me, row: CausalAckedSparseRow,
+                          m: Msgs, key):
+        # seqs are per-DESTINATION streams: every stream starts at 1, so
+        # the ack must match (dst, seq), not seq alone — a seq-only
+        # match would let node 2's ack of its seq-1 message clear the
+        # still-unacked seq-1 message bound for node 3
+        hit = row.out_valid & (row.out_dst == m.src) \
+            & (m.data["seq"] > 0) & (row.out_seq == m.data["seq"])
+        return row.replace(out_valid=row.out_valid & ~hit), self.no_emit()
+
+    def tick(self, cfg, me, row: CausalAckedSparseRow, rnd, key):
+        crow, _ = drain(row.causal, me)
+        row = row.replace(causal=crow)
+        # reemit the stored wire copies of unacked messages
+        age, due = ack_mod.retransmit_due(row.out_valid, row.out_age,
+                                          cfg.retransmit_interval)
+        row = row.replace(out_age=age)
+        em = self.emit(jnp.where(due, row.out_dst, -1),
+                       self.typ("causal"), cap=self.tick_emit_cap,
+                       payload=row.out_payload,
+                       dep_act=row.out_dep_act, dep_cnt=row.out_dep_cnt,
+                       has_dep=row.out_has_dep.astype(jnp.int32),
+                       clk_act=row.out_clk_act, clk_cnt=row.out_clk_cnt,
+                       seq=row.out_seq)
+        return row, em

@@ -92,6 +92,129 @@ class TestCausalSparse:
         for d in range(1, 5):
             assert int(w.state.log_n[d]) == 1
 
+    def test_acked_causal_order_through_omission(self):
+        """CausalAckedSparse: both first transmissions dropped; reemit
+        delivers IN ORDER from the stored wire copies (dense
+        TestCausalAcked scenario, sparse clocks)."""
+        from partisan_tpu.qos.causal_sparse import CausalAckedSparse
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=3)
+        proto = CausalAckedSparse(cfg)
+
+        def interpose(m, rnd):
+            drop = (m.typ == proto.typ("causal")) & (rnd < 4)
+            return m.replace(valid=m.valid & ~drop)
+
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=interpose)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=1, cdelay=0)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=2, cdelay=0)
+        for _ in range(20):
+            world, _ = step(world)
+        c = world.state.causal
+        assert int(c.log_n[2]) == 2
+        assert list(np.asarray(c.log[2])[:2]) == [1, 2]
+        assert not np.asarray(world.state.out_valid[0]).any()
+
+    def test_acked_no_duplicate_delivery(self):
+        """Retransmissions crossing their ack must not double-deliver
+        (sparse last-seq dedup); interval 1 guarantees a crossing."""
+        from partisan_tpu.qos.causal_sparse import CausalAckedSparse
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=1)
+        proto = CausalAckedSparse(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=7, cdelay=0)
+        for _ in range(12):
+            world, _ = step(world)
+        assert int(world.state.causal.log_n[2]) == 1
+
+    def test_acked_transitive_advance_not_duplicate(self):
+        """The dense backend's transitive-dominance repro with sparse
+        clocks: r's clock advances via t past m2's clock before m1
+        arrives; per-stream seqs must hold m2 and never mark m1 dup."""
+        from partisan_tpu.qos.causal_sparse import CausalAckedSparse
+        cfg = pt.Config(n_nodes=512, inbox_cap=8, retransmit_interval=50)
+        proto = CausalAckedSparse(cfg)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            randomize_delivery=False)
+        s, t, r = 0, 100, 300
+        world = send_ctl(world, proto, s, "ctl_csend", peer=r,
+                         payload=1, cdelay=10)
+        world = send_ctl(world, proto, s, "ctl_csend", peer=r,
+                         payload=2, cdelay=0)
+        world = send_ctl(world, proto, s, "ctl_csend", peer=t,
+                         payload=3, cdelay=0)
+        for _ in range(4):
+            world, _ = step(world)
+        world = send_ctl(world, proto, t, "ctl_csend", peer=r,
+                         payload=4, cdelay=0)
+        for _ in range(20):
+            world, _ = step(world)
+        c = world.state.causal
+        assert int(c.log_n[r]) == 3, int(c.log_n[r])
+        log = list(np.asarray(c.log[r])[:3])
+        assert log.index(1) < log.index(2)
+        assert not np.asarray(c.ls_dropped).any()
+
+    def test_ack_is_per_destination_stream(self):
+        """Every (sender -> dst) stream starts at seq 1, so an ack must
+        clear only ITS destination's ring entry: node 2's seq-1 ack must
+        not cancel the dropped seq-1 message bound for node 3 — that one
+        must still retransmit and deliver."""
+        from partisan_tpu.qos.causal_sparse import CausalAckedSparse
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=3)
+        proto = CausalAckedSparse(cfg)
+
+        def interpose(m, rnd):
+            # drop only messages TO node 3 for a few rounds; node 2's
+            # stream (and its ack) goes through immediately
+            drop = (m.typ == proto.typ("causal")) & (m.dst == 3) & (rnd < 4)
+            return m.replace(valid=m.valid & ~drop)
+
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=interpose)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=21, cdelay=0)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=3,
+                         payload=31, cdelay=0)
+        for _ in range(20):
+            world, _ = step(world)
+        c = world.state.causal
+        assert int(c.log_n[2]) == 1 and int(c.log[2][0]) == 21
+        assert int(c.log_n[3]) == 1 and int(c.log[3][0]) == 31
+        assert not np.asarray(world.state.out_valid[0]).any()
+
+    def test_ack_is_per_destination_stream_dense(self):
+        """Same contract on the dense backend (the bug class existed
+        there too: qos/causal.py handle_causal_ack matched seq alone)."""
+        from partisan_tpu.qos.causal import CausalAcked
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=3)
+        proto = CausalAcked(cfg)
+
+        def interpose(m, rnd):
+            drop = (m.typ == proto.typ("causal")) & (m.dst == 3) & (rnd < 4)
+            return m.replace(valid=m.valid & ~drop)
+
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False,
+                            interpose_send=interpose)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=2,
+                         payload=21, cdelay=0)
+        world = send_ctl(world, proto, 0, "ctl_csend", peer=3,
+                         payload=31, cdelay=0)
+        for _ in range(20):
+            world, _ = step(world)
+        c = world.state.causal
+        assert int(c.log_n[2]) == 1 and int(c.log[2][0]) == 21
+        assert int(c.log_n[3]) == 1 and int(c.log[3][0]) == 31
+        assert not np.asarray(world.state.out_valid[0]).any()
+
     def test_clock_overflow_counted(self):
         """More distinct writers than K slots: delivery keeps working,
         overflow is counted at the nodes whose clocks ran out."""
