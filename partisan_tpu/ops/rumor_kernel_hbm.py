@@ -6,12 +6,6 @@ state plus roll temporaries must fit in ~16 MB of VMEM.  This variant
 keeps the packed state in HBM and runs a ``grid = (rounds, blocks)``
 kernel: each step DMAs the block's working set into VMEM scratch,
 computes one epidemic round for that block, and writes the block back.
-DMAs are synchronous per step (gather, compute, write back — no
-cross-step overlap yet; ROADMAP #2 lists that overlap as remaining
-headroom).  The "ping-pong" below refers to the round-parity swap of
-the two HBM state buffers, not DMA double buffering.  Measured
-roll-compute-bound: ~13.6k rounds/s at 2^22, ~6.3k at 2^24, ~2.7k at
-2^26 on one chip — N is VMEM-unbounded (scales to ~10^8).
 
 Rendezvous decomposition (round 3 — VERDICT r2 #4): the flat-roll
 delivery of the VMEM kernel (partner = node + s mod n) would make every
@@ -34,6 +28,18 @@ and restart patient-zeros are drawn HOST-side with jax.random and ride
 the scalar-prefetch lane, which also makes the deterministic configs
 (churn = 0) interpret-mode testable; only churn bits use the on-core
 PRNG.
+
+DMA/compute overlap (round 3, the "remaining headroom" of ROADMAP #2 —
+built, measured, found NOT to matter): ``_kernel_db`` double-buffers
+scratch by block parity — at step (i, b) it waits the window DMAs it
+started at (i, b-1), immediately starts block b+1's windows into the
+other slot, then computes.  Cross-ROUND prefetch is structurally unsafe
+(a window starting at an arbitrary row reads rows written by ANY block
+of the previous round, so round i+1's first load must see every round-i
+write), so block 0 of each round pays one synchronous load.  An
+interleaved A/B on the chip shows the overlap changes nothing outside
+trial noise (see rumor_run_hbm's docstring), so the synchronous
+``_kernel_sync`` stays the default.
 
 State ping-pongs between two HBM buffers by round parity (reads hit the
 previous round's buffer while writes fill the other), so there is no
@@ -68,7 +74,7 @@ def _row_bit_roll(x: jax.Array, s: jax.Array) -> jax.Array:
     return jnp.where(r == 0, xw, (xw << r) | carry)
 
 
-def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
+def _kernel_sync(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
             # scratch
             w_hot, w_alive, w_dup, b_inf, b_hot, b_alive, hotcnt, sems,
             *, nb, B, R, fanout, stop_k, churn, all_alive):
@@ -200,11 +206,175 @@ def _kernel(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
         write_out(inf_b, hot_b)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+
+def _kernel_db(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
+            # scratch (leading axis 2 = block-parity slot)
+            w_hot, w_alive, w_dup, b_inf, b_hot, b_alive, hotcnt, sems,
+            *, nb, B, R, fanout, stop_k, churn, all_alive):
+    i = pl.program_id(0)          # round
+    b = pl.program_id(1)          # block
+    base = i * (2 * fanout + 2)   # per-round scalar record
+    even = i % 2 == 0
+    slot = jax.lax.rem(b, 2)
+    nslot = jax.lax.rem(b + 1, 2)
+
+    def window_copies(inf_src, hot_src, blk, s):
+        """The DMA descriptor set for block ``blk``'s read windows into
+        slot ``s`` — built identically at start and wait time (the
+        handle pair must match; only the semaphore identity matters)."""
+        ds = []
+        for j in range(fanout):
+            q = sref[base + 2 * j]            # row offset, [0, R)
+            src_r = jax.lax.rem(blk * B + R - q, R)
+            ds.append(pltpu.make_async_copy(
+                hot_src.at[pl.ds(src_r, B)], w_hot.at[s, j],
+                sems.at[s, 2 * j]))
+            if not all_alive:
+                ds.append(pltpu.make_async_copy(
+                    alive.at[pl.ds(src_r, B)], w_alive.at[s, j],
+                    sems.at[s, 2 * j + 1]))
+        # dup feedback window: the inverse translation -> rows (+q0)
+        q0 = sref[base]
+        dup_r = jax.lax.rem(blk * B + q0, R)
+        ds.append(pltpu.make_async_copy(
+            inf_src.at[pl.ds(dup_r, B)], w_dup.at[s], sems.at[s, 2 * fanout]))
+        ds.append(pltpu.make_async_copy(
+            inf_src.at[pl.ds(blk * B, B)], b_inf.at[s],
+            sems.at[s, 2 * fanout + 1]))
+        ds.append(pltpu.make_async_copy(
+            hot_src.at[pl.ds(blk * B, B)], b_hot.at[s],
+            sems.at[s, 2 * fanout + 2]))
+        if not all_alive:
+            ds.append(pltpu.make_async_copy(
+                alive.at[pl.ds(blk * B, B)], b_alive.at[s],
+                sems.at[s, 2 * fanout + 3]))
+        return ds
+
+    def with_src(fn):
+        """Dispatch on the round's read source (ping-pong by parity;
+        round 0 reads the pristine inputs)."""
+        @pl.when(i == 0)
+        def _():
+            fn(inf0, hot0)
+
+        @pl.when((i > 0) & even)
+        def _():
+            fn(inf_b, hot_b)
+
+        @pl.when((i > 0) & ~even)
+        def _():
+            fn(inf_a, hot_a)
+
+    # ---- gather, double-buffered by block parity: block 0 starts its
+    # own windows (the round-boundary synchronous load — cross-round
+    # prefetch would race the previous round's writes); every step then
+    # waits its slot and immediately prefetches block b+1 into the
+    # other slot before computing.
+    @pl.when(b == 0)
+    def _():
+        with_src(lambda inf_src, hot_src: [
+            d.start() for d in window_copies(inf_src, hot_src, 0, 0)])
+
+    with_src(lambda inf_src, hot_src: [
+        d.wait() for d in window_copies(inf_src, hot_src, b, slot)])
+
+    if nb > 1:
+        @pl.when(b + 1 < nb)
+        def _():
+            with_src(lambda inf_src, hot_src: [
+                d.start()
+                for d in window_copies(inf_src, hot_src, b + 1, nslot)])
+
+    # ---- hot-count bookkeeping for the restart reseed: reset the
+    # accumulator at each round's first block; the value consumed is the
+    # count accumulated over the PREVIOUS round's blocks.
+    @pl.when(b == 0)
+    def _():
+        hotcnt[1] = hotcnt[0]
+        hotcnt[0] = 0
+
+    # ---- one round for this block
+    hit = jnp.zeros((B, LANES), jnp.uint32)
+    for j in range(fanout):
+        r = sref[base + 2 * j + 1]            # intra-row bits, [1, CELL)
+        send_w = w_hot[slot, j] if all_alive \
+            else (w_hot[slot, j] & w_alive[slot, j])
+        hit = hit | _row_bit_roll(send_w, r)
+
+    inf = b_inf[slot]
+    hot = b_hot[slot]
+    al = jnp.uint32(0xFFFFFFFF) if all_alive else b_alive[slot]
+    send = hot & al
+    new_inf = inf | (hit & al)
+    r0 = sref[base + 1]
+    dup = _row_bit_roll(w_dup[slot], CELL - r0) & send
+    newly = new_inf & ~inf
+    new_hot = hot | newly
+    if stop_k <= 1:
+        new_hot = new_hot & ~dup
+    else:
+        pltpu.prng_seed(sref[base + 2 * fanout], i * nb + b)
+        coin = _bernoulli_words(1.0 / stop_k, (B, LANES))
+        new_hot = new_hot & ~(dup & coin)
+    if churn > 0.0:
+        pltpu.prng_seed(sref[base + 2 * fanout], 7777 + i * nb + b)
+        reborn = _bernoulli_words(churn, (B, LANES))
+        new_inf = new_inf & ~reborn
+        new_hot = new_hot & ~reborn
+
+    # restart: the previous round ended with zero hot senders -> seed the
+    # round's patient zero (if it lives in this block)
+    dead = (i > 0) & (hotcnt[1] == 0)
+    pz = sref[base + 2 * fanout + 1]
+    bit = pz_bit(pz, (B, LANES), b * B, dead)
+    new_inf = new_inf | bit
+    new_hot = new_hot | bit
+
+    hotcnt[0] = hotcnt[0] + jnp.sum(
+        ((new_hot & al) != 0).astype(jnp.int32))
+
+    # ---- write back to this round's output buffer (synchronous: the
+    # waits here are what make the next round's block-0 load safe)
+    b_inf[slot] = new_inf
+    b_hot[slot] = new_hot
+
+    def write_out(inf_dst, hot_dst):
+        d1 = pltpu.make_async_copy(b_inf.at[slot],
+                                   inf_dst.at[pl.ds(b * B, B)],
+                                   sems.at[slot, 2 * fanout + 4])
+        d2 = pltpu.make_async_copy(b_hot.at[slot],
+                                   hot_dst.at[pl.ds(b * B, B)],
+                                   sems.at[slot, 2 * fanout + 5])
+        d1.start(); d2.start()
+        d1.wait(); d2.wait()
+        # block 0 also refreshes the halo mirror (rows R..R+B-1), which
+        # is what lets every window read skip wrap handling
+        @pl.when(b == 0)
+        def _():
+            h1 = pltpu.make_async_copy(b_inf.at[slot],
+                                       inf_dst.at[pl.ds(R, B)],
+                                       sems.at[slot, 2 * fanout + 4])
+            h2 = pltpu.make_async_copy(b_hot.at[slot],
+                                       hot_dst.at[pl.ds(R, B)],
+                                       sems.at[slot, 2 * fanout + 5])
+            h1.start(); h2.start()
+            h1.wait(); h2.wait()
+
+    @pl.when(even)
+    def _():
+        write_out(inf_a, hot_a)
+
+    @pl.when(~even)
+    def _():
+        write_out(inf_b, hot_b)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
 def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
                   stop_k: int = 1, churn: float = 0.0,
                   block_rows: int = 1024, interpret: bool = False,
-                  all_alive: bool = False):
+                  all_alive: bool = False,
+                  double_buffer: bool | None = None):
     """Run ``n_rounds`` of rumor mongering with HBM-resident state.
 
     ``packed`` is a models.demers.RumorWorldPacked; ``n`` must be a
@@ -213,12 +383,26 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
     ``all_alive=True`` (caller-asserted: packed.alive is all-ones, as in
     the churn benchmark, whose churn resets infection but never kills
     nodes) skips every alive DMA and mask — ~30% of the HBM traffic.
+
+    ``double_buffer`` selects the prefetch-overlap kernel variant
+    (block-parity double-buffered scratch; bit-identical output).
+    Measured IRRELEVANT on one chip: an interleaved A/B (5 trials each,
+    same process) gives 2^24 medians 11.3k sync vs 11.5k db and 2^26
+    medians 3.46k vs 3.52k — within the tunnel's trial noise, which
+    spans 9.7k-16.6k at 2^24.  Separate-invocation runs had suggested
+    +18%/-41% swings; those were noise too.  The synchronous kernel's
+    DMAs evidently already overlap enough under the hardware's own
+    queueing, so the simpler variant stays the default; the db variant
+    remains selectable for future geometries (multi-chip shards, bigger
+    blocks) where the boundary math changes.
     """
     R = n // CELL
     B = min(block_rows, R)
     assert R % B == 0, f"n/{CELL} = {R} rows must divide into {B}-row blocks"
     nb = R // B
     assert n_rounds >= 1
+    if double_buffer is None:
+        double_buffer = False
 
     # host-side randomness: per-(round, fanout) (q, r) + seed + patient
     # zero, packed as one int32 scalar-prefetch record per round.
@@ -237,28 +421,44 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
     shape = (R + B, LANES)     # +B = the halo mirror of rows 0..B-1
     halo = lambda x: jnp.concatenate(
         [x.reshape(R, LANES), x.reshape(R, LANES)[:B]], axis=0)
-    kern = functools.partial(_kernel, nb=nb, B=B, R=R, fanout=fanout,
-                             stop_k=stop_k, churn=churn,
-                             all_alive=all_alive)
+    kern = functools.partial(
+        _kernel_db if double_buffer else _kernel_sync,
+        nb=nb, B=B, R=R, fanout=fanout,
+        stop_k=stop_k, churn=churn, all_alive=all_alive)
+    if double_buffer:
+        scratch = [
+            pltpu.VMEM((2, fanout, B, LANES), jnp.uint32),   # w_hot
+            # alive buffers shrink to dummies on the all_alive fast
+            # path — their VMEM is the block-size headroom
+            pltpu.VMEM((2, 1, 1, 1) if all_alive
+                       else (2, fanout, B, LANES), jnp.uint32),  # w_alive
+            pltpu.VMEM((2, B, LANES), jnp.uint32),           # w_dup
+            pltpu.VMEM((2, B, LANES), jnp.uint32),           # b_inf
+            pltpu.VMEM((2, B, LANES), jnp.uint32),           # b_hot
+            pltpu.VMEM((2, 1, 1) if all_alive
+                       else (2, B, LANES), jnp.uint32),      # b_alive
+            pltpu.SMEM((2,), jnp.int32),                     # hotcnt
+            pltpu.SemaphoreType.DMA((2, 2 * fanout + 6,)),
+        ]
+    else:
+        scratch = [
+            pltpu.VMEM((fanout, B, LANES), jnp.uint32),      # w_hot
+            pltpu.VMEM((1, 1, 1) if all_alive
+                       else (fanout, B, LANES), jnp.uint32),  # w_alive
+            pltpu.VMEM((B, LANES), jnp.uint32),              # w_dup
+            pltpu.VMEM((B, LANES), jnp.uint32),              # b_inf
+            pltpu.VMEM((B, LANES), jnp.uint32),              # b_hot
+            pltpu.VMEM((1, 1) if all_alive
+                       else (B, LANES), jnp.uint32),         # b_alive
+            pltpu.SMEM((2,), jnp.int32),                     # hotcnt
+            pltpu.SemaphoreType.DMA((2 * fanout + 6,)),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_rounds, nb),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
         out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
-        scratch_shapes=[
-            pltpu.VMEM((fanout, B, LANES), jnp.uint32),   # w_hot
-            # alive buffers shrink to dummies on the all_alive fast
-            # path — their 1.5 MB of VMEM is the block-size headroom
-            pltpu.VMEM((1, 1, 1) if all_alive
-                       else (fanout, B, LANES), jnp.uint32),  # w_alive
-            pltpu.VMEM((B, LANES), jnp.uint32),           # w_dup
-            pltpu.VMEM((B, LANES), jnp.uint32),           # b_inf
-            pltpu.VMEM((B, LANES), jnp.uint32),           # b_hot
-            pltpu.VMEM((1, 1) if all_alive
-                       else (B, LANES), jnp.uint32),      # b_alive
-            pltpu.SMEM((2,), jnp.int32),                  # hotcnt
-            pltpu.SemaphoreType.DMA((2 * fanout + 6,)),
-        ],
+        scratch_shapes=scratch,
     )
     inf_a, hot_a, inf_b, hot_b = pl.pallas_call(
         kern,

@@ -96,6 +96,36 @@ class TestHbmKernelInterpret:
                                       np.asarray(b.infected))
         np.testing.assert_array_equal(np.asarray(a.hot), np.asarray(b.hot))
 
+    @pytest.mark.parametrize("rounds", [1, 2, 5])
+    def test_double_buffer_matches_numpy_reference(self, rounds):
+        """The prefetch-overlap kernel variant (double_buffer=True;
+        non-default — measured perf-neutral on chip, kept for future
+        geometries) is bit-exact against the same numpy model."""
+        n = 8 * CELL            # 8 blocks of 1 row
+        w = rumor_init(n, patient_zero=7)
+        out = rumor_run_hbm(rumor_pack(w), rounds, n, fanout=2,
+                            stop_k=1, churn=0.0, block_rows=1,
+                            interpret=True, double_buffer=True)
+        got = rumor_unpack(out, n)
+        ref_inf, ref_hot = numpy_reference(
+            np.asarray(w.infected), np.asarray(w.hot),
+            np.asarray(w.alive), rounds, n, 2, 1, int(w.rnd))
+        np.testing.assert_array_equal(np.asarray(got.infected), ref_inf)
+        np.testing.assert_array_equal(np.asarray(got.hot), ref_hot)
+
+    def test_variants_bit_identical(self):
+        """Sync and double-buffered kernels share host-side randomness
+        and semantics — outputs must match bit for bit."""
+        n = 8 * CELL
+        w = rumor_init(n, patient_zero=101)
+        a = rumor_run_hbm(rumor_pack(w), 6, n, 2, 1, 0.0, 1, True,
+                          False, False)
+        b = rumor_run_hbm(rumor_pack(w), 6, n, 2, 1, 0.0, 1, True,
+                          False, True)
+        np.testing.assert_array_equal(np.asarray(a.infected),
+                                      np.asarray(b.infected))
+        np.testing.assert_array_equal(np.asarray(a.hot), np.asarray(b.hot))
+
     def test_epidemic_spreads(self):
         n = 2 * CELL
         w = rumor_init(n, patient_zero=3)
