@@ -100,22 +100,36 @@ def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
                    ) -> jax.Array:
     """Route per-node proposals to their targets without scatter
     conflicts: node i proposes to ``targets[i]`` (−1 = none); each target
-    learns up to ``c`` proposers, ties broken uniformly at random.
-    Returns ``[n, c]`` proposer ids (−1 pad).  One lexsort + one
+    learns up to ``c`` proposers, ties broken (near-)uniformly at
+    random.  Returns ``[n, c]`` proposer ids (−1 pad).  One sort + one
     searchsorted + one scatter — the ops/msg.build_inbox recipe with the
-    inbox collapsed to ids, O(n log n), no [n, n] anything."""
+    inbox collapsed to ids, O(n log n), no [n, n] anything.
+
+    The sort is a SINGLE uint32 key (target id in the high bits, random
+    tiebreak in the low) with an index payload: the earlier
+    ``lexsort((r, sk))`` was a two-key variadic sort, whose TPU lowering
+    cost ~10x a single-key payload sort and dominated the 2^16 dense
+    round (promotion+shuffle each carry one reverse_select;
+    scripts/profile_dense.py / profile_merge.py — the same lowering
+    cliff lax.top_k hits).  Tiebreak width shrinks as n grows (14 bits
+    at 2^16); within a target's ~c-proposer bucket, low-bit collisions
+    merely make a rare tie deterministic."""
     m = targets.shape[0]
+    assert n < (1 << 27), "packed reverse_select key needs n < 2^27"
+    bits = 31 - max(n.bit_length(), 1)
     valid = (targets >= 0) & (targets < n)
-    sk = jnp.where(valid, targets, n)
+    sk = jnp.where(valid, targets, n).astype(jnp.uint32)
     r = _mix(jnp.arange(m, dtype=jnp.uint32) ^ salt)
-    order = jnp.lexsort((r, sk))
-    st = sk[order]
+    packed = (sk << bits) | (r >> (32 - bits))
+    sp, order = jax.lax.sort(
+        (packed, jnp.arange(m, dtype=jnp.int32)), dimension=0, num_keys=1)
+    st = (sp >> bits).astype(jnp.int32)
     starts = jnp.searchsorted(st, jnp.arange(n), side="left")
     pos = jnp.arange(m) - starts[jnp.clip(st, 0, n - 1)]
     ok = (st < n) & (pos < c)
     flat = jnp.where(ok, st * c + jnp.clip(pos, 0, c - 1), n * c)
     out = jnp.full((n * c + 1,), -1, jnp.int32)
-    out = out.at[flat].set(order.astype(jnp.int32))
+    out = out.at[flat].set(order)
     return out[: n * c].reshape((n, c))
 
 
@@ -126,9 +140,20 @@ def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.where((idx >= 0)[..., None], rows, -1)
 
 
-def make_dense_round(cfg: Config, churn: float = 0.0):
+def make_dense_round(cfg: Config, churn: float = 0.0,
+                     skip: frozenset = frozenset()):
     """Compile one dense round: ``state -> state``.  Deterministic from
-    (cfg.seed, state.rnd) like the engine's rounds."""
+    (cfg.seed, state.rnd) like the engine's rounds.
+
+    ``skip`` names phases to OMIT from the program entirely —
+    {"repair", "promotion", "shuffle", "merge"} — the surface
+    scripts/profile_dense.py uses to attribute round cost phase by
+    phase (config gating alone leaves the phase's ops in the program
+    as no-ops, which XLA does not always eliminate).  Production
+    callers leave it empty."""
+    assert skip <= {"repair", "promotion", "shuffle", "merge"}, (
+        f"unknown phase(s) in skip: "
+        f"{skip - {'repair', 'promotion', 'shuffle', 'merge'}}")
     N = cfg.n_nodes
     A = cfg.max_active_size
     P = cfg.max_passive_size
@@ -144,24 +169,34 @@ def make_dense_round(cfg: Config, churn: float = 0.0):
         either view, random-evict when full).  A sequence of K
         random-evict inserts ends at a random-ish subset of the union;
         this computes that subset directly — random rank over the
-        deduplicated union, keep P — one sort + one top-P instead of
-        ~6K scatter/gather kernels (the N=2^16 round was launch-bound
-        on exactly those; the distributional parity tests cover the
-        substitution)."""
+        deduplicated union, keep P — instead of ~6K scatter/gather
+        kernels (the N=2^16 round was launch-bound on exactly those;
+        the distributional parity tests cover the substitution).
+
+        Two structural choices are chip-measured (scripts/
+        profile_dense.py + profile_merge.py, N=2^16): dedup is ONE
+        value-sort + adjacent-compare (the earlier [N, W, W] pairwise
+        compare and this sort cost the same, but the sort composes with
+        the next point), and the random-P-of-union selection is a
+        two-operand ``lax.sort`` keyed by negated priority — NOT
+        ``lax.top_k``, whose lowering at [N, 62] -> 30 ran the whole
+        merge at 45 merges/s vs 536 for the payload sort (12x;
+        ``approx_max_k`` and a packed single-operand uint32 sort both
+        hit the same slow path).  The kept subset is exact and
+        distribution-identical: descending priority order, first P."""
         W = passive.shape[1] + cands.shape[1]
         cat = jnp.concatenate([passive, cands], axis=1)       # [N, W]
         ok = (cat >= 0) & (cat != ids[:, None])
         ok &= ~jnp.any(cat[:, :, None] == active[:, None, :], axis=-1)
-        # dedup within the row: entry j is a duplicate iff an earlier
-        # valid column holds the same peer — a [W, W] pairwise compare
-        # vectorizes better on the VPU than row sorts (width ~64)
-        eq = (cat[:, :, None] == cat[:, None, :]) \
-            & ok[:, :, None] & ok[:, None, :]
-        earlier = jnp.arange(W)[:, None] > jnp.arange(W)[None, :]
-        ok &= ~jnp.any(eq & earlier[None, :, :], axis=2)
-        pri = jnp.where(ok, jax.random.uniform(key, cat.shape), -1.0)
-        _, keep = jax.lax.top_k(pri, passive.shape[1])
-        return jnp.take_along_axis(jnp.where(ok, cat, -1), keep, axis=1)
+        big = jnp.int32(1) << 30
+        sv = jnp.sort(jnp.where(ok, cat, big), axis=1)        # [N, W]
+        first = jnp.concatenate(
+            [jnp.ones((N, 1), bool), sv[:, 1:] != sv[:, :-1]], axis=1)
+        ok2 = (sv < big) & first
+        pri = jnp.where(ok2, jax.random.uniform(key, sv.shape), -1.0)
+        _, out = jax.lax.sort((-pri, jnp.where(ok2, sv, -1)),
+                              dimension=1, num_keys=1)
+        return out[:, : passive.shape[1]]
 
     def step(state: DenseHvState) -> DenseHvState:
         key = jax.random.fold_in(
@@ -185,16 +220,18 @@ def make_dense_round(cfg: Config, churn: float = 0.0):
             passive = passive.at[:, 0].set(
                 jnp.where(reset, contact, passive[:, 0]))
 
+        demote = []  # all passive-bound peers merge once, at the end
         # ---- repair: liveness + symmetry prune, demote to passive
-        peer_rows = _gather_rows(active, active)            # [N, A, A]
-        mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
-        ok_edge = (active >= 0) & alive[jnp.clip(active, 0, N - 1)] \
-            & mutual & alive[:, None]
-        pruned = jnp.where((active >= 0) & ~ok_edge
-                           & alive[jnp.clip(active, 0, N - 1)],
-                           active, -1)  # demote only live asymmetric peers
-        active = jnp.where(ok_edge, active, -1)
-        demote = [pruned]  # all passive-bound peers merge once, at the end
+        if "repair" not in skip:
+            peer_rows = _gather_rows(active, active)        # [N, A, A]
+            mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
+            ok_edge = (active >= 0) & alive[jnp.clip(active, 0, N - 1)] \
+                & mutual & alive[:, None]
+            pruned = jnp.where((active >= 0) & ~ok_edge
+                               & alive[jnp.clip(active, 0, N - 1)],
+                               active, -1)  # demote live asymmetric peers
+            active = jnp.where(ok_edge, active, -1)
+            demote.append(pruned)
 
         # ---- isolation re-subscribe: a live node with BOTH views empty
         # has no protocol path back (its rebirth contact may itself have
@@ -210,98 +247,108 @@ def make_dense_round(cfg: Config, churn: float = 0.0):
             jnp.where(lonely, fresh, passive[:, 0]))
 
         # ---- promotion / join (neighbor_request :975-1089)
-        sizes = jnp.sum(active >= 0, axis=1)
-        isolated = sizes == 0
-        due = (((state.rnd + ids) % cfg.random_promotion_interval) == 0) \
-            | isolated
-        cand = jax.vmap(ps.random_member)(passive, nkeys(key, 3))
-        in_act = jax.vmap(ps.contains)(active, cand)
-        cand = jnp.where(in_act, -1, cand)
-        # propose while under max_active: promotion doubles as the join
-        # path here (dense bootstrap has no separate join storm), and
-        # joins in the reference add at the target regardless of the
-        # proposer's fill level (:703-771); under-min urgency is carried
-        # by the priority bit instead
-        propose = alive & due & (sizes < A) & (cand >= 0)
-        target = jnp.where(propose, cand, -1)
-        # failed-connect analog: a proposal to a dead candidate is
-        # refused below AND the candidate is dropped from passive
-        # (the reference drops unconnectable promotion candidates)
-        t_dead = propose & ~alive[jnp.clip(target, 0, N - 1)]
-        passive = jnp.where(
-            (passive == jnp.where(t_dead, target, -2)[:, None]),
-            -1, passive)
-        chosen = reverse_select(
-            jnp.where(t_dead, -1, target),
-            jax.random.bits(jax.random.fold_in(key, 4), (), jnp.uint32),
-            N, 2)                                           # [N, 2]
-        acc = jnp.zeros((N, 2), bool)
-        for j in range(2):
-            p_j = chosen[:, j]
-            high = jnp.sum(_gather_rows(active, p_j[:, None])[:, 0] >= 0,
-                           axis=-1) == 0                    # proposer isolated
-            room = jnp.sum(active >= 0, axis=1) < A
-            a_j = (p_j >= 0) & alive & (room | high)
-            acc = acc.at[:, j].set(a_j)
-            kj = nkeys(key, 5 + j)
-            active, evicted, _ = jax.vmap(ps.insert_evict)(
-                active, jnp.where(a_j, p_j, -1), kj)
-            # eviction demotes the victim on the evictor's side
-            # (:1466-1512); the victim's own side heals at next repair
-            demote.append(evicted[:, None])
-        # proposer side: did my target accept me?
-        tc = jnp.clip(target, 0, N - 1)
-        accepted = propose & ~t_dead & (
-            ((chosen[tc, 0] == ids) & acc[tc, 0])
-            | ((chosen[tc, 1] == ids) & acc[tc, 1]))
-        active, ev2, _ = jax.vmap(ps.insert_evict)(
-            active, jnp.where(accepted, target, -1), nkeys(key, 9))
-        demote.append(ev2[:, None])
-        # (a promoted peer leaves the passive view automatically: the
-        # final bulk merge masks out every entry now present in active —
-        # move_peer_from_passive_to_active :1678-1709)
+        if "promotion" not in skip:
+            sizes = jnp.sum(active >= 0, axis=1)
+            isolated = sizes == 0
+            due = (((state.rnd + ids) % cfg.random_promotion_interval)
+                   == 0) | isolated
+            cand = jax.vmap(ps.random_member)(passive, nkeys(key, 3))
+            in_act = jax.vmap(ps.contains)(active, cand)
+            cand = jnp.where(in_act, -1, cand)
+            # propose while under max_active: promotion doubles as the
+            # join path here (dense bootstrap has no separate join
+            # storm), and joins in the reference add at the target
+            # regardless of the proposer's fill level (:703-771);
+            # under-min urgency is carried by the priority bit instead
+            propose = alive & due & (sizes < A) & (cand >= 0)
+            target = jnp.where(propose, cand, -1)
+            # failed-connect analog: a proposal to a dead candidate is
+            # refused below AND the candidate is dropped from passive
+            # (the reference drops unconnectable promotion candidates)
+            t_dead = propose & ~alive[jnp.clip(target, 0, N - 1)]
+            passive = jnp.where(
+                (passive == jnp.where(t_dead, target, -2)[:, None]),
+                -1, passive)
+            chosen = reverse_select(
+                jnp.where(t_dead, -1, target),
+                jax.random.bits(jax.random.fold_in(key, 4), (),
+                                jnp.uint32),
+                N, 2)                                       # [N, 2]
+            acc = jnp.zeros((N, 2), bool)
+            for j in range(2):
+                p_j = chosen[:, j]
+                high = jnp.sum(
+                    _gather_rows(active, p_j[:, None])[:, 0] >= 0,
+                    axis=-1) == 0                  # proposer isolated
+                room = jnp.sum(active >= 0, axis=1) < A
+                a_j = (p_j >= 0) & alive & (room | high)
+                acc = acc.at[:, j].set(a_j)
+                kj = nkeys(key, 5 + j)
+                active, evicted, _ = jax.vmap(ps.insert_evict)(
+                    active, jnp.where(a_j, p_j, -1), kj)
+                # eviction demotes the victim on the evictor's side
+                # (:1466-1512); the victim's side heals at next repair
+                demote.append(evicted[:, None])
+            # proposer side: did my target accept me?
+            tc = jnp.clip(target, 0, N - 1)
+            accepted = propose & ~t_dead & (
+                ((chosen[tc, 0] == ids) & acc[tc, 0])
+                | ((chosen[tc, 1] == ids) & acc[tc, 1]))
+            active, ev2, _ = jax.vmap(ps.insert_evict)(
+                active, jnp.where(accepted, target, -1), nkeys(key, 9))
+            demote.append(ev2[:, None])
+            # (a promoted peer leaves the passive view automatically:
+            # the final bulk merge masks out every entry now present in
+            # active — move_peer_from_passive_to_active :1678-1709)
 
         # ---- shuffle (passive_view_maintenance :572-607)
-        due_s = alive & (((state.rnd + ids) % cfg.shuffle_interval) == 0)
-        # every node's own sample: me ++ k_a active ++ k_p passive
-        samp = jnp.concatenate([
-            ids[:, None],
-            jax.vmap(ps.random_k, in_axes=(0, 0, None))(
-                active, nkeys(key, 11), cfg.shuffle_k_active),
-            jax.vmap(ps.random_k, in_axes=(0, 0, None))(
-                passive, nkeys(key, 12), cfg.shuffle_k_passive),
-        ], axis=1)                                          # [N, S]
-        # ARWL-hop walk through active views (one gather per hop)
-        e = ids
-        for h in range(cfg.arwl):
-            rows = _gather_rows(active, e)
-            kh = nkeys(key, 13 + h)
-            step_to = jax.vmap(
-                lambda r, k, ex: ps.random_member(r, k, exclude=ex)
-            )(rows, kh, jnp.stack([ids, e], axis=1))
-            e = jnp.where(step_to >= 0, step_to, e)
-        ep = jnp.where(due_s & (e != ids) & alive[jnp.clip(e, 0, N - 1)],
-                       e, -1)
-        # forward merge: origin folds the endpoint's sample (shuffle_reply)
-        fwd_samp = jnp.where((ep >= 0)[:, None],
-                             samp[jnp.clip(ep, 0, N - 1)], -1)
-        demote.append(fwd_samp)
-        # reverse merge: endpoints fold origin samples (the shuffle body),
-        # up to 2 origins per endpoint per round (collisions wait for the
-        # next stagger slot — the engine path serializes them the same way
-        # through the inbox)
-        rchosen = reverse_select(
-            ep, jax.random.bits(jax.random.fold_in(key, 31), (), jnp.uint32),
-            N, 2)
-        for j in range(2):
-            o_j = rchosen[:, j]
-            demote.append(jnp.where((o_j >= 0)[:, None],
-                                    samp[jnp.clip(o_j, 0, N - 1)], -1))
+        if "shuffle" not in skip:
+            due_s = alive \
+                & (((state.rnd + ids) % cfg.shuffle_interval) == 0)
+            # every node's own sample: me ++ k_a active ++ k_p passive
+            samp = jnp.concatenate([
+                ids[:, None],
+                jax.vmap(ps.random_k, in_axes=(0, 0, None))(
+                    active, nkeys(key, 11), cfg.shuffle_k_active),
+                jax.vmap(ps.random_k, in_axes=(0, 0, None))(
+                    passive, nkeys(key, 12), cfg.shuffle_k_passive),
+            ], axis=1)                                      # [N, S]
+            # ARWL-hop walk through active views (one gather per hop)
+            e = ids
+            for h in range(cfg.arwl):
+                rows = _gather_rows(active, e)
+                kh = nkeys(key, 13 + h)
+                step_to = jax.vmap(
+                    lambda r, k, ex: ps.random_member(r, k, exclude=ex)
+                )(rows, kh, jnp.stack([ids, e], axis=1))
+                e = jnp.where(step_to >= 0, step_to, e)
+            ep = jnp.where(
+                due_s & (e != ids) & alive[jnp.clip(e, 0, N - 1)], e, -1)
+            # forward merge: origin folds the endpoint's sample
+            # (shuffle_reply)
+            fwd_samp = jnp.where((ep >= 0)[:, None],
+                                 samp[jnp.clip(ep, 0, N - 1)], -1)
+            demote.append(fwd_samp)
+            # reverse merge: endpoints fold origin samples (the shuffle
+            # body), up to 2 origins per endpoint per round (collisions
+            # wait for the next stagger slot — the engine path
+            # serializes them the same way through the inbox)
+            rchosen = reverse_select(
+                ep,
+                jax.random.bits(jax.random.fold_in(key, 31), (),
+                                jnp.uint32),
+                N, 2)
+            for j in range(2):
+                o_j = rchosen[:, j]
+                demote.append(jnp.where((o_j >= 0)[:, None],
+                                        samp[jnp.clip(o_j, 0, N - 1)],
+                                        -1))
 
         # ---- single fused passive merge for every phase's candidates
-        passive = bulk_passive_merge(
-            active, passive, jnp.concatenate(demote, axis=1),
-            jax.random.fold_in(key, 50))
+        if "merge" not in skip and demote:
+            passive = bulk_passive_merge(
+                active, passive, jnp.concatenate(demote, axis=1),
+                jax.random.fold_in(key, 50))
 
         return DenseHvState(active=active, passive=passive, alive=alive,
                             rnd=state.rnd + 1)

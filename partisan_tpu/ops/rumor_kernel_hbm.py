@@ -74,6 +74,52 @@ def _row_bit_roll(x: jax.Array, s: jax.Array) -> jax.Array:
     return jnp.where(r == 0, xw, (xw << r) | carry)
 
 
+def _block_round(sref, base, i, b, nb, B, fanout, stop_k, churn,
+                 all_alive, w_hot_js, w_alive_js, w_dup_v, inf, hot, al,
+                 hotcnt):
+    """One epidemic round for one block — the compute shared verbatim by
+    _kernel_sync and _kernel_db (which differ only in how scratch refs
+    resolve: plain vs block-parity slot).  Takes already-loaded VALUES,
+    accumulates the block's surviving hot count into ``hotcnt[0]``, and
+    returns (new_inf, new_hot)."""
+    hit = jnp.zeros((B, LANES), jnp.uint32)
+    for j in range(fanout):
+        r = sref[base + 2 * j + 1]            # intra-row bits, [1, CELL)
+        send_w = w_hot_js[j] if all_alive \
+            else (w_hot_js[j] & w_alive_js[j])
+        hit = hit | _row_bit_roll(send_w, r)
+
+    send = hot & al
+    new_inf = inf | (hit & al)
+    r0 = sref[base + 1]
+    dup = _row_bit_roll(w_dup_v, CELL - r0) & send
+    newly = new_inf & ~inf
+    new_hot = hot | newly
+    if stop_k <= 1:
+        new_hot = new_hot & ~dup
+    else:
+        pltpu.prng_seed(sref[base + 2 * fanout], i * nb + b)
+        coin = _bernoulli_words(1.0 / stop_k, (B, LANES))
+        new_hot = new_hot & ~(dup & coin)
+    if churn > 0.0:
+        pltpu.prng_seed(sref[base + 2 * fanout], 7777 + i * nb + b)
+        reborn = _bernoulli_words(churn, (B, LANES))
+        new_inf = new_inf & ~reborn
+        new_hot = new_hot & ~reborn
+
+    # restart: the previous round ended with zero hot senders -> seed
+    # the round's patient zero (if it lives in this block)
+    dead = (i > 0) & (hotcnt[1] == 0)
+    pz = sref[base + 2 * fanout + 1]
+    bit = pz_bit(pz, (B, LANES), b * B, dead)
+    new_inf = new_inf | bit
+    new_hot = new_hot | bit
+
+    hotcnt[0] = hotcnt[0] + jnp.sum(
+        ((new_hot & al) != 0).astype(jnp.int32))
+    return new_inf, new_hot
+
+
 def _kernel_sync(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
             # scratch
             w_hot, w_alive, w_dup, b_inf, b_hot, b_alive, hotcnt, sems,
@@ -136,44 +182,13 @@ def _kernel_sync(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
         hotcnt[1] = hotcnt[0]
         hotcnt[0] = 0
 
-    # ---- one round for this block
-    hit = jnp.zeros((B, LANES), jnp.uint32)
-    for j in range(fanout):
-        r = sref[base + 2 * j + 1]            # intra-row bits, [1, CELL)
-        send_w = w_hot[j] if all_alive else (w_hot[j] & w_alive[j])
-        hit = hit | _row_bit_roll(send_w, r)
-
-    inf = b_inf[:]
-    hot = b_hot[:]
+    # ---- one round for this block (shared compute)
     al = jnp.uint32(0xFFFFFFFF) if all_alive else b_alive[:]
-    send = hot & al
-    new_inf = inf | (hit & al)
-    r0 = sref[base + 1]
-    dup = _row_bit_roll(w_dup[:], CELL - r0) & send
-    newly = new_inf & ~inf
-    new_hot = hot | newly
-    if stop_k <= 1:
-        new_hot = new_hot & ~dup
-    else:
-        pltpu.prng_seed(sref[base + 2 * fanout], i * nb + b)
-        coin = _bernoulli_words(1.0 / stop_k, (B, LANES))
-        new_hot = new_hot & ~(dup & coin)
-    if churn > 0.0:
-        pltpu.prng_seed(sref[base + 2 * fanout], 7777 + i * nb + b)
-        reborn = _bernoulli_words(churn, (B, LANES))
-        new_inf = new_inf & ~reborn
-        new_hot = new_hot & ~reborn
-
-    # restart: the previous round ended with zero hot senders -> seed the
-    # round's patient zero (if it lives in this block)
-    dead = (i > 0) & (hotcnt[1] == 0)
-    pz = sref[base + 2 * fanout + 1]
-    bit = pz_bit(pz, (B, LANES), b * B, dead)
-    new_inf = new_inf | bit
-    new_hot = new_hot | bit
-
-    hotcnt[0] = hotcnt[0] + jnp.sum(
-        ((new_hot & al) != 0).astype(jnp.int32))
+    new_inf, new_hot = _block_round(
+        sref, base, i, b, nb, B, fanout, stop_k, churn, all_alive,
+        [w_hot[j] for j in range(fanout)],
+        None if all_alive else [w_alive[j] for j in range(fanout)],
+        w_dup[:], b_inf[:], b_hot[:], al, hotcnt)
 
     # ---- write back to this round's output buffer
     b_inf[:] = new_inf
@@ -293,45 +308,13 @@ def _kernel_db(sref, inf0, hot0, alive, inf_a, hot_a, inf_b, hot_b,
         hotcnt[1] = hotcnt[0]
         hotcnt[0] = 0
 
-    # ---- one round for this block
-    hit = jnp.zeros((B, LANES), jnp.uint32)
-    for j in range(fanout):
-        r = sref[base + 2 * j + 1]            # intra-row bits, [1, CELL)
-        send_w = w_hot[slot, j] if all_alive \
-            else (w_hot[slot, j] & w_alive[slot, j])
-        hit = hit | _row_bit_roll(send_w, r)
-
-    inf = b_inf[slot]
-    hot = b_hot[slot]
+    # ---- one round for this block (shared compute, slot-resolved refs)
     al = jnp.uint32(0xFFFFFFFF) if all_alive else b_alive[slot]
-    send = hot & al
-    new_inf = inf | (hit & al)
-    r0 = sref[base + 1]
-    dup = _row_bit_roll(w_dup[slot], CELL - r0) & send
-    newly = new_inf & ~inf
-    new_hot = hot | newly
-    if stop_k <= 1:
-        new_hot = new_hot & ~dup
-    else:
-        pltpu.prng_seed(sref[base + 2 * fanout], i * nb + b)
-        coin = _bernoulli_words(1.0 / stop_k, (B, LANES))
-        new_hot = new_hot & ~(dup & coin)
-    if churn > 0.0:
-        pltpu.prng_seed(sref[base + 2 * fanout], 7777 + i * nb + b)
-        reborn = _bernoulli_words(churn, (B, LANES))
-        new_inf = new_inf & ~reborn
-        new_hot = new_hot & ~reborn
-
-    # restart: the previous round ended with zero hot senders -> seed the
-    # round's patient zero (if it lives in this block)
-    dead = (i > 0) & (hotcnt[1] == 0)
-    pz = sref[base + 2 * fanout + 1]
-    bit = pz_bit(pz, (B, LANES), b * B, dead)
-    new_inf = new_inf | bit
-    new_hot = new_hot | bit
-
-    hotcnt[0] = hotcnt[0] + jnp.sum(
-        ((new_hot & al) != 0).astype(jnp.int32))
+    new_inf, new_hot = _block_round(
+        sref, base, i, b, nb, B, fanout, stop_k, churn, all_alive,
+        [w_hot[slot, j] for j in range(fanout)],
+        None if all_alive else [w_alive[slot, j] for j in range(fanout)],
+        w_dup[slot], b_inf[slot], b_hot[slot], al, hotcnt)
 
     # ---- write back to this round's output buffer (synchronous: the
     # waits here are what make the next round's block-0 load safe)
@@ -374,7 +357,7 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
                   stop_k: int = 1, churn: float = 0.0,
                   block_rows: int = 1024, interpret: bool = False,
                   all_alive: bool = False,
-                  double_buffer: bool | None = None):
+                  double_buffer: bool = False):
     """Run ``n_rounds`` of rumor mongering with HBM-resident state.
 
     ``packed`` is a models.demers.RumorWorldPacked; ``n`` must be a
@@ -401,8 +384,6 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
     assert R % B == 0, f"n/{CELL} = {R} rows must divide into {B}-row blocks"
     nb = R // B
     assert n_rounds >= 1
-    if double_buffer is None:
-        double_buffer = False
 
     # host-side randomness: per-(round, fanout) (q, r) + seed + patient
     # zero, packed as one int32 scalar-prefetch record per round.

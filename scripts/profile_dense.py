@@ -1,9 +1,10 @@
 """Phase ablation for the dense HyParView round (ROADMAP 1b headroom:
-N=2^16 ~16 rounds/s on chip; which phase pays?).
+which phase pays at N=2^16?).
 
-Times run_dense with individual phases neutralized via config/monkeypatch
-and prints per-variant rounds/s.  A phase whose removal moves the rate is
-the lever; one whose removal does nothing is already free.
+Uses make_dense_round's ``skip`` parameter to OMIT phases from the
+compiled program (config gating alone leaves dead ops XLA may keep) and
+times each variant as a whole-run scan — single jit calls through the
+TPU tunnel carry ~100 ms dispatch latency and measure nothing.
 
 Usage: python scripts/profile_dense.py [--n 65536] [--rounds 300]
 """
@@ -11,6 +12,7 @@ Usage: python scripts/profile_dense.py [--n 65536] [--rounds 300]
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import statistics
 import sys
@@ -25,34 +27,25 @@ import partisan_tpu as pt  # noqa: E402
 from partisan_tpu.models import hyparview_dense as hd  # noqa: E402
 
 
-def timed(tag, cfg, rounds, churn, make_round=None):
-    orig = hd.make_dense_round
-    if make_round is not None:
-        hd.make_dense_round = make_round
-    try:
-        # fresh jit wrapper per variant: run_dense's cache key would not
-        # see the monkeypatch
-        import functools
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def run_skip(state, n_rounds, cfg, churn, skip):
+    step = hd.make_dense_round(cfg, churn, skip=skip)
+    out, _ = jax.lax.scan(lambda s, _: (step(s), None), state, None,
+                          length=n_rounds)
+    return out
 
-        @functools.partial(jax.jit, static_argnums=(1, 2, 3))
-        def run(state, n_rounds, cfg, churn=0.0):
-            step = hd.make_dense_round(cfg, churn)
-            out, _ = jax.lax.scan(lambda s, _: (step(s), None), state,
-                                  None, length=n_rounds)
-            return out
 
-        w = run(hd.dense_init(cfg), rounds, cfg, churn)
+def timed(tag, cfg, rounds, churn, skip=frozenset()):
+    w = run_skip(hd.dense_init(cfg), rounds, cfg, churn, skip)
+    float(jnp.sum(w.active))
+    rates = []
+    for t in range(3):
+        w0 = hd.dense_init(cfg.replace(seed=31 + t))
+        t0 = time.perf_counter()
+        w = run_skip(w0, rounds, cfg, churn, skip)
         float(jnp.sum(w.active))
-        rates = []
-        for t in range(3):
-            w0 = hd.dense_init(cfg.replace(seed=31 + t))
-            t0 = time.perf_counter()
-            w = run(w0, rounds, cfg, churn)
-            float(jnp.sum(w.active))
-            rates.append(rounds / (time.perf_counter() - t0))
-        print(f"{tag:24s} {statistics.median(rates):8.1f} rounds/s")
-    finally:
-        hd.make_dense_round = orig
+        rates.append(rounds / (time.perf_counter() - t0))
+    print(f"{tag:24s} {statistics.median(rates):8.1f} rounds/s")
 
 
 def main():
@@ -65,29 +58,10 @@ def main():
 
     timed("full", cfg, args.rounds, 0.01)
     timed("no_churn", cfg, args.rounds, 0.0)
-    timed("no_shuffle", cfg.replace(shuffle_interval=1 << 20),
-          args.rounds, 0.01)
-    timed("no_promotion", cfg.replace(random_promotion_interval=1 << 20),
-          args.rounds, 0.01)
+    for phase in ("repair", "promotion", "shuffle", "merge"):
+        timed(f"skip_{phase}", cfg, args.rounds, 0.01,
+              frozenset([phase]))
     timed("arwl_1", cfg.replace(arwl=1), args.rounds, 0.01)
-
-    # surgical variants: strip one whole-array phase from the round
-    orig = hd.make_dense_round
-
-    def no_merge(cfg, churn=0.0):
-        import partisan_tpu.models.hyparview_dense as m
-        real = orig(cfg, churn)
-
-        def step(state):
-            out = real(state)
-            return out.replace(passive=state.passive)  # discard merge work?
-        return jax.jit(step)
-
-    # NOTE: returning old passive does NOT remove the merge from the
-    # compiled program (XLA DCEs it instead) — so this variant measures
-    # the merge's true cost by difference: if XLA removes it, the rate
-    # jump equals its cost.
-    timed("dce_bulk_merge", cfg, args.rounds, 0.01, make_round=no_merge)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,121 @@
+"""Micro-ablation of hyparview_dense.bulk_passive_merge internals at
+N=2^16 (the phase ablation showed the merge is ~2/3 of the round; the
+[W,W]->sort dedup swap moved nothing, so the cost is elsewhere in it).
+
+Times standalone jitted variants on representative inputs: which of
+{active-mask, value-sort, threefry uniform, top_k} pays?
+
+Usage: python scripts/profile_merge.py [--n 65536]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from partisan_tpu.ops.bitset import mix32  # noqa: E402
+
+P, A, K = 30, 6, 32     # passive width, active width, candidate width
+
+
+def inputs(n, seed):
+    k = jax.random.PRNGKey(seed)
+    ka, kp, kc = jax.random.split(k, 3)
+    active = jax.random.randint(ka, (n, A), -1, n, jnp.int32)
+    passive = jax.random.randint(kp, (n, P), -1, n, jnp.int32)
+    cands = jax.random.randint(kc, (n, K), -1, n, jnp.int32)
+    return active, passive, cands
+
+
+def make_variant(which, n):
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def merge(active, passive, cands, key):
+        W = P + K
+        cat = jnp.concatenate([passive, cands], axis=1)
+        ok = (cat >= 0) & (cat != ids[:, None])
+        if which != "no_activemask":
+            ok &= ~jnp.any(cat[:, :, None] == active[:, None, :], axis=-1)
+        big = jnp.int32(1) << 30
+        if which == "no_sort":
+            sv, first = jnp.where(ok, cat, big), jnp.ones(cat.shape, bool)
+        else:
+            sv = jnp.sort(jnp.where(ok, cat, big), axis=1)
+            first = jnp.concatenate(
+                [jnp.ones((n, 1), bool), sv[:, 1:] != sv[:, :-1]], axis=1)
+        ok2 = (sv < big) & first
+        if which == "hash_pri":
+            h = mix32(sv.astype(jnp.uint32)
+                      ^ jax.random.bits(key, (), jnp.uint32))
+            pri = jnp.where(ok2, h.astype(jnp.float32), -1.0)
+        else:
+            pri = jnp.where(ok2, jax.random.uniform(key, sv.shape), -1.0)
+        if which == "no_topk":
+            return jnp.where(ok2, sv, -1)[:, :P]
+        if which == "sort2":
+            masked = jnp.where(ok2, sv, -1)
+            _, out = jax.lax.sort((-pri, masked), dimension=1, num_keys=1)
+            return out[:, :P]
+        if which == "approx":
+            _, keep = jax.lax.approx_max_k(pri, P)
+            return jnp.take_along_axis(jnp.where(ok2, sv, -1), keep,
+                                       axis=1)
+        if which == "packed":
+            # single-operand uint32 sort: 16-bit random rank | low bits
+            # of a shuffled value surrogate; then gather by recovered
+            # column index.  rank<<16 | column  (column fits 16 bits)
+            col = jnp.arange(sv.shape[1], dtype=jnp.uint32)[None, :]
+            h = mix32(sv.astype(jnp.uint32) * jnp.uint32(2654435761)
+                      ^ jax.random.bits(key, (), jnp.uint32))
+            rank = jnp.where(ok2, h >> 16, jnp.uint32(0xFFFF))
+            packed = (rank << 16) | col
+            srt = jnp.sort(packed, axis=1)[:, :P]
+            keep = (srt & jnp.uint32(0xFFFF)).astype(jnp.int32)
+            out = jnp.take_along_axis(jnp.where(ok2, sv, -1), keep, axis=1)
+            return jnp.where((srt >> 16) == 0xFFFF, -1, out)
+        _, keep = jax.lax.top_k(pri, P)
+        return jnp.take_along_axis(jnp.where(ok2, sv, -1), keep, axis=1)
+
+    def run(active, passive, cands, key, rounds=100):
+        def body(c, _):
+            p, k = c
+            k1, k2 = jax.random.split(k)
+            return (merge(active, p, cands, k1), k2), None
+
+        (p, _), _ = jax.lax.scan(body, (passive, key), None, length=rounds)
+        return p
+
+    return jax.jit(run, static_argnums=(4,))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 16)
+    ap.add_argument("--rounds", type=int, default=100)
+    args = ap.parse_args()
+    for which in ("full", "no_activemask", "no_sort", "hash_pri",
+                  "no_topk", "sort2", "approx", "packed"):
+        fn = make_variant(which, args.n)
+        a, p, c = inputs(args.n, 1)
+        out = fn(a, p, c, jax.random.PRNGKey(2), args.rounds)
+        float(jnp.sum(out))
+        rates = []
+        for t in range(3):
+            a, p, c = inputs(args.n, 10 + t)
+            t0 = time.perf_counter()
+            out = fn(a, p, c, jax.random.PRNGKey(3 + t), args.rounds)
+            float(jnp.sum(out))
+            rates.append(args.rounds / (time.perf_counter() - t0))
+        print(f"{which:16s} {statistics.median(rates):8.1f} merges/s")
+
+
+if __name__ == "__main__":
+    main()
