@@ -184,7 +184,6 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
         ``approx_max_k`` and a packed single-operand uint32 sort both
         hit the same slow path).  The kept subset is exact and
         distribution-identical: descending priority order, first P."""
-        W = passive.shape[1] + cands.shape[1]
         cat = jnp.concatenate([passive, cands], axis=1)       # [N, W]
         ok = (cat >= 0) & (cat != ids[:, None])
         ok &= ~jnp.any(cat[:, :, None] == active[:, None, :], axis=-1)

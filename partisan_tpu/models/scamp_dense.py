@@ -1,0 +1,358 @@
+"""Dense-representation SCAMP — the second membership strategy re-laid
+TPU-fast (the models/hyparview_dense.py recipe applied to
+``src/partisan_scamp_v2_membership_strategy.erl``).
+
+The engine path (models/scamp.py) proves the protocol message for
+message: subscription walks that hop one partial-view member per round
+and keep with probability 1/(1+|view|), the contact fan-out of
+|view| + c copies per join, keep-notifications filling the in-view
+(v2 :328-338).  Its COO message shape is scatter-latency-bound at TPU
+scale like HyParView's was.  This module re-expresses the same dynamics
+as whole-array ops:
+
+  walkers    every in-flight subscription walk is a (subject, position)
+             pair in a fixed [N, C] slot table: subject = row, position
+             = current holder.  One round = one gather of the holders'
+             views + one keep-coin per walker + one hop gather — the
+             engine's forward_subscription handler (:284-327)
+             batch-evaluated for every walk at once.
+  keep       walkers that keep propose (subject -> holder) through
+             ``reverse_select`` — the same sort-routed delivery the
+             dense HyParView uses for neighbor proposals — and the
+             holder admits up to 4 new subscriptions per round
+             (duplicate subjects deduped); a second reverse_select
+             routes the v2 keep-notification back to the subject's
+             in-view.  Full views refuse-and-count (the padded-set
+             analog of the reference's unbounded orddict).
+  join       a churned/reborn node adopts a random live contact and
+             spawns its walk copies AT the contact: one per contact
+             partial-view member plus ``scamp_c`` extras at random
+             members (subscription fan-out, v2 :64-117) — positions
+             gathered from the contact's row, no messages.
+  isolation  a live node with an empty partial view and no active
+             walkers re-subscribes through a fresh random contact
+             (isolation detection, v2 :130-178).
+
+What is deliberately NOT carried over (and why that is faithful):
+graceful leave/rewiring and remove_subscription gossip are
+reconfiguration VERBS, exercised against the engine path
+(tests/test_scamp.py) — the dense variant models the steady-state +
+churn regime the big-N benchmarks measure, where departure is crash
+and recovery is re-subscription.  Walks expire (counted) after
+``max_age`` hops instead of walking forever: the keep-coin terminates
+real walks in O(|view|) hops, so expiry only fires on pathological
+orphans (e.g. every reachable view saturated).
+
+Parity bar (SURVEY §7.3 "two RNG semantics"): distributional —
+tests/test_scamp_dense.py asserts weak connectivity and that the
+view-size distribution brackets the engine path's at N=256.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..ops import padded_set as ps
+from .hyparview_dense import _gather_rows, reverse_select
+from .scamp import default_view_cap
+
+
+@struct.dataclass
+class DenseScampState:
+    partial: jax.Array    # [N, P] padded partial view
+    in_view: jax.Array    # [N, P] padded in-view (who holds my sub)
+    walk_pos: jax.Array   # [N, C] walker positions (-1 = inactive)
+    walk_age: jax.Array   # [N, C] hops walked
+    alive: jax.Array      # [N]
+    insert_dropped: jax.Array  # [N] keeps refused by a full view
+    walk_expired: jax.Array    # [N] walks dead of old age (counted)
+    walk_truncated: jax.Array  # [N] join fan copies lost to full slots
+    rnd: jax.Array
+
+
+def walker_caps(cfg: Config) -> Tuple[int, int]:
+    """(P, C): view cap and walker slots.  C bounds ONE subject's
+    concurrent walk copies; the join fan-out (one copy per contact view
+    member + c extras, v2 :64-117) truncates to C with the excess
+    counted (walk_truncated).  16 covers the measured fan at the view
+    sizes this simulation regime actually reaches (engine path: mean
+    ~2.5 at N=1024) while keeping the walker plane O(N·C) SCALARS per
+    round — a [N·C, P] row gather would move ~0.5 GB/round at 2^16."""
+    return default_view_cap(cfg.n_nodes, cfg.scamp_c), 16
+
+
+def dense_scamp_init(cfg: Config) -> DenseScampState:
+    n = cfg.n_nodes
+    p, c = walker_caps(cfg)
+    st = DenseScampState(
+        partial=jnp.full((n, p), -1, jnp.int32),
+        in_view=jnp.full((n, p), -1, jnp.int32),
+        walk_pos=jnp.full((n, c), -1, jnp.int32),
+        walk_age=jnp.zeros((n, c), jnp.int32),
+        alive=jnp.ones((n,), bool),
+        insert_dropped=jnp.zeros((n,), jnp.int32),
+        walk_expired=jnp.zeros((n,), jnp.int32),
+        walk_truncated=jnp.zeros((n,), jnp.int32),
+        rnd=jnp.int32(0),
+    )
+    # bootstrap: every node joins through a random contact (the
+    # orchestration-layer peer discovery, as in hyparview_dense)
+    key = jax.random.PRNGKey(cfg.seed ^ 0x5CA37)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    contact = jax.random.randint(key, (n,), 0, n, jnp.int32)
+    contact = jnp.where(contact == ids, (contact + 1) % n, contact)
+    return _spawn_walks(st, contact, jnp.ones((n,), bool), key, cfg)
+
+
+def _spawn_walks(st: DenseScampState, contact: jax.Array,
+                 doing: jax.Array, key: jax.Array,
+                 cfg: Config) -> DenseScampState:
+    """Join through ``contact`` for rows where ``doing``: adopt the
+    contact and place the subscription fan-out's walk copies at the
+    contact — one per contact partial-view member (they each received a
+    forward), plus scamp_c extras at random members; an empty-view
+    contact holds the walks itself (first-join keep, :284-327)."""
+    n = st.partial.shape[0]
+    _, c_slots = walker_caps(cfg)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    partial = jnp.where(doing[:, None], -1, st.partial)
+    partial = partial.at[:, 0].set(
+        jnp.where(doing, contact, partial[:, 0]))
+    crow = _gather_rows(st.partial, contact)               # [N, P]
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        jax.random.fold_in(key, 77), ids)
+    extras = jax.vmap(ps.random_k, in_axes=(0, 0, None))(
+        crow, keys, cfg.scamp_c)                           # [N, c]
+    # one walk copy per contact view member + c extras — the fan-out
+    # tracks the contact's ACTUAL view size like the reference's (a cap-
+    # wide spawn would inflate in-degree ~P per join).  An empty-view
+    # contact gets ONE walker standing at the contact itself, whose
+    # keep-coin is 1/(1+0) = 1 — exactly the reference's direct first-
+    # join keep (:284-327 lonely branch).  Fan beyond the C walker
+    # slots truncates, counted.
+    spawn_full = jnp.concatenate([crow, extras], axis=1)
+    # compact valid spawns to the front so truncation drops only excess
+    spawn_full = jax.vmap(ps.members_first)(spawn_full)
+    spawn = spawn_full[:, :c_slots]
+    lost = jnp.sum(spawn_full[:, c_slots:] >= 0, axis=1)
+    empty_contact = jnp.sum(crow >= 0, axis=1) == 0
+    spawn = spawn.at[:, 0].set(
+        jnp.where(empty_contact, contact, spawn[:, 0]))
+    new_pos = jnp.where(doing[:, None], spawn, st.walk_pos)
+    return st.replace(
+        partial=partial,
+        in_view=jnp.where(doing[:, None], -1, st.in_view),
+        walk_pos=new_pos,
+        walk_age=jnp.where(doing[:, None], 0, st.walk_age),
+        walk_truncated=st.walk_truncated
+        + jnp.where(doing, lost, 0).astype(jnp.int32),
+    )
+
+
+def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
+                           max_age: int = 64):
+    N = cfg.n_nodes
+    P, C = walker_caps(cfg)
+    ids = jnp.arange(N, dtype=jnp.int32)
+
+    def nkeys(key, salt):
+        return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            jax.random.fold_in(key, salt), ids)
+
+    def step(st: DenseScampState) -> DenseScampState:
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed ^ 0x5CADE), st.rnd)
+        alive = st.alive
+        partial, in_view = st.partial, st.in_view
+        pos, age = st.walk_pos, st.walk_age
+
+        # ---- churn: restart-in-place (the dense fault plane)
+        if churn > 0.0:
+            ck = jax.random.fold_in(key, 0)
+            reset = (jax.random.uniform(ck, (N,)) < churn) & alive
+            contact = jax.random.randint(
+                jax.random.fold_in(key, 1), (N,), 0, N, jnp.int32)
+            contact = jnp.where(contact == ids, (contact + 1) % N,
+                                contact)
+            st2 = _spawn_walks(
+                st.replace(partial=partial, in_view=in_view,
+                           walk_pos=pos, walk_age=age),
+                contact, reset, jax.random.fold_in(key, 2), cfg)
+            partial, in_view = st2.partial, st2.in_view
+            pos, age = st2.walk_pos, st2.walk_age
+            # everyone drops churned peers from both views (the
+            # remove_subscription effect of detecting the restart)
+            partial = jnp.where(
+                reset[jnp.clip(partial, 0, N - 1)] & (partial >= 0),
+                -1, partial)
+            in_view = jnp.where(
+                reset[jnp.clip(in_view, 0, N - 1)] & (in_view >= 0),
+                -1, in_view)
+            # walks owned by churned SUBJECTS already reset; walks
+            # standing AT a churned holder bounce via the dead-holder
+            # path below
+
+        # ---- isolation re-subscribe (empty view, no walkers)
+        lonely = alive & (jnp.sum(partial >= 0, axis=1) == 0) \
+            & (jnp.sum(pos >= 0, axis=1) == 0)
+        fresh = jax.random.randint(
+            jax.random.fold_in(key, 3), (N,), 0, N, jnp.int32)
+        fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)
+        st3 = _spawn_walks(
+            st.replace(partial=partial, in_view=in_view, walk_pos=pos,
+                       walk_age=age),
+            fresh, lonely, jax.random.fold_in(key, 4), cfg)
+        partial, in_view = st3.partial, st3.in_view
+        pos, age = st3.walk_pos, st3.walk_age
+
+        # ---- one walk hop for every active walker.  The walker plane
+        # touches only O(N*C) SCALARS: view sizes are gathered from a
+        # precomputed [N] vector and hops sample a random SLOT of the
+        # holder's row (uniform over occupied members by rejection —
+        # an empty draw bounces one round), so no [N*C, P] row gather
+        # ever materializes.
+        sizes_all = jnp.sum(partial >= 0, axis=1)          # [N]
+        flat_pos = pos.reshape(-1)                         # [N*C]
+        subj = jnp.repeat(ids, C)                          # [N*C]
+        active_w = (flat_pos >= 0) & alive[jnp.clip(flat_pos, 0, N - 1)] \
+            & alive[subj]
+        hsize = jnp.where(active_w,
+                          sizes_all[jnp.clip(flat_pos, 0, N - 1)], 0)
+        can_keep = active_w & (flat_pos != subj)
+        if cfg.scamp_exact_keep_probability:
+            p_keep = 1.0 / (1.0 + hsize.astype(jnp.float32))
+        else:
+            p_keep = jnp.float32(0.4)
+        coin = jax.random.uniform(jax.random.fold_in(key, 5),
+                                  (N * C,))
+        keep = can_keep & (coin < p_keep)
+
+        # keepers propose (subject -> holder); holders admit up to 4
+        chosen = reverse_select(
+            jnp.where(keep, flat_pos, -1),
+            jax.random.bits(jax.random.fold_in(key, 6), (), jnp.uint32),
+            N, 4)                                          # [N, 4] walker ids
+        # dedup same-subject proposals within a holder's admit list
+        csubj = jnp.where(chosen >= 0, chosen // C, -1)    # [N, 4]
+        earlier = jnp.tril(jnp.ones((4, 4), bool), k=-1)
+        dup = jnp.any((csubj[:, :, None] == csubj[:, None, :])
+                      & (csubj[:, :, None] >= 0) & earlier[None], axis=2)
+        csubj = jnp.where(dup, -1, csubj)
+        admitted = jnp.zeros((N, 4), bool)
+        dropped = jnp.zeros((N,), jnp.int32)
+        for j in range(4):
+            s_j = csubj[:, j]
+            hit = jnp.any(partial == s_j[:, None], axis=1)
+            want = (s_j >= 0) & ~hit
+            free = jnp.sum(partial >= 0, axis=1) < P
+            do = want & free
+            partial, _, ins = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
+                partial, jnp.where(do, s_j, -1), None)
+            admitted = admitted.at[:, j].set(do & ins)
+            dropped = dropped + (want & ~free).astype(jnp.int32)
+        # keep-notification (v2): admitted subjects record the holder
+        # in their in-view — routed by a second reverse_select over the
+        # flattened admit matrix (entry e = holder * 4 + j)
+        ev_subj = jnp.where(admitted, csubj, -1).reshape(-1)   # [N*4]
+        back = reverse_select(
+            ev_subj,
+            jax.random.bits(jax.random.fold_in(key, 7), (), jnp.uint32),
+            N, 4)                                          # [N, 4] entries
+        for j in range(4):
+            e_j = back[:, j]
+            holder_j = jnp.where(e_j >= 0, e_j // 4, -1)
+            in_view, _, _ = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
+                in_view, holder_j, None)
+
+        # a walker whose proposal was ADMITTED dies; one whose proposal
+        # lost the admit race (or was refused) re-forwards next round
+        # from the same holder (the reference re-forwards on duplicate
+        # keep, :284-327)
+        kept_flat = jnp.zeros((N * C + 1,), bool)
+        kept_flat = kept_flat.at[jnp.where(
+            admitted, chosen, N * C)].set(True, mode="drop")
+        kept = kept_flat[:N * C]
+
+        # non-keeping walkers hop to a random occupied slot of the
+        # holder's view (rejection-uniform: an empty slot draw bounces
+        # one round); empty/dead holders bounce too (age still ticks)
+        slot_r = jax.random.randint(jax.random.fold_in(key, 8),
+                                    (N * C,), 0, P)
+        nxt = partial.reshape(-1)[
+            jnp.clip(flat_pos, 0, N - 1) * P + slot_r]
+        hop = active_w & ~keep & (nxt >= 0)
+        new_flat = jnp.where(kept, -1,
+                             jnp.where(hop, nxt, flat_pos))
+        new_age = jnp.where(active_w, age.reshape(-1) + 1,
+                            age.reshape(-1))
+        expired = (new_flat >= 0) & (new_age > max_age)
+        st_out = st.replace(
+            partial=partial,
+            in_view=in_view,
+            walk_pos=jnp.where(expired, -1,
+                               new_flat).reshape(N, C),
+            walk_age=jnp.where(expired, 0, new_age).reshape(N, C),
+            alive=alive,
+            insert_dropped=st.insert_dropped + dropped,
+            walk_expired=st.walk_expired
+            + jax.ops.segment_sum(expired.astype(jnp.int32), subj, N),
+            rnd=st.rnd + 1,
+        )
+        return st_out
+
+    return jax.jit(step)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,
+                    churn: float = 0.0) -> DenseScampState:
+    step = make_dense_scamp_round(cfg, churn)
+    out, _ = jax.lax.scan(lambda s, _: (step(s), None), st, None,
+                          length=n_rounds)
+    return out
+
+
+def scamp_health(st: DenseScampState) -> Dict[str, jax.Array]:
+    """Weak connectivity over the symmetric closure of the partial
+    views + view-size stats (the engine path's health surface,
+    tests/test_scamp.py)."""
+    partial, alive = st.partial, st.alive
+    n = partial.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    start = jnp.argmax(alive).astype(jnp.int32)
+    reach0 = ids == start
+
+    def expand(r):
+        # forward edges: rows of reached
+        nb = _gather_rows(partial, jnp.where(r, ids, -1))
+        hit = jnp.zeros((n,), bool).at[
+            jnp.clip(nb, 0, n - 1)].max(nb >= 0, mode="drop")
+        # reverse edges: any row that POINTS AT a reached node
+        points = jnp.any(
+            r[jnp.clip(partial, 0, n - 1)] & (partial >= 0), axis=1)
+        return r | ((hit | points) & alive)
+
+    def body(c):
+        r, _ = c
+        r2 = expand(r)
+        return r2, jnp.any(r2 != r)
+
+    reach, _ = jax.lax.while_loop(lambda c: c[1], body,
+                                  (reach0, jnp.bool_(True)))
+    sizes = jnp.sum(partial >= 0, axis=1)
+    live = jnp.sum(alive)
+    return {
+        "connected": jnp.sum(reach & alive) == live,
+        "reached": jnp.sum(reach & alive),
+        "live": live,
+        "mean_view": jnp.sum(jnp.where(alive, sizes, 0))
+        / jnp.maximum(live, 1),
+        "walkers": jnp.sum(st.walk_pos >= 0),
+        "expired": jnp.sum(st.walk_expired),
+    }

@@ -39,7 +39,6 @@ def make_variant(which, n):
     ids = jnp.arange(n, dtype=jnp.int32)
 
     def merge(active, passive, cands, key):
-        W = P + K
         cat = jnp.concatenate([passive, cands], axis=1)
         ok = (cat >= 0) & (cat != ids[:, None])
         if which != "no_activemask":

