@@ -157,6 +157,13 @@ def _spawn_walks(st: DenseScampState, contact: jax.Array,
 
 def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
                            max_age: int = 64):
+    # SCAMP_DENSE_SKIP: comma list of {churn, admit, inview} phases to
+    # omit — the bisection surface for the N=2^16 TPU worker fault
+    # (ROADMAP 1d: every op is individually clean; only the full
+    # churn-enabled composition faults).  Production runs leave it
+    # unset.
+    import os
+    _dbg = frozenset(os.environ.get('SCAMP_DENSE_SKIP', '').split(','))
     N = cfg.n_nodes
     P, C = walker_caps(cfg)
     ids = jnp.arange(N, dtype=jnp.int32)
@@ -173,7 +180,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         pos, age = st.walk_pos, st.walk_age
 
         # ---- churn: restart-in-place (the dense fault plane)
-        if churn > 0.0:
+        if churn > 0.0 and 'churn' not in _dbg:
             ck = jax.random.fold_in(key, 0)
             reset = (jax.random.uniform(ck, (N,)) < churn) & alive
             contact = jax.random.randint(
@@ -246,7 +253,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         csubj = jnp.where(dup, -1, csubj)
         admitted = jnp.zeros((N, 4), bool)
         dropped = jnp.zeros((N,), jnp.int32)
-        for j in range(4):
+        for j in (range(0) if 'admit' in _dbg else range(4)):
             s_j = csubj[:, j]
             hit = jnp.any(partial == s_j[:, None], axis=1)
             want = (s_j >= 0) & ~hit
@@ -259,16 +266,17 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         # keep-notification (v2): admitted subjects record the holder
         # in their in-view — routed by a second reverse_select over the
         # flattened admit matrix (entry e = holder * 4 + j)
-        ev_subj = jnp.where(admitted, csubj, -1).reshape(-1)   # [N*4]
-        back = reverse_select(
-            ev_subj,
-            jax.random.bits(jax.random.fold_in(key, 7), (), jnp.uint32),
-            N, 4)                                          # [N, 4] entries
-        for j in range(4):
-            e_j = back[:, j]
-            holder_j = jnp.where(e_j >= 0, e_j // 4, -1)
-            in_view, _, _ = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
-                in_view, holder_j, None)
+        if 'inview' not in _dbg:
+          ev_subj = jnp.where(admitted, csubj, -1).reshape(-1)
+          back = reverse_select(
+              ev_subj,
+              jax.random.bits(jax.random.fold_in(key, 7), (), jnp.uint32),
+              N, 4)
+          for j in range(4):
+              e_j = back[:, j]
+              holder_j = jnp.where(e_j >= 0, e_j // 4, -1)
+              in_view, _, _ = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
+                  in_view, holder_j, None)
 
         # a walker whose proposal was ADMITTED dies; one whose proposal
         # lost the admit race (or was refused) re-forwards next round
