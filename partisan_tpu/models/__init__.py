@@ -8,7 +8,11 @@ from .echo import Echo
 from .full_membership import FullMembership
 from .hbbft import HbbftWorker
 from .hyparview import HyParView
+from .hyparview_dense import DenseHvState, dense_init, run_dense
 from .managers import ClientServerManager, StaticManager
 from .plumtree import Plumtree
+from .plumtree_dense import PtDense, pt_dense_init, run_pt_dense
 from .scamp import ScampV1, ScampV2
+from .scamp_dense import (DenseScampState, dense_scamp_init,
+                          run_dense_scamp)
 from .stack import Stacked, StackState, UpperProtocol
