@@ -156,14 +156,14 @@ def _spawn_walks(st: DenseScampState, contact: jax.Array,
 
 
 def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
-                           max_age: int = 64):
-    # SCAMP_DENSE_SKIP: comma list of {churn, admit, inview} phases to
-    # omit — the bisection surface for the N=2^16 TPU worker fault
-    # (ROADMAP 1d: every op is individually clean; only the full
-    # churn-enabled composition faults).  Production runs leave it
-    # unset.
-    import os
-    _dbg = frozenset(os.environ.get('SCAMP_DENSE_SKIP', '').split(','))
+                           max_age: int = 64,
+                           skip: Tuple[str, ...] = ()):
+    # ``skip``: static tuple of {churn, admit, inview} phases to omit —
+    # the bisection/ablation surface for the N=2^16 TPU worker fault
+    # (ROADMAP 1d).  Static so every value is its own jit cache entry
+    # (the round-3 env-var gate was invisible to the cache and could
+    # silently reuse a stale program).  Production runs leave it empty.
+    _dbg = frozenset(skip)
     N = cfg.n_nodes
     P, C = walker_caps(cfg)
     ids = jnp.arange(N, dtype=jnp.int32)
@@ -179,33 +179,39 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         partial, in_view = st.partial, st.in_view
         pos, age = st.walk_pos, st.walk_age
 
-        # ---- churn: restart-in-place (the dense fault plane)
+        # ---- churn: restart-in-place.  Round-4 restructure (the
+        # ROADMAP 1d lever): churn only CLEARS state here — restarted
+        # rows wipe their views/walkers and every view drops the
+        # churned peers (the remove_subscription effect) — and the
+        # rejoin rides the isolation re-subscribe below, since a
+        # cleared row satisfies the isolation predicate by
+        # construction.  One _spawn_walks instance per round instead
+        # of the round-3 program's two; the round-3 shape faulted the
+        # TPU worker at N=2^16 beyond ~50 scanned rounds (compositional
+        # — every op individually clean) while this schedule runs 100-
+        # round launches clean (see LAUNCH_CAP for the residual length
+        # sensitivity; results.csv scamp_dense_65536).  Walk spawns now
+        # gather the contact's POST-drop view (a restarted contact can
+        # still host the walker itself via the empty-view first-join
+        # branch — it is alive, restart-in-place).
         if churn > 0.0 and 'churn' not in _dbg:
             ck = jax.random.fold_in(key, 0)
             reset = (jax.random.uniform(ck, (N,)) < churn) & alive
-            contact = jax.random.randint(
-                jax.random.fold_in(key, 1), (N,), 0, N, jnp.int32)
-            contact = jnp.where(contact == ids, (contact + 1) % N,
-                                contact)
-            st2 = _spawn_walks(
-                st.replace(partial=partial, in_view=in_view,
-                           walk_pos=pos, walk_age=age),
-                contact, reset, jax.random.fold_in(key, 2), cfg)
-            partial, in_view = st2.partial, st2.in_view
-            pos, age = st2.walk_pos, st2.walk_age
-            # everyone drops churned peers from both views (the
-            # remove_subscription effect of detecting the restart)
+            partial = jnp.where(reset[:, None], -1, partial)
+            in_view = jnp.where(reset[:, None], -1, in_view)
+            pos = jnp.where(reset[:, None], -1, pos)
+            age = jnp.where(reset[:, None], 0, age)
             partial = jnp.where(
                 reset[jnp.clip(partial, 0, N - 1)] & (partial >= 0),
                 -1, partial)
             in_view = jnp.where(
                 reset[jnp.clip(in_view, 0, N - 1)] & (in_view >= 0),
                 -1, in_view)
-            # walks owned by churned SUBJECTS already reset; walks
-            # standing AT a churned holder bounce via the dead-holder
-            # path below
+            # walks standing AT a churned holder bounce via the
+            # empty-view path below
 
-        # ---- isolation re-subscribe (empty view, no walkers)
+        # ---- re-subscribe: churned rows (cleared above) and isolated
+        # rows (empty view, no walkers) join through a fresh contact
         lonely = alive & (jnp.sum(partial >= 0, axis=1) == 0) \
             & (jnp.sum(pos >= 0, axis=1) == 0)
         fresh = jax.random.randint(
@@ -317,13 +323,42 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
     return jax.jit(step)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,
-                    churn: float = 0.0) -> DenseScampState:
-    step = make_dense_scamp_round(cfg, churn)
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _run_dense_scamp_launch(st: DenseScampState, n_rounds: int,
+                            cfg: Config, churn: float,
+                            skip: Tuple[str, ...]) -> DenseScampState:
+    step = make_dense_scamp_round(cfg, churn, skip=skip)
     out, _ = jax.lax.scan(lambda s, _: (step(s), None), st, None,
                           length=n_rounds)
     return out
+
+
+# Per-LAUNCH scan-length cap.  The v5e worker reproducibly crashes
+# ("kernel fault") running this program as a single scan of ~200 rounds
+# at N=2^16 with churn enabled, while 100-round launches run clean
+# indefinitely (round-4 soak: 1000+ rounds as 100-round launches; the
+# round-3 shape faulted even earlier).  Every constituent op is
+# individually clean and CPU runs are clean at any length — an
+# XLA/runtime scheduling or memory bug sensitive to scan trip count at
+# this shape, not a code bug.  scripts/repro_scamp_dense_fault.py pins
+# the minimal reproducer.  Chunking is semantically invisible (the
+# carried state is identical); it only adds one host round-trip per
+# LAUNCH_CAP rounds.
+LAUNCH_CAP = 100
+
+
+def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,
+                    churn: float = 0.0,
+                    skip: Tuple[str, ...] = ()) -> DenseScampState:
+    """Run ``n_rounds`` dense-SCAMP rounds, chunked into launches of at
+    most :data:`LAUNCH_CAP` scanned rounds (see its comment; one jit
+    cache entry per distinct chunk length)."""
+    done = 0
+    while done < n_rounds:
+        step_n = min(LAUNCH_CAP, n_rounds - done)
+        st = _run_dense_scamp_launch(st, step_n, cfg, churn, skip)
+        done += step_n
+    return st
 
 
 def scamp_health(st: DenseScampState) -> Dict[str, jax.Array]:

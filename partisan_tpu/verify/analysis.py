@@ -56,17 +56,28 @@ def _random_msgs(proto: ProtocolBase, cfg: Config, typ: int, samples: int,
 
 def infer_causality(cfg: Config, proto: ProtocolBase,
                     samples: int = 256, seed: int = 0,
-                    rounds_of_state: int = 0) -> Dict[str, List[str]]:
+                    rounds_of_state: int = 0,
+                    setup=None) -> Dict[str, List[str]]:
     """{message type: sorted list of types its handler can emit}.
 
     ``rounds_of_state`` > 0 seeds the sampled state rows from a briefly
     simulated world instead of ``proto.init`` (some emissions only occur
-    from populated views)."""
+    from populated views); ``setup`` (World -> World) runs before the
+    evolution — pass the workload's cluster-join setup so periodic sends
+    that need a populated membership actually fire.  The
+    ``__background__`` classification is relative to this state and
+    errs toward soundness in both directions: an unpopulated state
+    under-fills it (types misread as state-gated are merely never
+    pruned against — an efficiency cost), and a state evolved into a
+    timer gate cannot over-fill it because background requires
+    cluster-wide prevalence, not presence (see the 50% rule below)."""
     key = jax.random.PRNGKey(seed)
     state = proto.init(cfg, key)
     if rounds_of_state:
         from ..engine import init_world, make_step
         w = init_world(cfg, proto)
+        if setup is not None:
+            w = setup(w)
         step = make_step(cfg, proto, donate=False)
         for _ in range(rounds_of_state):
             w, _ = step(w)
@@ -118,18 +129,71 @@ def infer_causality(cfg: Config, proto: ProtocolBase,
             caused.add(proto.msg_types[int(ti)])
         out[name] = sorted(caused)
 
-    # timer emissions (the periodic/tick pseudo-source)
-    me = jnp.arange(min(samples, n), dtype=jnp.int32)
+    # timer emissions (the periodic/tick pseudo-source).  Two samplings:
+    #   __background__  tick over UNFUZZED rows (init/evolved state) —
+    #                   the unconditionally periodic sends, the analog of
+    #                   the reference annotations' {background, [...]}
+    #                   list (gossip, heartbeats); safe to prune against.
+    #   __tick__        union with tick over FUZZED rows at random round
+    #                   numbers — adds the STATE-GATED timer emissions
+    #                   (e.g. a timeout's decision_request fires only
+    #                   from uncertain states).  A gated timer send
+    #                   depends on state that arbitrary deliveries
+    #                   mutate, so the model checker treats
+    #                   __tick__ - __background__ as related to
+    #                   everything (never pruned against).
+    # sampled nodes x a grid of round numbers (periodic gates key off
+    # rnd % interval and (rnd + me) % interval — a single rnd=0 probe
+    # misses phase-offset schedules); node count bounded so the pass
+    # stays within the caller's `samples` budget at large N
+    n_bg = min(n, max(1, samples // 8))
+    me = jnp.tile(jnp.arange(n_bg, dtype=jnp.int32), 8)
+    brnds = jnp.repeat(jnp.arange(8, dtype=jnp.int32), n_bg)
     tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(
         jax.random.split(key, me.shape[0]), 7)
     rows = jax.tree_util.tree_map(lambda x: x[me % n], state)
     _, tems = jax.vmap(
-        lambda i, r, k: proto.tick(cfg, i, r, jnp.int32(0), k)
-    )(me, rows, tkeys)
-    tvalid = np.asarray(tems.valid)
-    ttyps = np.asarray(tems.typ)
-    out["__tick__"] = sorted({proto.msg_types[int(t)]
-                              for t in np.unique(ttyps[tvalid])})
+        lambda i, r, rnd, k: proto.tick(cfg, i, r, rnd, k)
+    )(me, rows, brnds, tkeys)
+    tvalid = np.asarray(tems.valid).reshape(me.shape[0], -1)
+    ttyps = np.asarray(tems.typ).reshape(me.shape[0], -1)
+    # PREVALENCE rule: background = the cluster fires it ON SCHEDULE —
+    # >=50% of sampled rows emit the type at its best probe round.  Mere
+    # presence is not enough: a single row evolved into a timeout gate
+    # (a PREPARED-past-timeout participant firing decision_request)
+    # must NOT be classed background, or the checker would prune
+    # against a state-gated send and lose real counterexamples.
+    # Misclassifying the other way (a phase-offset periodic send under
+    # 50%) only costs pruning efficiency.
+    background = set()
+    for t in np.unique(ttyps[tvalid]):
+        emits = ((ttyps == t) & tvalid).any(axis=-1)     # [8 * n_bg]
+        frac = emits.reshape(8, n_bg).mean(axis=1)       # per probe round
+        if float(frac.max()) >= 0.5:
+            background.add(proto.msg_types[int(t)])
+
+    # 4x the per-handler sample count: gated timer predicates are
+    # CONJUNCTIVE (status == X and timer == 1), so single-sample hit
+    # rates are ~1/d^2 over the fuzz domain — oversample to make every
+    # reachable gate a near-certain find
+    nf = 4 * samples
+    fme = jax.random.randint(jax.random.fold_in(key, 501),
+                             (nf,), 0, n)
+    fkeys = jax.random.split(jax.random.fold_in(key, 502), nf)
+    frnds = jax.random.randint(jax.random.fold_in(key, 503),
+                               (nf,), 0, 64)
+
+    def tick_one(i, rnd, k):
+        row = jax.tree_util.tree_map(lambda x: x[i % n], state)
+        row = randomize_row(row, jax.random.fold_in(k, 98))
+        _, em = proto.tick(cfg, i, row, rnd, k)
+        return em
+
+    gems = jax.vmap(tick_one)(fme, frnds, fkeys)
+    gtyps, gvalid = np.asarray(gems.typ), np.asarray(gems.valid)
+    gated = {proto.msg_types[int(t)] for t in np.unique(gtyps[gvalid])}
+    out["__background__"] = sorted(background)
+    out["__tick__"] = sorted(background | gated)
     return out
 
 

@@ -84,7 +84,32 @@ def drop_schedule_dynamic(slot: str = "sched"):
     typ) schedule from ``world.aux[slot]`` at run time — rows with
     ``round < 0`` are inert padding.  One compiled step then replays EVERY
     schedule of the model checker's enumeration (schedules are data, not
-    code)."""
+    code).  Implemented as the action-0 plane of
+    :func:`fault_schedule_dynamic` (drop = the zero-delay action), so
+    the schedule-matching logic lives in one place."""
+    full = fault_schedule_dynamic(slot)
+
+    def fn(m: Msgs, rnd: jax.Array, world: World) -> Msgs:
+        sched = world.aux[slot]                       # [S, 4]
+        sched5 = jnp.concatenate(
+            [sched, jnp.zeros((sched.shape[0], 1), sched.dtype)], axis=1)
+        world5 = world.replace(aux={**world.aux, slot: sched5})
+        return full(m, rnd, world5)
+    return fn
+
+
+def fault_schedule_dynamic(slot: str = "sched"):
+    """The drop/delay superset of :func:`drop_schedule_dynamic`: reads an
+    [S, 5] (round, src, dst, typ, action) schedule from
+    ``world.aux[slot]``.  ``action == 0`` drops the matched message
+    (omission); ``action == k > 0`` bumps its ``delay`` by k rounds — the
+    '$delay' interposition verb, re-held by the engine's recv split
+    (engine.py collect) and delivered k rounds late.  Rows with
+    ``round < 0`` are inert padding.  This is the reference's
+    delivery-ORDER exploration surface
+    (``partisan_trace_orchestrator.erl:160-202,476-560`` holds senders to
+    force an ordering): the model checker enumerates late-message
+    schedules with it, not just lost-message ones."""
     def fn(m: Msgs, rnd: jax.Array, world: World) -> Msgs:
         sched = world.aux[slot]
         active = sched[:, 0] >= 0
@@ -93,8 +118,11 @@ def drop_schedule_dynamic(slot: str = "sched"):
                & (sched[:, 1][:, None] == m.src[None, :])
                & (sched[:, 2][:, None] == m.dst[None, :])
                & (sched[:, 3][:, None] == m.typ[None, :]))
-        drop = jnp.any(hit, axis=0) & m.valid
-        return m.replace(valid=m.valid & ~drop)
+        act = sched[:, 4]
+        drop = jnp.any(hit & (act == 0)[:, None], axis=0) & m.valid
+        bump = jnp.max(jnp.where(hit, act[:, None], 0), axis=0)
+        return m.replace(valid=m.valid & ~drop,
+                         delay=m.delay + jnp.where(drop, 0, bump))
     return fn
 
 

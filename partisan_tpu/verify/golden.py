@@ -1,0 +1,66 @@
+"""Parser for the reference's golden causality annotation files
+(``/root/reference/annotations/partisan-annotations-<protocol>``) — the
+hand-checked edge sets ``partisan_analysis.erl:9-14`` feeds the
+filibuster checker's independence pruning with.
+
+File shape (an Erlang term):
+
+    [
+        {causality, [
+            {{forward_message, T}, [{{receive_message, P}, Count}]},
+            {{forward_message, T2}, [true]}
+        ]},
+        {background, [heartbeat, ...]}
+    ].
+
+Meaning: sending ``T`` is causally enabled by having received ``Count``
+messages of type ``P`` (a quorum precondition); ``[true]`` marks a
+spontaneous send (client/timer-originated); ``background`` lists the
+unconditionally periodic types the checker may ignore.
+
+The files are regular enough for a small grammar-specific parser — no
+Erlang term scanner needed.  Used by tests/test_prop_analysis.py to
+cross-validate the DYNAMIC inference (verify/analysis.py) against the
+reference's static, hand-checked truth: a golden edge missing from the
+inferred relation would make the checker's pruning unsound (VERDICT r3
+weak #5)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GoldenAnnotation:
+    # (recv_type, send_type, count): receiving `count` messages of
+    # recv_type enables sending send_type — stored in the RECV->SEND
+    # direction to match analysis.infer_causality's map orientation
+    edges: Tuple[Tuple[str, str, int], ...]
+    spontaneous: Tuple[str, ...]   # sends annotated [true]
+    background: Tuple[str, ...]
+
+
+_ENTRY = re.compile(
+    r"\{\{forward_message,\s*'?(\w+)'?\},\s*\[(.*?)\]\}", re.S)
+_PRE = re.compile(r"\{\{receive_message,\s*'?(\w+)'?\},\s*(\d+)\}")
+_BACKGROUND = re.compile(r"\{background,\s*\[(.*?)\]\}", re.S)
+
+
+def parse_golden(path: str) -> GoldenAnnotation:
+    with open(path) as f:
+        text = f.read()
+    edges: List[Tuple[str, str, int]] = []
+    spontaneous: List[str] = []
+    for send_t, pres in _ENTRY.findall(text):
+        found = _PRE.findall(pres)
+        for recv_t, count in found:
+            edges.append((recv_t, send_t, int(count)))
+        if not found and "true" in pres:
+            spontaneous.append(send_t)
+    m = _BACKGROUND.search(text)
+    background = tuple(
+        t.strip().strip("'") for t in m.group(1).split(",")
+        if t.strip()) if m else ()
+    return GoldenAnnotation(tuple(edges), tuple(spontaneous), background)

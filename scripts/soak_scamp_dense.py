@@ -1,0 +1,54 @@
+"""Chip soak for the dense-SCAMP 2^16 program (ROADMAP 1d repro/fix
+surface).  Round 3's program reproducibly faulted the TPU worker beyond
+~50 scanned rounds at N=2^16 with churn enabled; round 4 restructured
+the churn phase (one _spawn_walks per round).  This script runs the
+restructured program for SOAK rounds in scanned chunks, printing health
+after each chunk, then times a measurement pass.
+
+Usage: python scripts/soak_scamp_dense.py [log2_n] [soak_rounds]
+"""
+import sys, time
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, '.')
+from partisan_tpu.config import Config
+from partisan_tpu.models.scamp_dense import (
+    dense_scamp_init, run_dense_scamp, scamp_health)
+
+log2n = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+soak = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+n = 1 << log2n
+cfg = Config(n_nodes=n, seed=7)
+print(f"device={jax.devices()[0]} n={n} soak={soak}", flush=True)
+
+t0 = time.time()
+st = dense_scamp_init(cfg)
+st.partial.block_until_ready()
+print(f"init {time.time()-t0:.1f}s", flush=True)
+
+chunk = 100
+t0 = time.time()
+done = 0
+while done < soak:
+    st = run_dense_scamp(st, chunk, cfg, 0.01)
+    # sync on a scalar readback (tunnel block_until_ready can return early)
+    w = int(jnp.sum(st.walk_pos >= 0))
+    done += chunk
+    print(f"  rounds={done} walkers={w} t={time.time()-t0:.1f}s", flush=True)
+h = {k: v.item() if hasattr(v, 'item') else v
+     for k, v in scamp_health(run_dense_scamp(st, 60, cfg)).items()}
+print("health:", h, flush=True)
+
+# timed pass: warm compile already done; median-of-3 with distinct inputs
+times = []
+for i in range(3):
+    s0 = dense_scamp_init(Config(n_nodes=n, seed=100 + i))
+    s0.partial.block_until_ready()
+    t0 = time.time()
+    out = run_dense_scamp(s0, 200, cfg, 0.01)
+    _ = int(jnp.sum(out.walk_pos >= 0))
+    times.append(time.time() - t0)
+times.sort()
+rps = 200 / times[1]
+print(f"timed: {times} median rounds/s={rps:.1f}", flush=True)

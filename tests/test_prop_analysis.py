@@ -105,8 +105,13 @@ class TestAnalysis:
             return True  # exploration-shape test; outcomes irrelevant
 
         typs = [proto.typ("gossip"), proto.typ("mail")]
-        ann = analysis.infer_causality(cfg, proto, samples=128)
+        # rounds_of_state + the workload's own setup: gossip only fires
+        # from a populated membership, and background classification
+        # (prunable periodic sends) is relative to the sampled state
+        ann = analysis.infer_causality(cfg, proto, samples=128,
+                                       rounds_of_state=6, setup=setup)
         assert "mail" not in analysis.reachable_types(ann, ["gossip"]), ann
+        assert "gossip" in ann["__background__"], ann
 
         mc = ModelChecker(cfg, proto, setup, invariant, n_rounds=10)
         full = mc.check(candidate_typs=typs, max_drops=2,
@@ -117,6 +122,67 @@ class TestAnalysis:
             (pruned.explored, full.explored)
         assert pruned.passed > 0  # singletons still explored
 
+    def test_background_vs_gated_tick_split(self):
+        """__background__ holds the unconditionally periodic sends; a
+        state-gated timer emission (CTP's decision_request fires only
+        from PREPARED-past-timeout states) must land in __tick__ but NOT
+        __background__ — the checker treats that difference as
+        'related to everything' (unprunable)."""
+        from partisan_tpu.models.commit import BernsteinCTP
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        c = analysis.infer_causality(cfg, BernsteinCTP(cfg), samples=256)
+        assert "decision_request" in c["__tick__"], c
+        assert "decision_request" not in c["__background__"], c
+
+    def test_background_needs_prevalence_not_presence(self):
+        """A timer send firing from a SINGLE gate-satisfying row (the
+        shape of an evolved PREPARED-past-timeout participant) must stay
+        out of __background__ — presence alone would let the checker
+        prune against a state-gated send.  Cluster-wide periodic sends
+        still classify as background."""
+        import jax.numpy as jnp
+        from flax import struct
+        from partisan_tpu.engine import ProtocolBase
+
+        @struct.dataclass
+        class _S:
+            armed: object
+
+        class BeatAlarm(ProtocolBase):
+            msg_types = ("beat", "alarm")
+
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self.data_spec = {}
+                self.emit_cap = 1
+                self.tick_emit_cap = 2
+
+            def init(self, cfg, key):
+                # exactly one row satisfies the alarm gate — like one
+                # participant evolved into its timeout window
+                return _S(armed=jnp.arange(cfg.n_nodes) == 0)
+
+            def handle_beat(self, cfg, me, row, m, key):
+                return row, self.no_emit()
+
+            def handle_alarm(self, cfg, me, row, m, key):
+                return row, self.no_emit()
+
+            def tick(self, cfg, me, row, rnd, key):
+                nxt = (me + 1) % cfg.n_nodes
+                em = self.merge(
+                    self.emit(nxt[None], self.typ("beat")),
+                    self.emit(jnp.where(row.armed, nxt, -1)[None],
+                              self.typ("alarm")),
+                    cap=self.tick_emit_cap)
+                return row, em
+
+        cfg = pt.Config(n_nodes=8, inbox_cap=8)
+        c = analysis.infer_causality(cfg, BeatAlarm(cfg), samples=64)
+        assert "beat" in c["__background__"], c
+        assert "alarm" not in c["__background__"], c
+        assert "alarm" in c["__tick__"], c
+
     def test_roundtrip_and_reachability(self, tmp_path):
         cfg = pt.Config(n_nodes=4, inbox_cap=8)
         proto = TwoPhaseCommit(cfg)
@@ -126,3 +192,138 @@ class TestAnalysis:
         assert analysis.read_annotations(p) == c
         reach = analysis.reachable_types(c, ["prepare"])
         assert {"prepare", "prepared", "commit", "commit_ack"} <= reach
+
+
+# =====================================================================
+# Golden-annotation cross-walk (VERDICT r3 next #5): the reference ships
+# hand-checked causality files (/root/reference/annotations/
+# partisan-annotations-<proto>, fed to the filibuster pruning by
+# partisan_analysis.erl:9-14).  Every golden edge (receive P enables
+# send T) must be visible to the DYNAMIC inference — either directly
+# (T in inferred[P]) or as a state-gated timer emission (T in
+# __tick__ - __background__, which the checker never prunes against) —
+# otherwise the rebuild's independence pruning could drop a real
+# counterexample.
+# =====================================================================
+
+GOLDEN_DIR = "/root/reference/annotations"
+
+
+def _crosswalk(fname, proto, cfg, type_map=None, edge_map=None,
+               samples=256):
+    from partisan_tpu.verify.golden import parse_golden
+    g = parse_golden(os.path.join(GOLDEN_DIR, fname))
+    inf = analysis.infer_causality(cfg, proto, samples=samples)
+    gated = set(inf["__tick__"]) - set(inf["__background__"])
+    # spontaneous = client- or timer-originated: a ctl_* verb or a tick
+    spont_ok = set(inf["__tick__"])
+    for t in proto.msg_types:
+        if t.startswith("ctl"):
+            spont_ok |= set(inf.get(t, []))
+    tm = dict(type_map or {})
+    em = dict(edge_map or {})
+    missing = []
+    for recv, send, _cnt in g.edges:
+        if (recv, send) in em:
+            pair = em[(recv, send)]
+            if pair is None:
+                continue          # documented no-analog skip
+            p, t = pair
+        else:
+            p = tm.get(recv, recv)
+            t = tm.get(send, send)
+        if p is None or t is None:
+            continue              # documented no-analog skip
+        if t not in inf.get(p, []) and t not in gated:
+            missing.append((recv, send, p, t))
+    assert not missing, (missing, inf)
+    for s in g.spontaneous:
+        t = tm.get(s, s)
+        if t is None:
+            continue
+        assert t in spont_ok, (s, t, inf)
+    return g
+
+
+class TestGoldenCrosswalk:
+    def _cfg(self, n=4):
+        return pt.Config(n_nodes=n, inbox_cap=16)
+
+    def test_lampson_2pc(self):
+        cfg = self._cfg()
+        # 'ok' (client confirmation) has no wire analog: the rebuild
+        # surfaces the decision in p_status/delivered host-side state
+        _crosswalk("partisan-annotations-lampson_2pc",
+                   TwoPhaseCommit(cfg), cfg, type_map={"ok": None})
+
+    def test_bernstein_ctp(self):
+        from partisan_tpu.models.commit import BernsteinCTP
+        cfg = self._cfg()
+        g = _crosswalk("partisan-annotations-bernstein_ctp",
+                       BernsteinCTP(cfg), cfg, type_map={"ok": None})
+        # the golden file's timeout edge is the one the gated-tick rule
+        # exists for — make sure this test would catch its loss
+        assert ("prepared", "decision_request", 3) in g.edges
+
+    def test_skeen_3pc(self):
+        from partisan_tpu.models.commit import Skeen3PC
+        cfg = self._cfg()
+        _crosswalk("partisan-annotations-skeen_3pc",
+                   Skeen3PC(cfg), cfg, type_map={"ok": None})
+
+    def test_demers_direct_mail(self):
+        from partisan_tpu.models.demers import DirectMail
+        cfg = self._cfg()
+        _crosswalk("partisan-annotations-demers_direct_mail",
+                   DirectMail(cfg), cfg, type_map={"broadcast": "mail"})
+
+    def test_demers_direct_mail_acked(self):
+        from partisan_tpu.models.demers import DirectMailAcked
+        cfg = self._cfg()
+        _crosswalk("partisan-annotations-demers_direct_mail_acked",
+                   DirectMailAcked(cfg), cfg,
+                   type_map={"broadcast": "mail"})
+
+    def test_demers_anti_entropy(self):
+        from partisan_tpu.models.demers import AntiEntropy
+        cfg = self._cfg()
+        # reference names both halves of the exchange 'pull'; the
+        # rebuild splits them into push (the digest offer) and
+        # pull_reply (the response) — the edge is the same
+        _crosswalk("partisan-annotations-demers_anti_entropy",
+                   AntiEntropy(cfg), cfg,
+                   edge_map={("pull", "pull"): ("push", "pull_reply")})
+
+    def test_demers_rumor_mongering_has_no_edges(self):
+        """The rumor-mongering rebuild is the dense bitset/kernel plane
+        (ops/rumor_kernel*.py) with no per-message handlers — but its
+        golden file carries NO receive->send edges (broadcast is
+        spontaneous), so there is nothing pruning-relevant to lose."""
+        from partisan_tpu.verify.golden import parse_golden
+        g = parse_golden(os.path.join(
+            GOLDEN_DIR, "partisan-annotations-demers_rumor_mongering"))
+        assert g.edges == ()
+        assert "broadcast" in g.spontaneous
+
+    def test_alsberg_day_variants(self):
+        """All three golden files (base / acked / acked_membership)
+        cross-walk against the one rebuilt primary-backup protocol:
+        retry_* wire types have no analog because retransmission rides
+        the engine's ack plane (qos/ack.py), and heartbeat rides the
+        engine keepalive — their edges map onto the base
+        collaborate/collaborate_ack chain."""
+        from partisan_tpu.models.commit import AlsbergDay
+        retry_edges = {
+            ("retry_collaborate", "retry_collaborate_ack"):
+                ("collaborate", "collaborate_ack"),
+            ("retry_collaborate_ack", "ok"):
+                ("collaborate_ack", "client_reply"),
+        }
+        for fname in ("partisan-annotations-alsberg_day",
+                      "partisan-annotations-alsberg_day_acked",
+                      "partisan-annotations-alsberg_day_acked_membership"):
+            cfg = self._cfg()
+            _crosswalk(fname, AlsbergDay(cfg), cfg,
+                       type_map={"ok": "client_reply",
+                                 "heartbeat": None},
+                       edge_map=retry_edges)
