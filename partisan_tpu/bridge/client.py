@@ -93,6 +93,24 @@ class PortClient:
         assert ok == Atom("ok")
         return list(log), n
 
+    def rpc_call(self, src: int, peer: int, fn: int, arg: int) -> Any:
+        return self.call((Atom("rpc_call"), src, peer, fn, arg))
+
+    def rpc_results(self, node: int) -> List[int]:
+        ok, res = self.call((Atom("rpc_results"), node))
+        assert ok == Atom("ok")
+        return list(res)
+
+    def otp_call(self, src: int, peer: int, req, timeout: int = 10) -> Any:
+        return self.call((Atom("otp_call"), src, peer,
+                          [int(x) for x in req], timeout))
+
+    def otp_results(self, node: int):
+        """-> (replies, timed_out_count)"""
+        ok, replies, timed = self.call((Atom("otp_results"), node))
+        assert ok == Atom("ok")
+        return [list(r) for r in replies], timed
+
     def interpose(self, kind: str, verb: str, **props) -> Any:
         plist = [(Atom(k), Atom(v) if isinstance(v, str) else v)
                  for k, v in props.items()]

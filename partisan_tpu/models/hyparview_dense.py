@@ -72,6 +72,11 @@ class DenseHvState:
     passive: jax.Array   # [N, P] padded peer set
     alive: jax.Array     # [N] bool — churn plane
     rnd: jax.Array       # scalar int32
+    # [N] partition ids (0 = unpartitioned) — the cross-partition drop
+    # plane of verify/faults.inject_partition, honored when the round is
+    # built with faults=True (the verification configuration; the
+    # benchmark program omits the gathers it costs)
+    partition: Optional[jax.Array] = None
 
 
 def dense_init(cfg: Config, seeds_per_node: int = 2) -> DenseHvState:
@@ -93,6 +98,7 @@ def dense_init(cfg: Config, seeds_per_node: int = 2) -> DenseHvState:
         passive=passive,
         alive=jnp.ones((n,), bool),
         rnd=jnp.int32(0),
+        partition=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -124,8 +130,14 @@ def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
     sp, order = jax.lax.sort(
         (packed, jnp.arange(m, dtype=jnp.int32)), dimension=0, num_keys=1)
     st = (sp >> bits).astype(jnp.int32)
-    starts = jnp.searchsorted(st, jnp.arange(n), side="left")
-    pos = jnp.arange(m) - starts[jnp.clip(st, 0, n - 1)]
+    # rank within each target's bucket WITHOUT searchsorted (whose TPU
+    # lowering costs ~8 ms alone at [2^16] — scripts/profile_ops.py):
+    # bucket starts are where the sorted target changes; a running max
+    # of start indices gives each element its bucket's start
+    i = jnp.arange(m, dtype=jnp.int32)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), st[1:] != st[:-1]])
+    pos = i - jax.lax.cummax(jnp.where(first, i, 0))
     ok = (st < n) & (pos < c)
     flat = jnp.where(ok, st * c + jnp.clip(pos, 0, c - 1), n * c)
     out = jnp.full((n * c + 1,), -1, jnp.int32)
@@ -141,7 +153,9 @@ def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
 
 
 def make_dense_round(cfg: Config, churn: float = 0.0,
-                     skip: frozenset = frozenset()):
+                     skip: frozenset = frozenset(),
+                     faults: bool = False,
+                     interpose=None):
     """Compile one dense round: ``state -> state``.  Deterministic from
     (cfg.seed, state.rnd) like the engine's rounds.
 
@@ -150,7 +164,24 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
     scripts/profile_dense.py uses to attribute round cost phase by
     phase (config gating alone leaves the phase's ops in the program
     as no-ops, which XLA does not always eliminate).  Production
-    callers leave it empty."""
+    callers leave it empty.
+
+    ``faults=True`` builds the VERIFICATION configuration (VERDICT r3
+    #3): the ``state.partition`` plane drops cross-partition view ops
+    (the engine's inject_partition semantics), and ``interpose`` — a
+    fun ``(phase: str, dst: [N] int32, rnd) -> [N] bool keep-mask`` —
+    sees every wire-analog exchange before it lands:
+
+      phase "promote"      node i proposes promotion to dst[i]
+      phase "shuffle_fwd"  shuffle origin i's walk reached dst[i]
+                           (dropping it suppresses BOTH merge
+                           directions — the whole exchange is one
+                           message pair in the reference)
+
+    Dropping a promotion proposal is the reference's lost
+    neighbor_request; dropping a shuffle is a lost shuffle/shuffle_reply
+    pair.  The benchmark program (faults=False) omits the partition
+    gathers and hook calls entirely."""
     assert skip <= {"repair", "promotion", "shuffle", "merge"}, (
         f"unknown phase(s) in skip: "
         f"{skip - {'repair', 'promotion', 'shuffle', 'merge'}}")
@@ -159,9 +190,22 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
     P = cfg.max_passive_size
     ids = jnp.arange(N, dtype=jnp.int32)
 
-    def nkeys(key, salt):
-        return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-            jax.random.fold_in(key, salt), ids)
+    assert N <= (1 << 24), "rbits packs (node, slot) in (24, 8) bits"
+
+    def make_rbits(key):
+        """Per-(node, slot) uint32 randomness from ONE elementwise mix32
+        over packed counters — a vmapped fold_in key derivation costs
+        ~0.34 ms per use at N=2^16 where this costs ~0.05
+        (scripts/profile_ops.py); ~10 uses per round made it a top-3
+        phase cost."""
+        def rbits(salt: int, w: int) -> jax.Array:
+            assert w <= 256, "rbits packs the slot in 8 bits"
+            s32 = jax.random.bits(jax.random.fold_in(key, salt), (),
+                                  jnp.uint32)
+            ctr = ((ids.astype(jnp.uint32)[:, None] << 8)
+                   | jnp.arange(w, dtype=jnp.uint32)[None, :])
+            return _mix(ctr ^ s32)
+        return rbits
 
     def bulk_passive_merge(active, passive, cands, key):
         """Fold [N, K] candidate peers into the [N, P] passive views in
@@ -192,7 +236,13 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
         first = jnp.concatenate(
             [jnp.ones((N, 1), bool), sv[:, 1:] != sv[:, :-1]], axis=1)
         ok2 = (sv < big) & first
-        pri = jnp.where(ok2, jax.random.uniform(key, sv.shape), -1.0)
+        s32 = jax.random.bits(key, (), jnp.uint32)
+        w = sv.shape[1]
+        assert w <= 256, "merge priority counters pack the slot in 8 bits"
+        ctr = ((jnp.arange(N, dtype=jnp.uint32)[:, None] << 8)
+               | jnp.arange(w, dtype=jnp.uint32)[None, :])
+        pri = jnp.where(ok2, (_mix(ctr ^ s32) >> 8).astype(jnp.float32),
+                        -1.0)
         _, out = jax.lax.sort((-pri, jnp.where(ok2, sv, -1)),
                               dimension=1, num_keys=1)
         return out[:, : passive.shape[1]]
@@ -201,6 +251,19 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
         key = jax.random.fold_in(
             jax.random.PRNGKey(cfg.seed ^ 0xDE45E), state.rnd)
         active, passive, alive = state.active, state.passive, state.alive
+
+        def wire_ok(dst, phase):
+            """Fault plane for one wire-analog exchange: partition drop
+            + interposition mask (None-safe identity when faults off)."""
+            if not faults:
+                return dst
+            keep = (dst >= 0)
+            if state.partition is not None:
+                keep &= (state.partition
+                         == state.partition[jnp.clip(dst, 0, N - 1)])
+            if interpose is not None:
+                keep &= interpose(phase, dst, state.rnd)
+            return jnp.where(keep, dst, -1)
 
         # ---- churn: restart-in-place, the BASELINE #5 fault plane (the
         # rumor kernel's "fresh susceptibles": a churned node loses all
@@ -220,15 +283,29 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 jnp.where(reset, contact, passive[:, 0]))
 
         demote = []  # all passive-bound peers merge once, at the end
-        # ---- repair: liveness + symmetry prune, demote to passive
+        # ---- repair: liveness + symmetry prune, demote to passive.
+        # Dead nodes' rows clear with ONE broadcast mask, so a dead
+        # peer fails `mutual` through its empty row and no per-edge
+        # aliveness gather is needed — an [N*A]-index gather from an
+        # [N] vector costs ~3.4 ms at 2^16 regardless of dtype (6x a
+        # row gather; scripts/profile_ops.py) and the old repair paid
+        # it twice.  Pruned DEAD peers now demote to passive alongside
+        # asymmetric live ones; that is the reference's own shape — a
+        # node cannot synchronously know a remote died, it discovers
+        # via failed connect, which is the promotion path's t_dead
+        # drop below.
         if "repair" not in skip:
+            active = jnp.where(alive[:, None], active, -1)
             peer_rows = _gather_rows(active, active)        # [N, A, A]
             mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
-            ok_edge = (active >= 0) & alive[jnp.clip(active, 0, N - 1)] \
-                & mutual & alive[:, None]
-            pruned = jnp.where((active >= 0) & ~ok_edge
-                               & alive[jnp.clip(active, 0, N - 1)],
-                               active, -1)  # demote live asymmetric peers
+            ok_edge = (active >= 0) & mutual
+            if faults and state.partition is not None:
+                # a partition severs the connection (the engine's
+                # cross-partition drop): the edge prunes and the peer
+                # demotes to passive, reconnectable after resolution
+                ok_edge &= (state.partition[:, None] == state.partition[
+                    jnp.clip(active, 0, N - 1)])
+            pruned = jnp.where((active >= 0) & ~ok_edge, active, -1)
             active = jnp.where(ok_edge, active, -1)
             demote.append(pruned)
 
@@ -245,13 +322,15 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
         passive = passive.at[:, 0].set(
             jnp.where(lonely, fresh, passive[:, 0]))
 
+        rbits = make_rbits(key)
+
         # ---- promotion / join (neighbor_request :975-1089)
         if "promotion" not in skip:
             sizes = jnp.sum(active >= 0, axis=1)
             isolated = sizes == 0
             due = (((state.rnd + ids) % cfg.random_promotion_interval)
                    == 0) | isolated
-            cand = jax.vmap(ps.random_member)(passive, nkeys(key, 3))
+            cand = jax.vmap(ps.random_member_bits)(passive, rbits(3, P))
             in_act = jax.vmap(ps.contains)(active, cand)
             cand = jnp.where(in_act, -1, cand)
             # propose while under max_active: promotion doubles as the
@@ -269,7 +348,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 (passive == jnp.where(t_dead, target, -2)[:, None]),
                 -1, passive)
             chosen = reverse_select(
-                jnp.where(t_dead, -1, target),
+                wire_ok(jnp.where(t_dead, -1, target), "promote"),
                 jax.random.bits(jax.random.fold_in(key, 4), (),
                                 jnp.uint32),
                 N, 2)                                       # [N, 2]
@@ -282,9 +361,9 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 room = jnp.sum(active >= 0, axis=1) < A
                 a_j = (p_j >= 0) & alive & (room | high)
                 acc = acc.at[:, j].set(a_j)
-                kj = nkeys(key, 5 + j)
-                active, evicted, _ = jax.vmap(ps.insert_evict)(
-                    active, jnp.where(a_j, p_j, -1), kj)
+                active, evicted, _ = jax.vmap(ps.insert_evict_bits)(
+                    active, jnp.where(a_j, p_j, -1),
+                    rbits(5 + j, 1)[:, 0])
                 # eviction demotes the victim on the evictor's side
                 # (:1466-1512); the victim's side heals at next repair
                 demote.append(evicted[:, None])
@@ -293,8 +372,9 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
             accepted = propose & ~t_dead & (
                 ((chosen[tc, 0] == ids) & acc[tc, 0])
                 | ((chosen[tc, 1] == ids) & acc[tc, 1]))
-            active, ev2, _ = jax.vmap(ps.insert_evict)(
-                active, jnp.where(accepted, target, -1), nkeys(key, 9))
+            active, ev2, _ = jax.vmap(ps.insert_evict_bits)(
+                active, jnp.where(accepted, target, -1),
+                rbits(9, 1)[:, 0])
             demote.append(ev2[:, None])
             # (a promoted peer leaves the passive view automatically:
             # the final bulk merge masks out every entry now present in
@@ -307,22 +387,23 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
             # every node's own sample: me ++ k_a active ++ k_p passive
             samp = jnp.concatenate([
                 ids[:, None],
-                jax.vmap(ps.random_k, in_axes=(0, 0, None))(
-                    active, nkeys(key, 11), cfg.shuffle_k_active),
-                jax.vmap(ps.random_k, in_axes=(0, 0, None))(
-                    passive, nkeys(key, 12), cfg.shuffle_k_passive),
+                jax.vmap(ps.random_k_bits, in_axes=(0, 0, None))(
+                    active, rbits(11, A), cfg.shuffle_k_active),
+                jax.vmap(ps.random_k_bits, in_axes=(0, 0, None))(
+                    passive, rbits(12, P), cfg.shuffle_k_passive),
             ], axis=1)                                      # [N, S]
             # ARWL-hop walk through active views (one gather per hop)
             e = ids
             for h in range(cfg.arwl):
                 rows = _gather_rows(active, e)
-                kh = nkeys(key, 13 + h)
                 step_to = jax.vmap(
-                    lambda r, k, ex: ps.random_member(r, k, exclude=ex)
-                )(rows, kh, jnp.stack([ids, e], axis=1))
+                    lambda r, b, ex: ps.random_member_bits(r, b,
+                                                           exclude=ex)
+                )(rows, rbits(13 + h, A), jnp.stack([ids, e], axis=1))
                 e = jnp.where(step_to >= 0, step_to, e)
-            ep = jnp.where(
-                due_s & (e != ids) & alive[jnp.clip(e, 0, N - 1)], e, -1)
+            ep = wire_ok(jnp.where(
+                due_s & (e != ids) & alive[jnp.clip(e, 0, N - 1)], e, -1),
+                "shuffle_fwd")
             # forward merge: origin folds the endpoint's sample
             # (shuffle_reply)
             fwd_samp = jnp.where((ep >= 0)[:, None],
@@ -350,7 +431,8 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 jax.random.fold_in(key, 50))
 
         return DenseHvState(active=active, passive=passive, alive=alive,
-                            rnd=state.rnd + 1)
+                            rnd=state.rnd + 1,
+                            partition=state.partition)
 
     return jax.jit(step)
 
@@ -370,6 +452,7 @@ def run_dense(state: DenseHvState, n_rounds: int, cfg: Config,
 
 # ------------------------------------------------------------- health
 
+@jax.jit
 def connectivity(state: DenseHvState) -> Dict[str, jax.Array]:
     """On-device health: BFS reachability over the active overlay from
     node 0 (restricted to live nodes), symmetry rate, view-size stats —

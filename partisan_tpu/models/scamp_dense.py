@@ -73,6 +73,8 @@ class DenseScampState:
     insert_dropped: jax.Array  # [N] keeps refused by a full view
     walk_expired: jax.Array    # [N] walks dead of old age (counted)
     walk_truncated: jax.Array  # [N] join fan copies lost to full slots
+    in_view_dropped: jax.Array  # [N] keep-notifications lost to the
+                                # c=4 per-subject reverse_select cap
     rnd: jax.Array
 
 
@@ -99,6 +101,7 @@ def dense_scamp_init(cfg: Config) -> DenseScampState:
         insert_dropped=jnp.zeros((n,), jnp.int32),
         walk_expired=jnp.zeros((n,), jnp.int32),
         walk_truncated=jnp.zeros((n,), jnp.int32),
+        in_view_dropped=jnp.zeros((n,), jnp.int32),
         rnd=jnp.int32(0),
     )
     # bootstrap: every node joins through a random contact (the
@@ -234,7 +237,8 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         flat_pos = pos.reshape(-1)                         # [N*C]
         subj = jnp.repeat(ids, C)                          # [N*C]
         active_w = (flat_pos >= 0) & alive[jnp.clip(flat_pos, 0, N - 1)] \
-            & alive[subj]
+            & jnp.repeat(alive, C)   # own-aliveness is a broadcast, not
+                                     # a 1M-index gather
         hsize = jnp.where(active_w,
                           sizes_all[jnp.clip(flat_pos, 0, N - 1)], 0)
         can_keep = active_w & (flat_pos != subj)
@@ -272,6 +276,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         # keep-notification (v2): admitted subjects record the holder
         # in their in-view — routed by a second reverse_select over the
         # flattened admit matrix (entry e = holder * 4 + j)
+        iv_lost = jnp.zeros((N,), jnp.int32)
         if 'inview' not in _dbg:
           ev_subj = jnp.where(admitted, csubj, -1).reshape(-1)
           back = reverse_select(
@@ -283,6 +288,14 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
               holder_j = jnp.where(e_j >= 0, e_j // 4, -1)
               in_view, _, _ = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
                   in_view, holder_j, None)
+          # count-don't-silence: a subject admitted at more than 4
+          # holders in one round loses the excess in-view
+          # notifications to the reverse_select cap (ADVICE r3)
+          sent_per_subj = jax.ops.segment_sum(
+              (ev_subj >= 0).astype(jnp.int32),
+              jnp.clip(ev_subj, 0, N - 1), N)
+          got_per_subj = jnp.sum(back >= 0, axis=1)
+          iv_lost = sent_per_subj - got_per_subj
 
         # a walker whose proposal was ADMITTED dies; one whose proposal
         # lost the admit race (or was refused) re-forwards next round
@@ -316,6 +329,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
             insert_dropped=st.insert_dropped + dropped,
             walk_expired=st.walk_expired
             + jax.ops.segment_sum(expired.astype(jnp.int32), subj, N),
+            in_view_dropped=st.in_view_dropped + iv_lost,
             rnd=st.rnd + 1,
         )
         return st_out
@@ -361,6 +375,7 @@ def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,
     return st
 
 
+@jax.jit
 def scamp_health(st: DenseScampState) -> Dict[str, jax.Array]:
     """Weak connectivity over the symmetric closure of the partial
     views + view-size stats (the engine path's health surface,

@@ -47,6 +47,15 @@ def insert(s: jax.Array, x: jax.Array) -> jax.Array:
     return new
 
 
+def _first_match_value(sel: jax.Array, s: jax.Array) -> jax.Array:
+    """Value of the first slot where ``sel`` — as a one-hot reduction
+    (TPU-fast: a data-dependent ``s[idx]`` lane gather lowers ~10x
+    slower than an elementwise select + sum at these widths,
+    scripts/profile_ops.py)."""
+    first = sel & (jnp.cumsum(sel.astype(jnp.int32)) == 1)
+    return jnp.sum(jnp.where(first, s, 0)).astype(jnp.int32)
+
+
 def insert_evict(
     s: jax.Array, x: jax.Array, key: jax.Array | None
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -72,9 +81,48 @@ def insert_evict(
         rand_slot = jax.random.randint(key, (), 0, cap)
         slot = jnp.where(has_free, first_free, rand_slot)
         do = want
-        evicted = jnp.where(do & ~has_free, s[slot], EMPTY).astype(jnp.int32)
+        evicted = jnp.where(
+            do & ~has_free,
+            _first_match_value(jnp.arange(cap) == slot, s),
+            EMPTY).astype(jnp.int32)
     new = jnp.where((jnp.arange(cap) == slot) & do, x, s)
     return new, evicted, do
+
+
+def insert_evict_bits(
+    s: jax.Array, x: jax.Array, rand32: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`insert_evict` with the eviction slot drawn from a caller-
+    supplied uint32 scalar (see :func:`random_member_bits` for why)."""
+    cap = s.shape[0]
+    present = contains(s, x)
+    want = (x >= 0) & ~present
+    free = s < 0
+    has_free = jnp.any(free)
+    first_free = jnp.argmax(free)
+    rand_slot = (rand32 % jnp.uint32(cap)).astype(jnp.int32)
+    slot = jnp.where(has_free, first_free, rand_slot)
+    evicted = jnp.where(
+        want & ~has_free,
+        _first_match_value(jnp.arange(cap) == slot, s),
+        EMPTY).astype(jnp.int32)
+    new = jnp.where((jnp.arange(cap) == slot) & want, x, s)
+    return new, evicted, want
+
+
+def _random_member_from_bits(s: jax.Array, bits: jax.Array,
+                             exclude: jax.Array | None) -> jax.Array:
+    ok = s >= 0
+    if exclude is not None:
+        ex = jnp.atleast_1d(jnp.asarray(exclude))
+        ok = ok & ~jnp.any(s[None, :] == ex[:, None], axis=0)
+    # max-of-random with a one-hot readback instead of argmax + lane
+    # gather; f32 keeps 24 random bits — collisions at 2^-24 resolve
+    # to the first slot, far below the parity tests' resolution
+    f = jnp.where(ok, (bits >> 8).astype(jnp.float32), -1.0)
+    m = jnp.max(f)
+    member = _first_match_value(ok & (f == m), s)
+    return jnp.where(m >= 0, member, EMPTY).astype(jnp.int32)
 
 
 def random_member(
@@ -83,15 +131,33 @@ def random_member(
     """Uniformly random member (or -1 when empty), optionally excluding one id
     — the ``select_random(State, [exclude...])`` helper (hyparview :1346-1361).
     ``exclude`` may be a scalar or a 1-D array of ids to exclude."""
+    return _random_member_from_bits(
+        s, jax.random.bits(key, s.shape, jnp.uint32), exclude)
+
+
+def random_member_bits(
+    s: jax.Array, bits: jax.Array, exclude: jax.Array | None = None
+) -> jax.Array:
+    """:func:`random_member` from caller-supplied uint32 randomness
+    (shape of ``s``) — the dense models generate per-(row, slot) bits
+    with one elementwise ``mix32`` for the whole node axis, which costs
+    ~0.05 ms where a vmapped ``fold_in`` key derivation costs ~0.34 ms
+    at N=2^16 (scripts/profile_ops.py)."""
+    return _random_member_from_bits(s, bits, exclude)
+
+
+def _random_k_from_bits(s: jax.Array, bits: jax.Array, k: int,
+                        exclude: jax.Array | None) -> jax.Array:
     ok = s >= 0
     if exclude is not None:
         ex = jnp.atleast_1d(jnp.asarray(exclude))
         ok = ok & ~jnp.any(s[None, :] == ex[:, None], axis=0)
-    n = jnp.sum(ok)
-    # Gumbel-max over valid slots: uniform among them, fixed-shape.
-    g = jax.random.gumbel(key, s.shape)
-    idx = jnp.argmax(jnp.where(ok, g, -jnp.inf))
-    return jnp.where(n > 0, s[idx], EMPTY).astype(jnp.int32)
+    # single-key payload sort (ascending random, invalid slots at +inf):
+    # the earlier argsort + order-gather lowered ~10x slower on TPU
+    key32 = jnp.where(ok, bits >> 1, jnp.uint32(1) << 31)
+    _, picked = jax.lax.sort((key32, s), dimension=0, num_keys=1)
+    rank_ok = jnp.arange(k) < jnp.sum(ok)
+    return jnp.where(rank_ok, picked[:k], EMPTY).astype(jnp.int32)
 
 
 def random_k(
@@ -99,18 +165,25 @@ def random_k(
 ) -> jax.Array:
     """Up to ``k`` distinct random members, -1 padded — the shuffle sample
     (``select_random_sublist``, hyparview :572-607, 1589-1595)."""
-    ok = s >= 0
-    if exclude is not None:
-        ex = jnp.atleast_1d(jnp.asarray(exclude))
-        ok = ok & ~jnp.any(s[None, :] == ex[:, None], axis=0)
-    g = jax.random.gumbel(key, s.shape)
-    order = jnp.argsort(jnp.where(ok, g, -jnp.inf))[::-1]  # valid slots first
-    picked = s[order[:k]]
-    rank_ok = jnp.arange(k) < jnp.sum(ok)
-    return jnp.where(rank_ok, picked, EMPTY).astype(jnp.int32)
+    return _random_k_from_bits(
+        s, jax.random.bits(key, s.shape, jnp.uint32), k, exclude)
+
+
+def random_k_bits(
+    s: jax.Array, bits: jax.Array, k: int,
+    exclude: jax.Array | None = None
+) -> jax.Array:
+    """:func:`random_k` from caller-supplied uint32 randomness (see
+    :func:`random_member_bits`)."""
+    return _random_k_from_bits(s, bits, k, exclude)
 
 
 def members_first(s: jax.Array) -> jax.Array:
-    """Compact valid members to the front (order not preserved)."""
-    order = jnp.argsort(jnp.where(s >= 0, 0, 1), stable=True)
-    return s[order]
+    """Compact valid members to the front (order preserved among
+    members) — a single-key payload sort on (invalid, position)."""
+    cap = s.shape[0]
+    assert cap < (1 << 16), "members_first packs positions in 16 bits"
+    key32 = (jnp.where(s >= 0, jnp.uint32(0), jnp.uint32(1) << 16)
+             | jnp.arange(cap, dtype=jnp.uint32))
+    _, out = jax.lax.sort((key32, s), dimension=0, num_keys=1)
+    return out

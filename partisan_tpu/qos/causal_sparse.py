@@ -197,7 +197,17 @@ def drain(row: CausalSparseRow, me: jax.Array
     predecessor through transitive clock advancement (the dense
     backend's drain documents the same trap).  A sequenced delivery for
     a sender the full table cannot admit degrades to dominance-only and
-    is counted (ls_dropped), never silent."""
+    is counted (ls_dropped), never silent.
+
+    Known, bounded degradation edge (ADVICE r3): a 'degraded' delivery
+    carries no last-seq record, so a RETRANSMIT of the same message
+    that crosses its ack cannot be recognized as a duplicate — under
+    the acked composition, at-least-once can become at-least-twice for
+    exactly the messages delivered while the sender table was full.
+    Each such delivery is already counted in ls_dropped; senders that
+    must not risk duplicates should size k_slots to their writer set
+    (the sender side symmetrically REFUSES to send when its own tables
+    are full, seq==0 refusal)."""
     B = row.pend_valid.shape[0]
     L = row.log.shape[0]
 
@@ -338,7 +348,16 @@ class CausalAckedSparse(CausalDeliverySparse):
     at-least-once via stored-wire-copy reemit + causal order, no cluster
     cap.  Stream seqs ride the order buffer's destination slots
     (ob_seq), so the acked layer adds no dense [A] table; the receiver's
-    last-seq dedup table is sparse too (drain's ls_* fields)."""
+    last-seq dedup table is sparse too (drain's ls_* fields).
+
+    Delivery-count contract (ADVICE r3): at-least-once, exactly-once in
+    the common case — EXCEPT for messages a receiver delivered in
+    drain's counted 'degraded' mode (its ls table full): those carry no
+    dedup record, so a reemit crossing the ack can deliver twice.  The
+    sender side refuses new sends when its own tables are full rather
+    than degrade (seq==0 refusal); the receiver-side overflow is the
+    one place duplication can leak, bounded and counted (ls_dropped) —
+    see drain's docstring."""
 
     msg_types = ("causal", "causal_ack", "ctl_csend")
 

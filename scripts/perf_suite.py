@@ -68,7 +68,8 @@ def main() -> None:
                     help="force the CPU backend (the image's TPU plugin "
                          "ignores JAX_PLATFORMS)")
     ap.add_argument("--only", default=None,
-                    help="run a single config by name substring")
+                    help="run configs by name substring "
+                         "(comma list = any-of)")
     ap.add_argument("--gather", type=int, default=None,
                     help="deliver_gather_cap for the engine configs "
                          "(sparse dispatch; see Config)")
@@ -80,7 +81,8 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
     R = 50 if args.quick else 200
     rows = []
-    want = lambda name: args.only is None or args.only in name
+    want = lambda name: args.only is None or any(
+        tok and tok in name for tok in args.only.split(","))
 
     if want("full_membership"):
         # BASELINE #1: full membership, small cluster
@@ -166,8 +168,7 @@ def main() -> None:
                 w0 = dense_init(cfg.replace(seed=11 + 13 * t))
                 t0 = time.perf_counter()
                 out = run_dense(w0, rnds, cfg, 0.01)
-                h = {k: float(np.asarray(v))
-                     for k, v in connectivity(out).items()}   # sync
+                float(jnp.sum(out.active))                    # sync
                 rates.append(rnds / (time.perf_counter() - t0))
             # health on a healed overlay: under continuous restart churn
             # a snapshot always catches a few mid-rejoin nodes — the
@@ -323,6 +324,55 @@ def main() -> None:
                          round(rounds / dt, 1),
                          f"echoes={msgs},echoes_per_sec={msgs/dt:.1f}"])
             print(f"{name:28s} N=2       {msgs/dt:9.1f} echoes/s")
+
+    if want("echo_mb"):
+        # VERDICT r3 #6: the reference's FULL payload range — SIZE
+        # {1,2,4,8} MB x RTT {1,20,100} ms (partisan_SUITE.erl:1029-1136
+        # + bin/perf-suite.sh's tc-netem RTT axis).  Cadence mapping:
+        # ONE ENGINE ROUND = 1 ms of transport latency, so an RTT of
+        # k ms stamps delay=k rounds on each hop (the engine holds the
+        # message exactly k rounds — SURVEY §4.2's '$delay' plane).
+        # Payload bytes, not message count, dominate these rows: each
+        # in-flight message carries MB-scale int32 words through the
+        # router's sort-route-gather, which is the regime the 1-16 KB
+        # sweep above never touches.  plain (p1) vs connection-lane
+        # parallelism (p4, the reference's PARALLELISM axis) at the
+        # sweep corners.
+        from partisan_tpu.models.echo import Echo
+        from partisan_tpu.peer_service import send_ctl
+        mb_sweep = [(mb, rtt, 1) for mb in (1, 2, 4, 8)
+                    for rtt in (1, 20, 100)]
+        mb_sweep += [(mb, rtt, 4) for mb in (1, 8) for rtt in (1, 100)]
+        if args.quick:
+            mb_sweep = [(1, 1, 1), (8, 1, 1)]
+        for mb, rtt, par in mb_sweep:
+            words = mb * (1 << 20) // 4
+            conc = 4
+            total = {1: 16, 20: 12, 100: 8}[rtt]
+            cfg = pt.Config(n_nodes=2, inbox_cap=2 * conc + 2,
+                            parallelism=par)
+            proto = Echo(cfg, concurrency=conc, size_words=words,
+                         total=total, rtt=rtt)
+            rounds = (total + 2) * 2 * (1 + rtt)
+            run = make_run_scan(cfg, proto, rounds)
+            w0 = send_ctl(init_world(cfg, proto), proto, 0, "ctl_start",
+                          peer=0)
+            w1, _ = run(w0)
+            int(np.asarray(w1.state.sent[0]).sum())  # compile + sync
+            w0 = send_ctl(init_world(cfg, proto), proto, 0, "ctl_start",
+                          peer=1)
+            t0 = time.perf_counter()
+            w1, _ = run(w0)
+            msgs = int(np.asarray(w1.state.sent[0]).sum())
+            dt = time.perf_counter() - t0
+            name = f"echo_mb{mb}_rtt{rtt}_p{par}"
+            mbps = msgs * mb / dt          # one-way delivered payload
+            rows.append([name, 2, rounds, round(dt, 4),
+                         round(rounds / dt, 1),
+                         f"echoes={msgs},mb_per_sec={mbps:.1f},"
+                         f"size_mb={mb},rtt_ms={rtt}"])
+            print(f"{name:28s} N=2       {mbps:9.1f} MB/s "
+                  f"({msgs} echoes)")
 
     if want("rumor"):
         # BASELINE #5: rumor fast path at 1e6 (the bench.py headline)

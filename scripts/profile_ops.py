@@ -1,0 +1,119 @@
+"""Primitive-level timing for the dense round's building blocks at
+N=2^16 (ROADMAP 1b: the phase ablation left the cost 'spread' across
+promotion/shuffle/merge-feed — this breaks those phases into their
+constituent ops to find the lowering cliffs).
+
+Each op runs as a 1000-iteration lax.scan whose carry perturbs the
+inputs (the tunnel caches (executable, input) pairs), timed whole-scan:
+per-op cost = scan_time / iters.
+
+Usage: python scripts/profile_ops.py [--n 65536] [--iters 1000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from partisan_tpu.ops import padded_set as ps  # noqa: E402
+from partisan_tpu.ops.bitset import mix32  # noqa: E402
+from partisan_tpu.models.hyparview_dense import (  # noqa: E402
+    _gather_rows, reverse_select)
+
+A, P = 6, 30
+
+
+def bench(tag, fn, state0, iters):
+    @functools.partial(jax.jit, static_argnums=())
+    def run(s0):
+        out, _ = jax.lax.scan(lambda s, i: (fn(s, i), None), s0,
+                              jnp.arange(iters))
+        return out
+
+    w = run(state0)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(x.astype(jnp.float32))), w)
+    ts = []
+    for t in range(3):
+        s0 = jax.tree_util.tree_map(lambda x: x + 0 * t, state0)
+        t0 = time.perf_counter()
+        w = run(s0)
+        jax.tree_util.tree_map(
+            lambda x: float(jnp.sum(x.astype(jnp.float32))), w)
+        ts.append((time.perf_counter() - t0) / iters * 1e3)
+    print(f"{tag:28s} {statistics.median(ts):8.3f} ms/op")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 16)
+    ap.add_argument("--iters", type=int, default=1000)
+    args = ap.parse_args()
+    n, iters = args.n, args.iters
+    ids = jnp.arange(n, dtype=jnp.int32)
+    key = jax.random.PRNGKey(0)
+    active = jax.random.randint(key, (n, A), -1, n, jnp.int32)
+    passive = jax.random.randint(jax.random.fold_in(key, 1), (n, P), -1,
+                                 n, jnp.int32)
+    idx = jax.random.randint(jax.random.fold_in(key, 2), (n,), -1, n,
+                             jnp.int32)
+
+    def nkeys(k, salt):
+        return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            jax.random.fold_in(k, salt), ids)
+
+    k0 = jax.random.PRNGKey(7)
+
+    # --- each op: state is (array, aux); perturb with iteration index
+    bench("nkeys (vmap fold_in)", lambda s, i: s + nkeys(
+        jax.random.fold_in(k0, i[0] if i.ndim else i), 3)[:, :1].astype(
+            jnp.int32) % 2, jnp.zeros((n, 1), jnp.int32), iters)
+
+    bench("gather_rows [N,A] by [N]",
+          lambda s, i: _gather_rows(s, (idx + i) % n),
+          active, iters)
+
+    bench("vmap random_member [N,P]",
+          lambda s, i: s.at[:, 0].max(jax.vmap(ps.random_member)(
+              s, nkeys(jax.random.fold_in(k0, i), 3))),
+          passive, iters)
+
+    bench("vmap random_k3 [N,P]",
+          lambda s, i: s.at[:, :3].max(jax.vmap(
+              ps.random_k, in_axes=(0, 0, None))(
+                  s, nkeys(jax.random.fold_in(k0, i), 3), 3)),
+          passive, iters)
+
+    bench("vmap insert_evict [N,A]",
+          lambda s, i: jax.vmap(ps.insert_evict)(
+              s, (idx + i) % n, nkeys(jax.random.fold_in(k0, i), 5))[0],
+          active, iters)
+
+    bench("reverse_select c=2",
+          lambda s, i: s.at[:, :2].max(reverse_select(
+              (idx + i) % n, i.astype(jnp.uint32), n, 2)),
+          active, iters)
+
+    bench("repair mutual [N,A,A]",
+          lambda s, i: jnp.where(
+              jnp.any(_gather_rows(s, s) == ids[:, None, None], axis=-1),
+              s, (s + i) % n),
+          active, iters)
+
+    bench("searchsorted [N]",
+          lambda s, i: s.at[:, 0].set(jnp.searchsorted(
+              jnp.sort((s[:, 0] + i) % n), ids).astype(jnp.int32)),
+          active, iters)
+
+
+if __name__ == "__main__":
+    main()

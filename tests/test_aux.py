@@ -3,6 +3,7 @@ events (partisan_peer_service_events analog), console, and on-device
 metrics (SURVEY §5.5)."""
 
 import numpy as np
+import pytest
 
 import partisan_tpu as pt
 from partisan_tpu import checkpoint, events, metrics, peer_service
@@ -89,6 +90,7 @@ class TestMetrics:
         assert float(h["convergence"]) == 1.0
         assert int(h["alive"]) == 8
 
+    @pytest.mark.standard
     def test_view_stats_and_connectivity(self):
         cfg = pt.Config(n_nodes=16, inbox_cap=8, shuffle_interval=5)
         proto = HyParView(cfg)

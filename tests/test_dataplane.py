@@ -14,6 +14,10 @@ from partisan_tpu.models.full_membership import FullMembership
 from partisan_tpu.models.hyparview import HyParView
 from partisan_tpu.models.stack import Stacked
 
+# mid-weight tier (VERDICT r3 #10): deselect with the quick tier
+pytestmark = pytest.mark.standard
+
+
 
 def make(cfg, lower=None, **dp_kw):
     proto = Stacked(lower or FullMembership(cfg), DataPlane(cfg, **dp_kw))

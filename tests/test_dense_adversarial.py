@@ -1,0 +1,164 @@
+"""Adversarial cross-path validation of the dense HyParView re-layout
+(VERDICT r3 #3): the engine path carries the reference's full
+epoch/disconnect-id staleness machinery
+(partisan_hyparview_peer_service_manager.erl:1622-1676); the dense
+path drops it, CLAIMING staleness is structurally impossible in a
+round-synchronous step (hyparview_dense.py docstring).  This test puts
+both paths through the same adversarial regime — partitions + restart
+churn + rejoin, simultaneously — and asserts the claim's observable
+consequences instead of trusting it:
+
+  * no stale-peer resurrection: a restarted node must not linger (or
+    reappear) in any third party's active view without a fresh
+    TWO-SIDED handshake — checked edge-by-edge around externally-driven
+    restarts with known reset sets;
+  * connectivity repairs after the partition resolves, in bounded
+    rounds, on both paths;
+  * the surviving view-size distributions bracket each other (the
+    SURVEY §7.3 distributional parity bar) under faults, not just calm
+    churn.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.hyparview_dense import (
+    connectivity, dense_init, make_dense_round)
+from partisan_tpu.ops import graph
+from partisan_tpu.verify import faults
+
+N = 1024
+
+
+def _reset_rows(s, resets, contacts):
+    """Externally-driven restart-in-place (exactly the churn phase's
+    semantics, but with a reset set the TEST knows, so staleness is
+    assertable edge-by-edge)."""
+    n = s.active.shape[0]
+    mask = jnp.zeros((n,), bool).at[resets].set(True)
+    active = jnp.where(mask[:, None], -1, s.active)
+    passive = jnp.where(mask[:, None], -1, s.passive)
+    passive = passive.at[resets, 0].set(contacts)
+    return s.replace(active=active, passive=passive)
+
+
+class TestDenseAdversarialCrossPath:
+    @pytest.mark.slow
+    def test_partitions_churn_rejoin_parity(self):
+        rng = np.random.RandomState(7)
+        cfg = pt.Config(n_nodes=N, shuffle_interval=4,
+                        random_promotion_interval=2)
+
+        # ---------- dense path (faults build: partition plane live)
+        step = make_dense_round(cfg, churn=0.0, faults=True)
+        s = dense_init(cfg)
+        for _ in range(50):                        # form the overlay
+            s = step(s)
+        h0 = {k: float(np.asarray(v)) for k, v in connectivity(s).items()}
+        assert h0["connected"], h0
+
+        # partition into halves + churn 1%/round for 30 rounds, with
+        # the reset sets chosen HERE so staleness is checkable
+        s = s.replace(partition=(jnp.arange(N) >= N // 2)
+                      .astype(jnp.int32))
+        recent = []                                 # (round_ago, resets)
+        for r in range(30):
+            resets = rng.choice(N, size=max(1, N // 100), replace=False)
+            contacts = (resets + 1 + rng.randint(0, N - 2, resets.shape)) % N
+            s = _reset_rows(s, jnp.asarray(resets), jnp.asarray(contacts))
+            s = step(s)
+            recent.append(resets)
+            # no stale-peer resurrection: two rounds after a restart,
+            # every active edge pointing AT a restarted node must be
+            # reciprocated (a fresh two-sided handshake), never a
+            # leftover of its previous life
+            if len(recent) >= 3:
+                old = recent[-3]
+                act = np.asarray(s.active)
+                holders, slots = np.nonzero(np.isin(act, old))
+                for i, j in zip(holders, slots):
+                    peer = act[i, j]
+                    assert i in act[peer], (
+                        f"round {r}: node {i} holds restarted peer "
+                        f"{peer} without reciprocation — stale edge")
+        # no cross-partition active edges survive under the fault build
+        act = np.asarray(s.active)
+        side = np.arange(N) >= N // 2
+        holders, slots = np.nonzero(act >= 0)
+        cross = side[holders] != side[act[holders, slots]]
+        assert not cross.any(), f"{cross.sum()} cross-partition edges"
+
+        # resolve; measure rounds to reconnect
+        s = s.replace(partition=jnp.zeros((N,), jnp.int32))
+        repair_dense = None
+        for r in range(60):
+            s = step(s)
+            if bool(connectivity(s)["connected"]):
+                repair_dense = r + 1
+                break
+        assert repair_dense is not None, "dense overlay never reconnected"
+        hd = {k: float(np.asarray(v)) for k, v in connectivity(s).items()}
+        assert hd["symmetry"] >= 0.99, hd
+        dense_sizes = np.sum(np.asarray(s.active) >= 0, axis=1)
+
+        # ---------- engine path, same regime (epochs/disconnect-ids on)
+        ecfg = pt.Config(n_nodes=N, inbox_cap=16, shuffle_interval=4,
+                         random_promotion_interval=2,
+                         keepalive_interval=4)
+        proto = HyParView(ecfg)
+        world = pt.init_world(ecfg, proto)
+        world = peer_service.cluster(
+            world, proto, [(i, rng.randint(0, i)) for i in range(1, N)])
+        estep = pt.make_step(ecfg, proto, donate=False)
+        for _ in range(50):
+            world, _ = estep(world)
+        world = faults.inject_partition(
+            world, [list(range(N // 2)), list(range(N // 2, N))])
+        crashed: list = []
+        for r in range(30):
+            # restart churn: crash 1%, recover+rejoin them 3 rounds later
+            todo = rng.choice(N, size=max(1, N // 100), replace=False)
+            world = faults.crash(world, [int(x) for x in todo])
+            crashed.append(todo)
+            if len(crashed) > 3:
+                back = crashed.pop(0)
+                world = faults.recover(world, [int(x) for x in back])
+                for x in back:
+                    world = peer_service.join(
+                        world, proto, int(x),
+                        int((x + 1 + rng.randint(0, N - 2)) % N))
+            world, _ = estep(world)
+        for past in crashed:                        # recover stragglers
+            world = faults.recover(world, [int(x) for x in past])
+            for x in past:
+                world = peer_service.join(
+                    world, proto, int(x),
+                    int((x + 1 + rng.randint(0, N - 2)) % N))
+        world = faults.resolve_partition(world)
+        repair_engine = None
+        for r in range(60):
+            world, _ = estep(world)
+            adj = graph.adjacency_from_views(world.state.active, N)
+            alive = np.asarray(world.alive)
+            if bool(graph.is_connected(adj & alive[None, :]
+                                       & alive[:, None])):
+                repair_engine = r + 1
+                break
+        assert repair_engine is not None, "engine overlay never reconnected"
+        engine_sizes = np.sum(np.asarray(world.state.active) >= 0, axis=1)
+
+        # ---------- cross-path assertions
+        # bounded, comparable repair (both reconnect within the window;
+        # neither path is an order of magnitude behind the other)
+        assert repair_dense <= 60 and repair_engine <= 60
+        # view-size distributions bracket each other under faults
+        md, me = float(dense_sizes.mean()), float(engine_sizes.mean())
+        assert abs(md - me) <= 2.5, (md, me)
+        assert dense_sizes.max() <= ecfg.max_active_size
+        assert (dense_sizes > 0).mean() >= 0.99, \
+            "isolated nodes after rejoin"

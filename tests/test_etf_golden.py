@@ -1,0 +1,280 @@
+"""ETF golden corpus + differential fuzz (VERDICT r3 #7a/b).
+
+The port bridge is the one boundary where this framework and a BEAM
+must agree bit-for-bit, and no ``erl`` exists in this image to generate
+vectors — so the corpus below is TRANSCRIBED from the External Term
+Format specification (erts/preloaded + the interop doc; the same wire
+the reference speaks through ``term_to_binary``/``binary_to_term``,
+partisan_util.erl:235-297, partisan_peer_service_client.erl:275-276),
+byte by byte, tag by tag.  It was NOT produced by the codec under test.
+
+Coverage: every tag the port uses — SMALL_INTEGER/INTEGER/SMALL_BIG/
+LARGE_BIG, NEW_FLOAT (incl. extremes + subnormal), both atom encodings
+(SMALL_ATOM_UTF8/ATOM_UTF8) plus legacy ATOM_EXT, NIL/STRING/LIST
+(nested), BINARY (empty/small/64KB+), SMALL_TUPLE/LARGE_TUPLE, MAP
+(empty/nested), and deep mixed terms.
+
+``canon=True`` rows additionally pin the ENCODER: our codec must emit
+exactly these bytes (they use the tags our encoder chooses).
+``canon=False`` rows are alternative/legacy encodings a BEAM may send
+(ATOM_EXT, STRING_EXT) — decode-only.
+"""
+
+import struct
+
+import pytest
+
+from partisan_tpu.bridge import native_loader
+from partisan_tpu.bridge.etf import Atom, decode, encode
+
+
+V = 131  # version byte
+
+
+def _f(x: float) -> bytes:
+    return bytes([V, 70]) + struct.pack(">d", x)
+
+
+def vec(desc, raw, term, canon=True):
+    return pytest.param(bytes(raw), term, canon, id=desc)
+
+
+GOLDEN = [
+    # ---- small integers (SMALL_INTEGER_EXT = 97, uint8)
+    vec("smallint_0", [V, 97, 0], 0),
+    vec("smallint_1", [V, 97, 1], 1),
+    vec("smallint_255", [V, 97, 255], 255),
+    # ---- 32-bit integers (INTEGER_EXT = 98, int32 BE)
+    vec("int_256", [V, 98, 0, 0, 1, 0], 256),
+    vec("int_neg1", [V, 98, 255, 255, 255, 255], -1),
+    vec("int_neg256", [V, 98, 255, 255, 255, 0], -256),
+    vec("int_max", [V, 98, 127, 255, 255, 255], (1 << 31) - 1),
+    vec("int_min", [V, 98, 128, 0, 0, 0], -(1 << 31)),
+    # ---- bignums (SMALL_BIG_EXT = 110: n, sign, n LE digits)
+    vec("big_2p31", [V, 110, 4, 0, 0, 0, 0, 128], 1 << 31),
+    vec("big_2p32", [V, 110, 5, 0, 0, 0, 0, 0, 1], 1 << 32),
+    vec("big_neg_2p31_minus1",
+        [V, 110, 4, 1, 1, 0, 0, 128], -((1 << 31) + 1)),
+    vec("big_neg_2p40", [V, 110, 6, 1, 0, 0, 0, 0, 0, 1], -(1 << 40)),
+    vec("big_2p64_minus1", [V, 110, 8, 0] + [255] * 8, (1 << 64) - 1),
+    vec("big_255_digits", [V, 110, 255, 0] + [0] * 254 + [1],
+        1 << (8 * 254)),
+    # LARGE_BIG_EXT = 111: uint32 n, sign, n LE digits
+    vec("large_big_257_digits",
+        [V, 111, 0, 0, 1, 1, 0] + [0] * 256 + [1], 1 << (8 * 256)),
+    # ---- floats (NEW_FLOAT_EXT = 70, IEEE-754 double BE)
+    vec("float_zero", _f(0.0), 0.0),
+    vec("float_1_5", _f(1.5), 1.5),
+    vec("float_neg2_25", _f(-2.25), -2.25),
+    vec("float_1e308", _f(1e308), 1e308),
+    vec("float_subnormal_min", _f(5e-324), 5e-324),
+    vec("float_neg1e_10", _f(-1e-10), -1e-10),
+    # ---- atoms (SMALL_ATOM_UTF8_EXT = 119: uint8 len, utf8 bytes)
+    vec("atom_ok", [V, 119, 2] + list(b"ok"), Atom("ok")),
+    vec("atom_empty", [V, 119, 0], Atom("")),
+    vec("atom_true_is_bool", [V, 119, 4] + list(b"true"), True),
+    vec("atom_false_is_bool", [V, 119, 5] + list(b"false"), False),
+    vec("atom_undefined", [V, 119, 9] + list(b"undefined"),
+        Atom("undefined")),
+    vec("atom_utf8_eacute", [V, 119, 2, 0xC3, 0xA9], Atom("é")),
+    # ATOM_UTF8_EXT = 118: uint16 len — needed once len > 255 bytes
+    vec("atom_long_300", [V, 118, 1, 44] + [ord("a")] * 300,
+        Atom("a" * 300)),
+    # legacy ATOM_EXT = 100 (latin-1, uint16 len): decode-only
+    vec("legacy_atom_join", [V, 100, 0, 4] + list(b"join"),
+        Atom("join"), canon=False),
+    vec("legacy_atom_true", [V, 100, 0, 4] + list(b"true"), True,
+        canon=False),
+    # ---- nil / strings / lists
+    vec("nil", [V, 106], []),
+    # STRING_EXT = 107 (uint16 len, bytes): how a BEAM sends [0..255]
+    # int lists — decode-only (we always emit LIST_EXT)
+    vec("string_ab", [V, 107, 0, 2, 97, 98], [97, 98], canon=False),
+    vec("string_255s", [V, 107, 1, 0] + [255] * 256, [255] * 256,
+        canon=False),
+    # LIST_EXT = 108: uint32 len, elems, tail (NIL when proper)
+    vec("list_1000", [V, 108, 0, 0, 0, 1, 98, 0, 0, 3, 232, 106],
+        [1000]),
+    vec("list_nested_empty", [V, 108, 0, 0, 0, 1, 106, 106], [[]]),
+    vec("list_mixed",
+        [V, 108, 0, 0, 0, 3, 97, 1,
+         108, 0, 0, 0, 1, 97, 2, 106,
+         104, 1, 97, 3, 106],
+        [1, [2], (3,)]),
+    vec("list_of_atoms",
+        [V, 108, 0, 0, 0, 2, 119, 1, 97, 119, 1, 98, 106],
+        [Atom("a"), Atom("b")]),
+    vec("list_300_zeros", [V, 108, 0, 0, 1, 44] + [97, 0] * 300 + [106],
+        [0] * 300),
+    # ---- binaries (BINARY_EXT = 109: uint32 len, bytes)
+    vec("binary_empty", [V, 109, 0, 0, 0, 0], b""),
+    vec("binary_hello", [V, 109, 0, 0, 0, 5] + list(b"hello"), b"hello"),
+    vec("binary_zero_bytes", [V, 109, 0, 0, 0, 3, 0, 0, 0],
+        b"\x00\x00\x00"),
+    vec("binary_70000",
+        [V, 109, 0, 1, 17, 112] + [0xAB] * 70000, b"\xab" * 70000),
+    # ---- tuples (SMALL_TUPLE_EXT = 104: uint8 arity)
+    vec("tuple_empty", [V, 104, 0], ()),
+    vec("tuple_pair", [V, 104, 2, 97, 1, 97, 2], (1, 2)),
+    vec("tuple_nested", [V, 104, 2, 104, 0, 104, 0], ((), ())),
+    vec("tuple_tagged",
+        [V, 104, 3, 119, 4] + list(b"join") + [97, 1, 97, 2],
+        (Atom("join"), 1, 2)),
+    # LARGE_TUPLE_EXT = 105: uint32 arity
+    vec("large_tuple_256", [V, 105, 0, 0, 1, 0] + [97, 0] * 256,
+        (0,) * 256),
+    # ---- maps (MAP_EXT = 116: uint32 arity, k/v pairs)
+    vec("map_empty", [V, 116, 0, 0, 0, 0], {}),
+    vec("map_atom_int", [V, 116, 0, 0, 0, 1, 119, 1, 97, 97, 1],
+        {Atom("a"): 1}),
+    vec("map_int_tuple",
+        [V, 116, 0, 0, 0, 1, 97, 1, 104, 2, 97, 2, 97, 3],
+        {1: (2, 3)}),
+    vec("map_nested",
+        [V, 116, 0, 0, 0, 1, 119, 1, 97,
+         116, 0, 0, 0, 1, 119, 1, 98, 97, 2],
+        {Atom("a"): {Atom("b"): 2}}),
+    vec("map_binary_key_list_val",
+        [V, 116, 0, 0, 0, 1, 109, 0, 0, 0, 1, 107,
+         108, 0, 0, 0, 2, 97, 1, 97, 2, 106],
+        {b"k": [1, 2]}),
+    # ---- deep mixed terms (the port's actual message shapes)
+    vec("port_msg_shape",
+        [V, 104, 3, 119, 7] + list(b"forward") + [97, 5,
+         116, 0, 0, 0, 1, 119, 4] + list(b"data") +
+        [109, 0, 0, 0, 2, 1, 2],
+        (Atom("forward"), 5, {Atom("data"): b"\x01\x02"})),
+    vec("deep_nesting",
+        [V, 108, 0, 0, 0, 1,
+         104, 1,
+         116, 0, 0, 0, 1, 97, 9, 104, 1, 106,
+         106],
+        [({9: ([],)},)]),
+    vec("mixed_numeric_list",
+        [V, 108, 0, 0, 0, 4, 97, 7, 98, 255, 255, 255, 146, 70]
+        + list(struct.pack(">d", 2.5))
+        + [110, 5, 0, 0, 0, 0, 0, 1, 106],
+        [7, -110, 2.5, 1 << 32]),
+]
+
+
+class TestGoldenVectors:
+    @pytest.mark.parametrize("raw,term,canon", GOLDEN)
+    def test_decode(self, raw, term, canon):
+        got = decode(raw)
+        assert got == term
+        # atom-vs-bytes and bool-vs-int distinctions must survive
+        assert type(got) is type(term)
+
+    @pytest.mark.parametrize("raw,term,canon", GOLDEN)
+    def test_encode_canonical(self, raw, term, canon):
+        if not canon:
+            pytest.skip("legacy/alternative encoding: decode-only")
+        assert encode(term) == raw
+
+
+# =====================================================================
+# Differential fuzz: etf.py vs the native C++ codec (VERDICT r3 #7b).
+# The two implementations share the flat-int32-list domain (the bulk
+# port path, native/etf_native.cpp); on it they must agree BYTE FOR
+# BYTE in both directions.  Beyond that domain the native codec does
+# not exist, so the general-term fuzz is a self-inverse property test
+# of etf.py (encode o decode = id over random terms).
+# =====================================================================
+
+import random  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+class TestDifferentialFuzz:
+    def test_native_lib_available(self):
+        assert native_loader.native_lib() is not None
+
+    def test_intlist_differential_thousands(self):
+        rng = random.Random(0xE7F)
+        boundaries = [0, 1, 255, 256, -1, -255, -256,
+                      (1 << 31) - 1, -(1 << 31), 65535, -65536]
+        for case in range(2000):
+            n = rng.choice((0, 1, 2, 3, 7, 64, 300))
+            vals = [rng.choice(boundaries) if rng.random() < 0.3
+                    else rng.randint(-(1 << 31), (1 << 31) - 1)
+                    for _ in range(n)]
+            py_bytes = encode(vals)
+            nat_bytes = native_loader.encode_intlist(vals)
+            assert nat_bytes == py_bytes, (case, vals[:8], n)
+            # both directions, cross-decoded
+            assert decode(nat_bytes) == vals, case
+            nat_back = native_loader.decode_intlist(py_bytes)
+            assert np.array_equal(
+                np.asarray(nat_back, np.int64),
+                np.asarray(vals, np.int64)), case
+
+    def test_intlist_decodes_string_ext_form(self):
+        """A BEAM packs [0..255] lists as STRING_EXT; the native bulk
+        decoder must accept that alternative form too (spec-transcribed
+        frame, not self-generated)."""
+        raw = bytes([V, 107, 0, 3, 10, 20, 30])
+        assert decode(raw) == [10, 20, 30]
+        got = native_loader.decode_intlist(raw)
+        assert np.array_equal(np.asarray(got), [10, 20, 30])
+
+    def _random_term(self, rng, depth=0):
+        kinds = ["int", "big", "float", "atom", "bin", "bool", "none"]
+        if depth < 3:
+            kinds += ["list", "tuple", "map"] * 2
+        k = rng.choice(kinds)
+        if k == "int":
+            return rng.randint(-(1 << 31), (1 << 31) - 1)
+        if k == "big":
+            return rng.randint(1 << 32, 1 << 80) * rng.choice((1, -1))
+        if k == "float":
+            return rng.choice((0.0, 1.5, -2.25, 1e10, 5e-324, 3.14159))
+        if k == "atom":
+            return Atom("".join(rng.choice("abcxyz_")
+                                for _ in range(rng.randint(0, 12))))
+        if k == "bin":
+            return bytes(rng.getrandbits(8)
+                         for _ in range(rng.randint(0, 40)))
+        if k == "bool":
+            return rng.random() < 0.5
+        if k == "none":
+            return None
+        n = rng.randint(0, 4)
+        if k == "list":
+            return [self._random_term(rng, depth + 1) for _ in range(n)]
+        if k == "tuple":
+            return tuple(self._random_term(rng, depth + 1)
+                         for _ in range(n))
+        items = [(self._random_term(rng, depth + 1),
+                  self._random_term(rng, depth + 1)) for _ in range(n)]
+        try:
+            return dict(items)
+        except TypeError:   # unhashable key (list/dict) — retry flat
+            return {rng.randint(0, 99): self._random_term(rng, depth + 1)}
+
+    def test_general_term_roundtrip_fuzz(self):
+        rng = random.Random(0x90137)
+        for case in range(1500):
+            t = self._random_term(rng)
+            got = decode(encode(t))
+            want = self._normalize(t)
+            assert got == want, (case, t)
+
+    def _normalize(self, t):
+        """The documented lossy edges of the mapping: None -> the
+        'undefined' atom; str -> utf-8 binary."""
+        if t is None:
+            return Atom("undefined")
+        if isinstance(t, Atom):
+            return t
+        if isinstance(t, str):
+            return t.encode("utf-8")
+        if isinstance(t, list):
+            return [self._normalize(x) for x in t]
+        if isinstance(t, tuple):
+            return tuple(self._normalize(x) for x in t)
+        if isinstance(t, dict):
+            return {self._normalize(k): self._normalize(v)
+                    for k, v in t.items()}
+        return t

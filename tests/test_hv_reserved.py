@@ -10,6 +10,11 @@ import partisan_tpu as pt
 from partisan_tpu import peer_service as ps
 from partisan_tpu.models.hyparview import HyParView
 from partisan_tpu.peer_service import send_ctl
+import pytest
+
+# mid-weight tier (VERDICT r3 #10): deselect with the quick tier
+pytestmark = pytest.mark.standard
+
 
 
 def boot(n=16, rounds=20, tags=None, reservable=False, **cfg_kw):

@@ -13,6 +13,10 @@ from partisan_tpu import peer_service
 from partisan_tpu.models.hyparview import HyParView
 from partisan_tpu.ops import graph
 
+# mid-weight tier (VERDICT r3 #10): deselect with the quick tier
+pytestmark = pytest.mark.standard
+
+
 
 def boot(n, rounds, cfg_kw=None, join_to=0):
     cfg = pt.Config(n_nodes=n, inbox_cap=8, shuffle_interval=5,

@@ -64,6 +64,7 @@ class TestDenseInvariants:
             assert len(row) == len(set(row)), (i, row)
             assert i not in row
 
+    @pytest.mark.standard
     def test_churn_recovery(self):
         """1%/round restart churn (BASELINE #5's fault plane): the
         overlay absorbs continuous restarts, and heals to full
@@ -99,6 +100,7 @@ class TestEngineParity:
             world, _ = step(world)
         return cfg, world.state
 
+    @pytest.mark.standard
     def test_distributional_parity(self):
         n = 64
         cfg_e, est = self.engine_state(n)
@@ -119,3 +121,56 @@ class TestEngineParity:
         # passive views populated in both
         pas_e = (np.asarray(est.passive) >= 0).sum(axis=1).mean()
         assert s["mean_passive"] >= 0.5 * pas_e, (s["mean_passive"], pas_e)
+
+
+class TestDenseInterposition:
+    """The faults build's wire-level hooks (VERDICT r3 #3): drop masks
+    on the dense round's wire-analog exchanges."""
+
+    def test_promote_drop_mask_isolates_target(self):
+        import jax.numpy as jnp
+        from partisan_tpu.models.hyparview_dense import (
+            dense_init, make_dense_round)
+        n = 64
+        cfg = pt.Config(n_nodes=n, shuffle_interval=4,
+                        random_promotion_interval=2)
+
+        def drop_all_promotes(phase, dst, rnd):
+            if phase == "promote":
+                return jnp.zeros(dst.shape, bool)
+            return jnp.ones(dst.shape, bool)
+
+        step = make_dense_round(cfg, faults=True,
+                                interpose=drop_all_promotes)
+        s = dense_init(cfg)
+        for _ in range(30):
+            s = step(s)
+        # no promotion proposal ever lands => no active edges at all
+        assert int(jnp.sum(s.active >= 0)) == 0
+
+    def test_partition_plane_severs_and_heals(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from partisan_tpu.models.hyparview_dense import (
+            connectivity, dense_init, make_dense_round)
+        n = 128
+        cfg = pt.Config(n_nodes=n, shuffle_interval=4,
+                        random_promotion_interval=2)
+        step = make_dense_round(cfg, faults=True)
+        s = dense_init(cfg)
+        for _ in range(40):
+            s = step(s)
+        assert bool(connectivity(s)["connected"])
+        s = s.replace(partition=(jnp.arange(n) >= n // 2)
+                      .astype(jnp.int32))
+        for _ in range(10):
+            s = step(s)
+        act = np.asarray(s.active)
+        side = np.arange(n) >= n // 2
+        h, sl = np.nonzero(act >= 0)
+        assert not (side[h] != side[act[h, sl]]).any()
+        assert not bool(connectivity(s)["connected"])
+        s = s.replace(partition=jnp.zeros((n,), jnp.int32))
+        for _ in range(40):
+            s = step(s)
+        assert bool(connectivity(s)["connected"])

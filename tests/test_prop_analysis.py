@@ -12,6 +12,10 @@ from partisan_tpu.models.hyparview import HyParView
 from partisan_tpu.verify import analysis
 from partisan_tpu.verify.prop import (ClusterCommands, Command, PropRunner,
                                       connectivity_model, convergence_model)
+import pytest
+
+# mid-weight tier (VERDICT r3 #10): deselect with the quick tier
+pytestmark = pytest.mark.standard
 
 
 class TestProp:

@@ -5,6 +5,7 @@ and rpc_test (:813) rebuilt as batched assertions."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import partisan_tpu as pt
 from partisan_tpu.peer_service import send_ctl
@@ -145,6 +146,7 @@ class TestCausalAcked:
             world, _ = step(world)
         assert int(world.state.causal.log_n[2]) == 1
 
+    @pytest.mark.standard
     def test_transitive_clock_advance_not_marked_duplicate(self):
         """Transitive-dominance repro: r's clock advances via t past m2's
         clock before m1 arrives.  Per-stream seq ordering must hold m2

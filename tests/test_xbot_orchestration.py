@@ -4,6 +4,7 @@ import json
 
 import jax
 import numpy as np
+import pytest
 
 import partisan_tpu as pt
 from partisan_tpu import peer_service
@@ -12,6 +13,10 @@ from partisan_tpu.models.managers import StaticManager
 from partisan_tpu.orchestration import (FileSystemStrategy,
                                         OrchestrationBackend)
 from partisan_tpu.ops import graph
+
+# mid-weight tier (VERDICT r3 #10): deselect with the quick tier
+pytestmark = pytest.mark.standard
+
 
 
 def total_edge_cost(active, n):
