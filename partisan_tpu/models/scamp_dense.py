@@ -75,6 +75,13 @@ class DenseScampState:
     walk_truncated: jax.Array  # [N] join fan copies lost to full slots
     in_view_dropped: jax.Array  # [N] keep-notifications lost to the
                                 # c=4 per-subject reverse_select cap
+    last_reset: jax.Array  # [N] round of the node's last restart
+                           # (-10^6 = never) — drives the amortized
+                           # stale-entry sweep
+    pstamp: jax.Array      # [N, P] admission round of each partial
+                           # entry — the sweep deletes exactly the
+                           # entries older than the peer's last restart
+    ivstamp: jax.Array     # [N, P] same for in_view entries
     rnd: jax.Array
 
 
@@ -102,6 +109,9 @@ def dense_scamp_init(cfg: Config) -> DenseScampState:
         walk_expired=jnp.zeros((n,), jnp.int32),
         walk_truncated=jnp.zeros((n,), jnp.int32),
         in_view_dropped=jnp.zeros((n,), jnp.int32),
+        last_reset=jnp.full((n,), -1000000, jnp.int32),
+        pstamp=jnp.zeros((n, p), jnp.int32),
+        ivstamp=jnp.zeros((n, p), jnp.int32),
         rnd=jnp.int32(0),
     )
     # bootstrap: every node joins through a random contact (the
@@ -155,6 +165,8 @@ def _spawn_walks(st: DenseScampState, contact: jax.Array,
         walk_age=jnp.where(doing[:, None], 0, st.walk_age),
         walk_truncated=st.walk_truncated
         + jnp.where(doing, lost, 0).astype(jnp.int32),
+        pstamp=jnp.where(doing[:, None], st.rnd, st.pstamp),
+        ivstamp=jnp.where(doing[:, None], st.rnd, st.ivstamp),
     )
 
 
@@ -180,12 +192,12 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
             jax.random.PRNGKey(cfg.seed ^ 0x5CADE), st.rnd)
         alive = st.alive
         partial, in_view = st.partial, st.in_view
+        pstamp, ivstamp = st.pstamp, st.ivstamp
         pos, age = st.walk_pos, st.walk_age
 
         # ---- churn: restart-in-place.  Round-4 restructure (the
         # ROADMAP 1d lever): churn only CLEARS state here — restarted
-        # rows wipe their views/walkers and every view drops the
-        # churned peers (the remove_subscription effect) — and the
+        # rows wipe their views/walkers and stamp last_reset — and the
         # rejoin rides the isolation re-subscribe below, since a
         # cleared row satisfies the isolation predicate by
         # construction.  One _spawn_walks instance per round instead
@@ -193,10 +205,11 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         # TPU worker at N=2^16 beyond ~50 scanned rounds (compositional
         # — every op individually clean) while this schedule runs 100-
         # round launches clean (see LAUNCH_CAP for the residual length
-        # sensitivity; results.csv scamp_dense_65536).  Walk spawns now
-        # gather the contact's POST-drop view (a restarted contact can
+        # sensitivity; results.csv scamp_dense_65536).  Walk spawns
+        # gather the contact's POST-clear view (a restarted contact can
         # still host the walker itself via the empty-view first-join
         # branch — it is alive, restart-in-place).
+        last_reset = st.last_reset
         if churn > 0.0 and 'churn' not in _dbg:
             ck = jax.random.fold_in(key, 0)
             reset = (jax.random.uniform(ck, (N,)) < churn) & alive
@@ -204,14 +217,44 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
             in_view = jnp.where(reset[:, None], -1, in_view)
             pos = jnp.where(reset[:, None], -1, pos)
             age = jnp.where(reset[:, None], 0, age)
-            partial = jnp.where(
-                reset[jnp.clip(partial, 0, N - 1)] & (partial >= 0),
-                -1, partial)
-            in_view = jnp.where(
-                reset[jnp.clip(in_view, 0, N - 1)] & (in_view >= 0),
-                -1, in_view)
-            # walks standing AT a churned holder bounce via the
-            # empty-view path below
+            pstamp = jnp.where(reset[:, None], st.rnd, pstamp)
+            ivstamp = jnp.where(reset[:, None], st.rnd, ivstamp)
+            last_reset = jnp.where(reset, st.rnd, last_reset)
+
+        # ---- amortized stale-entry sweep (the remove_subscription
+        # effect): each round re-checks a rotating window of K_SWEEP=8
+        # columns
+        # of the concatenated (partial ++ in_view) planes against
+        # the peer's last restart, so every stale entry clears within
+        # ~ceil(W/8) rounds of the restart.  Bounded removal latency is the faithful cadence —
+        # the reference's remove_subscription is GOSSIP-carried, never
+        # instantaneous (scamp_v2 :180-227) — and it shares the
+        # reference's removal semantics: a re-proposal of a held
+        # subject refreshes the entry's stamp (resubscribe supersedes
+        # the pending unsubscribe), so only subscriptions the subject
+        # never re-requests are swept.  It is also the difference between
+        # ~5 and ~19 rounds/s at 2^16: the round-3 full-plane scrub
+        # gather pushed XLA into a pathological schedule costing
+        # ~140 ms a round (scripts/profile_scamp.py; the same fusion
+        # pass Check-fails outright on a neighboring ablation variant,
+        # scripts/repro_scamp_dense_fault.py).  Runs in churn-free
+        # programs too, so a settle window finishes the sweep.
+        cat = jnp.concatenate([partial, in_view], axis=1)
+        scat = jnp.concatenate([pstamp, ivstamp], axis=1)
+        W = cat.shape[1]
+        K_SWEEP = 8              # columns re-checked per round: removal
+                                 # latency is ceil(W/K) rounds
+        for j in range(K_SWEEP):
+            cj = (st.rnd * K_SWEEP + j) % W
+            col = jnp.take(cat, cj, axis=1)                  # [N]
+            lr = last_reset[jnp.clip(col, 0, N - 1)]         # [N]
+            # exact: delete iff the entry was admitted BEFORE the
+            # peer's last restart (same-round admissions are always
+            # post-clear — churn runs first in the step)
+            stale = (col >= 0) & (jnp.take(scat, cj, axis=1) < lr)
+            cat = cat.at[:, cj].set(jnp.where(stale, -1, col))
+        partial = cat[:, : partial.shape[1]]
+        in_view = cat[:, partial.shape[1]:]
 
         # ---- re-subscribe: churned rows (cleared above) and isolated
         # rows (empty view, no walkers) join through a fresh contact
@@ -222,10 +265,12 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)
         st3 = _spawn_walks(
             st.replace(partial=partial, in_view=in_view, walk_pos=pos,
-                       walk_age=age),
+                       walk_age=age, pstamp=pstamp, ivstamp=ivstamp),
             fresh, lonely, jax.random.fold_in(key, 4), cfg)
         partial, in_view = st3.partial, st3.in_view
+        pstamp, ivstamp = st3.pstamp, st3.ivstamp
         pos, age = st3.walk_pos, st3.walk_age
+        walk_truncated = st3.walk_truncated
 
         # ---- one walk hop for every active walker.  The walker plane
         # touches only O(N*C) SCALARS: view sizes are gathered from a
@@ -265,12 +310,20 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         dropped = jnp.zeros((N,), jnp.int32)
         for j in (range(0) if 'admit' in _dbg else range(4)):
             s_j = csubj[:, j]
-            hit = jnp.any(partial == s_j[:, None], axis=1)
+            dup_slot = (partial == s_j[:, None]) & (s_j >= 0)[:, None]
+            # a re-proposal of an ALREADY-HELD subject refreshes the
+            # entry's stamp: resubscribe supersedes a pending (swept)
+            # unsubscribe, so the sweep cannot delete a subscription
+            # the subject re-requested after its restart
+            pstamp = jnp.where(dup_slot, st.rnd, pstamp)
+            hit = jnp.any(dup_slot, axis=1)
             want = (s_j >= 0) & ~hit
             free = jnp.sum(partial >= 0, axis=1) < P
             do = want & free
+            prev = partial
             partial, _, ins = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
                 partial, jnp.where(do, s_j, -1), None)
+            pstamp = jnp.where(partial != prev, st.rnd, pstamp)
             admitted = admitted.at[:, j].set(do & ins)
             dropped = dropped + (want & ~free).astype(jnp.int32)
         # keep-notification (v2): admitted subjects record the holder
@@ -286,8 +339,10 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
           for j in range(4):
               e_j = back[:, j]
               holder_j = jnp.where(e_j >= 0, e_j // 4, -1)
+              prev = in_view
               in_view, _, _ = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
                   in_view, holder_j, None)
+              ivstamp = jnp.where(in_view != prev, st.rnd, ivstamp)
           # count-don't-silence: a subject admitted at more than 4
           # holders in one round loses the excess in-view
           # notifications to the reverse_select cap (ADVICE r3)
@@ -329,7 +384,11 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
             insert_dropped=st.insert_dropped + dropped,
             walk_expired=st.walk_expired
             + jax.ops.segment_sum(expired.astype(jnp.int32), subj, N),
+            walk_truncated=walk_truncated,
             in_view_dropped=st.in_view_dropped + iv_lost,
+            last_reset=last_reset,
+            pstamp=pstamp,
+            ivstamp=ivstamp,
             rnd=st.rnd + 1,
         )
         return st_out
@@ -347,17 +406,21 @@ def _run_dense_scamp_launch(st: DenseScampState, n_rounds: int,
     return out
 
 
-# Per-LAUNCH scan-length cap.  The v5e worker reproducibly crashes
-# ("kernel fault") running this program as a single scan of ~200 rounds
-# at N=2^16 with churn enabled, while 100-round launches run clean
-# indefinitely (round-4 soak: 1000+ rounds as 100-round launches; the
-# round-3 shape faulted even earlier).  Every constituent op is
-# individually clean and CPU runs are clean at any length — an
-# XLA/runtime scheduling or memory bug sensitive to scan trip count at
-# this shape, not a code bug.  scripts/repro_scamp_dense_fault.py pins
-# the minimal reproducer.  Chunking is semantically invisible (the
-# carried state is identical); it only adds one host round-trip per
-# LAUNCH_CAP rounds.
+# Per-LAUNCH scan-length cap — defense-in-depth against a
+# program-shape-sensitive XLA/TPU bug this module has now hit in THREE
+# shapes (scripts/repro_scamp_dense_fault.py):
+#   * round-3 shape: worker "kernel fault" beyond ~50 scanned rounds;
+#   * round-4 mid shape (one _spawn_walks + instant scrub): clean at
+#     100, faulted at ~200 — and a neighboring ablation variant
+#     (skip=admit) crashed the COMPILER outright
+#     (scatter_emitter.cc:2824 Check failure in the fusion pass);
+#   * round-4 final shape (stamp-exact amortized sweep): clean at 500+
+#     single-launch.
+# Every constituent op is individually clean and CPU runs are clean at
+# any length — not a code bug.  The current shape no longer needs the
+# cap, but the bug is plainly nearby, chunking is semantically
+# invisible (the carried state is identical), and it costs one host
+# round-trip per LAUNCH_CAP rounds — so it stays.
 LAUNCH_CAP = 100
 
 

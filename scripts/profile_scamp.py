@@ -1,0 +1,122 @@
+"""Phase + primitive ablation for the dense-SCAMP round at N=2^16
+(ROADMAP 1d residual: ~4.9 rounds/s — where do the ~200 ms go?).
+
+Usage: python scripts/profile_scamp.py [--n 65536] [--rounds 100]
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu.models import scamp_dense as sd  # noqa: E402
+from partisan_tpu.models.hyparview_dense import reverse_select  # noqa: E402
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def run_skip(st, n_rounds, cfg, churn, skip):
+    step = sd.make_dense_scamp_round(cfg, churn, skip=skip)
+    out, _ = jax.lax.scan(lambda s, _: (step(s), None), st, None,
+                          length=n_rounds)
+    return out
+
+
+def timed(tag, fn, warm_arg, iters=1):
+    out = fn(warm_arg)
+    jax.tree_util.tree_map(
+        lambda x: float(jnp.sum(x.astype(jnp.float32))), out)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(warm_arg)
+        jax.tree_util.tree_map(
+            lambda x: float(jnp.sum(x.astype(jnp.float32))), out)
+        ts.append((time.perf_counter() - t0) / iters)
+    print(f"{tag:30s} {statistics.median(ts)*1e3:9.2f} ms")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 16)
+    ap.add_argument("--rounds", type=int, default=100)
+    args = ap.parse_args()
+    cfg = pt.Config(n_nodes=args.n)
+    n, rounds = args.n, args.rounds
+    st = sd.dense_scamp_init(cfg)
+    st.partial.block_until_ready()
+
+    for tag, churn, skip in (
+            ("full", 0.01, ()),
+            ("no_churn", 0.0, ()),
+            ("skip_admit", 0.01, ("admit",)),
+            ("skip_inview", 0.01, ("inview",)),
+            ("skip_admit+inview", 0.01, ("admit", "inview"))):
+        def f(s, churn=churn, skip=skip):
+            return run_skip(s, rounds, cfg, churn, tuple(skip))
+        timed(tag, f, st, iters=rounds)
+
+    # primitive probes at shape
+    P, C = sd.walker_caps(cfg)
+    M = n * C
+    key = jax.random.PRNGKey(0)
+    flat_pos = jax.random.randint(key, (M,), -1, n, jnp.int32)
+    vec = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0, 99)
+    partial = jax.random.randint(jax.random.fold_in(key, 2), (n, P), -1,
+                                 n, jnp.int32)
+
+    @jax.jit
+    def probe_scalar_gather(fp):
+        def body(s, i):
+            return s + vec[jnp.clip(fp + i, 0, n - 1)], None
+        out, _ = jax.lax.scan(body, jnp.zeros((M,), jnp.int32),
+                              jnp.arange(50))
+        return out
+    timed("vec[1M idx] scalar gather", probe_scalar_gather, flat_pos,
+          iters=50)
+
+    @jax.jit
+    def probe_flat_hop(fp):
+        flat = partial.reshape(-1)
+        def body(s, i):
+            return s + flat[jnp.clip(fp + i, 0, n - 1) * P
+                            + (i % P)], None
+        out, _ = jax.lax.scan(body, jnp.zeros((M,), jnp.int32),
+                              jnp.arange(50))
+        return out
+    timed("hop gather [1M from N*P]", probe_flat_hop, flat_pos, iters=50)
+
+    @jax.jit
+    def probe_rs(fp):
+        def body(s, i):
+            ch = reverse_select(jnp.where((fp + i) % 3 == 0, fp, -1),
+                                i.astype(jnp.uint32), n, 4)
+            return s + ch[:, 0], None
+        out, _ = jax.lax.scan(body, jnp.zeros((n,), jnp.int32),
+                              jnp.arange(20))
+        return out
+    timed("reverse_select M=1M c=4", probe_rs, flat_pos, iters=20)
+
+    @jax.jit
+    def probe_reset_mask(pv):
+        reset = vec < 5
+        def body(s, i):
+            out = jnp.where(reset[jnp.clip(s, 0, n - 1)] & (s >= 0), -1,
+                            s + 0 * i)
+            return out, None
+        out, _ = jax.lax.scan(body, pv, jnp.arange(50))
+        return out
+    timed("reset[clip(partial)] [N,P]", probe_reset_mask, partial,
+          iters=50)
+
+
+if __name__ == "__main__":
+    main()

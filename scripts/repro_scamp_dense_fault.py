@@ -13,16 +13,26 @@ N=2^16.  Observed on a v5e chip (jax 0.9.0, axon tunnel):
   * every constituent op of the round, run alone at shape -> clean
     (round-3 bisection, commit 18f364f)
 
-Round 4 restructured the churn phase (one _spawn_walks instance per
-round instead of two) which moved the failing length from ~50 to
-somewhere in (100, 200] — evidence the trigger is XLA's
-schedule/allocation at a given scan trip count, not any single op.
-Production code chunks launches at scamp_dense.LAUNCH_CAP=100 and is
-unaffected.
+Round-4 history (the trigger is XLA's schedule/allocation for the
+whole program, not any single op):
+  * restructuring the churn phase (one _spawn_walks instance per round
+    instead of two) moved the failing length from ~50 to (100, 200];
+  * with that mid-round-4 shape, the skip=("admit",) ablation variant
+    crashed the XLA:TPU COMPILER itself — SIGABRT in
+    TpuInstructionFusion::ShouldFuseInputIntoScatter,
+    "scatter_emitter.cc:2824 Check failed: operand_indices.size() == 1
+    (2 vs. 1)" — a second manifestation of the same fusion-machinery
+    fragility at this shape;
+  * the final round-4 shape (stamp-exact amortized stale-entry sweep
+    replacing the full-plane scrub) runs 500-round single launches
+    CLEAN, so this script may no longer reproduce the runtime fault
+    against current models/scamp_dense.py.  It is kept as the recipe
+    and record: if the fault reappears after a change, bisect with
+    make_dense_scamp_round's skip= parameter (phases: churn, admit,
+    inview) and scan length.  Production code chunks launches at
+    scamp_dense.LAUNCH_CAP=100 regardless.
 
 Run:  python scripts/repro_scamp_dense_fault.py [rounds=200 [log2_n=16]]
-Expect with rounds<=100: prints walkers + exits 0.
-Expect with rounds=200 on a v5e: JaxRuntimeError UNAVAILABLE crash.
 """
 import sys
 
