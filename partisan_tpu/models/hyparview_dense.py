@@ -145,6 +145,27 @@ def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
     return out[: n * c].reshape((n, c))
 
 
+def refuse_tpu_shape_bug(n_nodes: int, what: str,
+                         limit: int = 1 << 16) -> None:
+    """Loud gate for the XLA scatter/fusion bug family (ROADMAP 1d,
+    scripts/repro_scamp_dense_fault.py): the dense-SCAMP and
+    dense-plumtree programs reproducibly fault the v5e TPU worker
+    beyond ``limit`` nodes.  Keys on the process backend
+    (JAX_PLATFORMS=cpu runs are clean at any N and pass); set
+    PARTISAN_TPU_UNGATE=1 to bypass when re-validating against a newer
+    jaxlib."""
+    import os
+    if (n_nodes > limit and jax.default_backend() == "tpu"
+            and not os.environ.get("PARTISAN_TPU_UNGATE")):
+        raise NotImplementedError(
+            f"{what} at N={n_nodes} > {limit} faults the TPU worker "
+            f"(XLA scatter/fusion bug, ROADMAP 1d; "
+            f"scripts/repro_scamp_dense_fault.py).  Use the engine "
+            f"path, shard the node axis, run with JAX_PLATFORMS=cpu, "
+            f"or set PARTISAN_TPU_UNGATE=1 to re-validate on newer "
+            f"jaxlib.")
+
+
 def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
     """views[idx] with idx < 0 yielding an all-empty row."""
     n = views.shape[0]

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..config import Config
-from .hyparview_dense import DenseHvState, make_dense_round
+from .hyparview_dense import refuse_tpu_shape_bug, DenseHvState, make_dense_round
 
 
 @struct.dataclass
@@ -117,7 +117,14 @@ def run_pt_dense(hv: DenseHvState, pt: PtDense, n_rounds: int,
                  ) -> Tuple[DenseHvState, PtDense]:
     """Fused membership + broadcast scan: each round runs one dense
     HyParView round and one broadcast round over the updated views —
-    the Stacked(HyParView, Plumtree) composition at TPU scale."""
+    the Stacked(HyParView, Plumtree) composition at TPU scale.
+
+    N gate: at N = 2^20 this fused program faults the v5e TPU worker
+    (the XLA scatter/fusion bug family of ROADMAP 1d /
+    scripts/repro_scamp_dense_fault.py — the bare dense-HyParView scan
+    runs 2^20 CLEAN, so the trigger is in the added broadcast planes'
+    composition); loudly refuse rather than crash the chip."""
+    refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree")
     hv_step = make_dense_round(cfg, churn)
     pt_step = make_pt_dense_round(cfg, root=root, broadcast_interval=5)
 

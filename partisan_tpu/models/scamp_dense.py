@@ -59,7 +59,8 @@ from flax import struct
 
 from ..config import Config
 from ..ops import padded_set as ps
-from .hyparview_dense import _gather_rows, reverse_select
+from .hyparview_dense import (_gather_rows, refuse_tpu_shape_bug,
+                              reverse_select)
 from .scamp import default_view_cap
 
 
@@ -180,6 +181,10 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
     # silently reuse a stale program).  Production runs leave it empty.
     _dbg = frozenset(skip)
     N = cfg.n_nodes
+    # Loud gate: dense SCAMP faults the v5e worker at N = 2^20 even in
+    # the shape that runs 2^16 clean at any launch length — the XLA
+    # bug re-manifests at the larger shape (see LAUNCH_CAP's comment).
+    refuse_tpu_shape_bug(N, "dense SCAMP")
     P, C = walker_caps(cfg)
     ids = jnp.arange(N, dtype=jnp.int32)
 

@@ -153,8 +153,8 @@ def main() -> None:
         import statistics as _st
         from partisan_tpu.models.hyparview_dense import (
             connectivity, dense_init, run_dense)
-        on_tpu = jax.devices()[0].platform == "tpu"
-        sweep = [(1 << 12, 2000)] + ([(1 << 16, 500)] if on_tpu else [])
+        # (this block is TPU-gated above, so the sweep is unconditional)
+        sweep = [(1 << 12, 2000), (1 << 16, 500), (1 << 20, 200)]
         for n, rnds in sweep:
             if args.quick:
                 rnds = min(rnds, 200)

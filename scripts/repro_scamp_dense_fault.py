@@ -25,16 +25,25 @@ whole program, not any single op):
     fragility at this shape;
   * the final round-4 shape (stamp-exact amortized stale-entry sweep
     replacing the full-plane scrub) runs 500-round single launches
-    CLEAN, so this script may no longer reproduce the runtime fault
-    against current models/scamp_dense.py.  It is kept as the recipe
-    and record: if the fault reappears after a change, bisect with
-    make_dense_scamp_round's skip= parameter (phases: churn, admit,
-    inview) and scan length.  Production code chunks launches at
-    scamp_dense.LAUNCH_CAP=100 regardless.
+    CLEAN at N=2^16 — but the SAME program faults the worker at
+    N=2^20 on its first 100-round launch, so the bug tracks SHAPE as
+    well as program structure.  make_dense_scamp_round raises a loud
+    NotImplementedError for N > 2^16 on TPU devices.
 
-Run:  python scripts/repro_scamp_dense_fault.py [rounds=200 [log2_n=16]]
+This script remains the recipe and record: to reproduce, run it at
+log2_n=20; if a 2^16 regression appears after a change, bisect with
+make_dense_scamp_round's skip= parameter (phases: churn, admit,
+inview) and scan length.  Production code chunks launches at
+scamp_dense.LAUNCH_CAP=100 regardless.
+
+Run:  python scripts/repro_scamp_dense_fault.py [rounds=100 [log2_n=20]]
 """
+import os
 import sys
+
+# this script's PURPOSE is reproducing the fault — bypass the
+# production gate (hyparview_dense.refuse_tpu_shape_bug)
+os.environ["PARTISAN_TPU_UNGATE"] = "1"
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +53,8 @@ from partisan_tpu.config import Config
 from partisan_tpu.models.scamp_dense import (
     _run_dense_scamp_launch, dense_scamp_init)
 
-rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 200
-log2n = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+log2n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
 cfg = Config(n_nodes=1 << log2n, seed=7)
 print(f"device={jax.devices()[0]} n={cfg.n_nodes} rounds={rounds} "
       f"(single scan launch)", flush=True)
