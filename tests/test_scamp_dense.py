@@ -136,3 +136,54 @@ class TestDenseScamp:
         h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
         assert h["connected"], h
         assert int(jnp.sum(st.partial[7] >= 0)) >= 1
+
+
+class TestStampSweepContract:
+    def test_stale_entries_swept_fresh_entries_kept(self):
+        """The round-4 removal contract, pinned white-box: after a node
+        restarts, every OTHER row's entry naming it that was admitted
+        BEFORE the restart disappears within the sweep period
+        (ceil(W/8) rounds + slack), while entries re-admitted after the
+        restart carry fresh stamps and survive.  The restart is driven
+        externally (exactly the churn phase's clear + last_reset stamp)
+        so the test knows the reset round."""
+        from partisan_tpu.models.scamp_dense import make_dense_scamp_round
+        n, v = 128, 7
+        cfg = pt.Config(n_nodes=n, seed=5)
+        st = run_dense_scamp(dense_scamp_init(cfg), 200, cfg, 0.0)
+        held_before = int((np.asarray(st.partial) == v).sum())
+        assert held_before >= 1, "victim held nowhere; pick another seed"
+
+        r0 = int(st.rnd)
+        st = st.replace(
+            partial=st.partial.at[v].set(-1),
+            in_view=st.in_view.at[v].set(-1),
+            walk_pos=st.walk_pos.at[v].set(-1),
+            walk_age=st.walk_age.at[v].set(0),
+            pstamp=st.pstamp.at[v].set(r0),
+            ivstamp=st.ivstamp.at[v].set(r0),
+            last_reset=st.last_reset.at[v].set(r0))
+
+        p, _ = walker_caps(cfg)
+        sweep_rounds = (2 * p + 7) // 8 + 4       # W = 2P, K = 8, slack
+        step = make_dense_scamp_round(cfg, 0.0)
+        for _ in range(sweep_rounds):
+            st = step(st)
+
+        pv = np.asarray(st.partial)
+        stamps = np.asarray(st.pstamp)
+        holders, slots = np.nonzero(pv == v)
+        # every surviving entry naming v is a fresh post-restart
+        # re-admission — no pre-restart stamp survives the sweep
+        for h, s in zip(holders, slots):
+            assert stamps[h, s] >= r0, (
+                f"stale entry for {v} at holder {h} (stamp "
+                f"{stamps[h, s]} < restart {r0}) survived the sweep")
+        # and the victim rejoined through the isolation path
+        assert int(np.sum(np.asarray(st.partial[v]) >= 0)) >= 1
+        # same contract on the in_view plane
+        iv = np.asarray(st.in_view)
+        ivs = np.asarray(st.ivstamp)
+        rows, slots = np.nonzero(iv == v)
+        for r_, s_ in zip(rows, slots):
+            assert ivs[r_, s_] >= r0
