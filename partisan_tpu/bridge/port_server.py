@@ -597,13 +597,30 @@ def serve(stdin: BinaryIO, stdout: BinaryIO) -> None:
         payload = etf.read_frame(stdin)
         if not payload:
             return
-        term = etf.decode(payload)
+        # a malformed frame (corrupt term, bad version byte, truncated
+        # payload) must take down ONE request, not the whole world —
+        # the analog of the reference dropping one bad connection
+        # rather than the node (partisan_peer_service_server's
+        # per-connection error handling)
+        try:
+            term = etf.decode(payload)
+        except Exception:  # noqa: BLE001 — any decode failure is badarg
+            traceback.print_exc(file=sys.stderr)
+            stdout.write(etf.frame(etf.encode(
+                (Atom("error"), Atom("bad_frame")))))
+            stdout.flush()
+            continue
         reply = session.handle(term)
         if reply is None:  # stop
             stdout.write(etf.frame(etf.encode(Atom("ok"))))
             stdout.flush()
             return
-        stdout.write(etf.frame(etf.encode(reply)))
+        try:
+            out = etf.encode(reply)
+        except Exception:  # noqa: BLE001 — unencodable reply = server bug,
+            traceback.print_exc(file=sys.stderr)   # but still don't die
+            out = etf.encode((Atom("error"), Atom("unencodable_reply")))
+        stdout.write(etf.frame(out))
         stdout.flush()
 
 

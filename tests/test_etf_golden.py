@@ -278,3 +278,62 @@ class TestDifferentialFuzz:
             return {self._normalize(k): self._normalize(v)
                     for k, v in t.items()}
         return t
+
+
+class TestMalformedFrames:
+    """The port must survive garbage: a corrupt term from the BEAM side
+    takes down one request, never the bridge (the reference drops the
+    one bad connection, not the node)."""
+
+    def test_server_survives_malformed_frames(self):
+        import io
+        from partisan_tpu.bridge import etf as etf_mod
+        from partisan_tpu.bridge.port_server import serve
+
+        bad_frames = [
+            b"\x00",                        # not ETF at all (bad version)
+            bytes([131, 104]),              # truncated SMALL_TUPLE header
+            bytes([131, 109, 0, 0, 0, 99, 1, 2]),  # binary len > payload
+            bytes([131, 97]),               # truncated SMALL_INT
+            bytes([131, 118, 255, 255]),    # huge atom length, no bytes
+        ]
+        buf = io.BytesIO()
+        for f in bad_frames:
+            buf.write(etf_mod.frame(f))
+        # a real command after the garbage must still be served
+        buf.write(etf_mod.frame(etf_mod.encode(etf_mod.Atom("health"))))
+        buf.write(etf_mod.frame(etf_mod.encode(etf_mod.Atom("stop"))))
+        buf.seek(0)
+        out = io.BytesIO()
+        serve(buf, out)                     # must not raise
+        out.seek(0)
+        replies = []
+        while True:
+            fr = etf_mod.read_frame(out)
+            if not fr:
+                break
+            replies.append(etf_mod.decode(fr))
+        assert len(replies) == len(bad_frames) + 2
+        for r in replies[: len(bad_frames)]:
+            assert r == (etf_mod.Atom("error"), etf_mod.Atom("bad_frame")), r
+        assert replies[-1] == etf_mod.Atom("ok")   # clean stop
+
+    def test_decoder_rejects_garbage_without_hanging(self):
+        """Randomized corrupt inputs raise promptly — no hangs, no
+        silent wrong terms accepted past the version byte check."""
+        import random
+        from partisan_tpu.bridge.etf import decode, encode, Atom
+        rng = random.Random(0xBAD)
+        good = encode((Atom("forward"), 1, [2, 3], b"xy"))
+        for case in range(500):
+            b = bytearray(good)
+            n_flips = rng.randint(1, 4)
+            for _ in range(n_flips):
+                i = rng.randrange(len(b))
+                b[i] ^= 1 << rng.randrange(8)
+            trunc = bytes(b[: rng.randint(0, len(b))]) \
+                if rng.random() < 0.3 else bytes(b)
+            try:
+                decode(trunc)   # may succeed (benign flip) or raise —
+            except Exception:   # either way it must RETURN promptly
+                pass
