@@ -233,7 +233,26 @@ def frame(payload: bytes) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
 
-def read_frame(stream) -> bytes:
+# Largest frame the bridge will accept.  The reference's socket layer has
+# the same implicit bound (gen_tcp {packet, 4} caps at 2 GiB; real partisan
+# messages are far smaller).  256 MiB clears the biggest legitimate payload
+# (echo_mb's 8 MB word arrays ETF-encode well under 64 MiB) while a
+# corrupted length prefix — which would otherwise make read_frame try to
+# allocate up to 4 GiB and block on a read that never completes — fails
+# fast as FrameTooLarge (ADVICE r4: the malformed-frame hardening must
+# cover the FRAMING read, not only the term decode).
+MAX_FRAME_LEN = 256 * (1 << 20)
+
+
+class FrameTooLarge(ValueError):
+    """Length prefix exceeds MAX_FRAME_LEN — treat as a malformed frame.
+
+    After a bad prefix the stream is desynchronized (the next 'frame
+    header' would be arbitrary payload bytes), so callers should close
+    the session rather than resynchronize."""
+
+
+def read_frame(stream, max_len: int = MAX_FRAME_LEN) -> bytes:
     """Blocking read of one 4-byte-length frame; b'' on clean EOF."""
     hdr = stream.read(4)
     if not hdr:
@@ -241,6 +260,8 @@ def read_frame(stream) -> bytes:
     if len(hdr) < 4:
         raise EOFError("truncated frame header")
     (n,) = struct.unpack(">I", hdr)
+    if n > max_len:
+        raise FrameTooLarge(f"frame length {n} exceeds cap {max_len}")
     payload = b""
     while len(payload) < n:
         chunk = stream.read(n - len(payload))

@@ -594,7 +594,19 @@ def _to_term(v) -> Any:
 def serve(stdin: BinaryIO, stdout: BinaryIO) -> None:
     session = Session()
     while True:
-        payload = etf.read_frame(stdin)
+        # a corrupted length prefix (FrameTooLarge) or a peer dying
+        # mid-frame (EOFError) leaves the stream desynchronized — there
+        # is no frame boundary to resume from, so reply bad_frame and
+        # CLOSE the session explicitly instead of blocking on a
+        # gigabyte-long phantom payload (ADVICE r4)
+        try:
+            payload = etf.read_frame(stdin)
+        except (etf.FrameTooLarge, EOFError):
+            traceback.print_exc(file=sys.stderr)
+            stdout.write(etf.frame(etf.encode(
+                (Atom("error"), Atom("bad_frame")))))
+            stdout.flush()
+            return
         if not payload:
             return
         # a malformed frame (corrupt term, bad version byte, truncated

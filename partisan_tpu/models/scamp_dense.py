@@ -356,6 +356,16 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
           for j in range(4):
               e_j = back[:, j]
               holder_j = jnp.where(e_j >= 0, e_j // 4, -1)
+              # mirror the partial plane's re-proposal stamp refresh
+              # (ADVICE r4): if the holder is ALREADY in the subject's
+              # in-view (stale entry from before the holder's restart,
+              # not yet swept), the insert below is a no-op and the old
+              # ivstamp would let the sweep delete a live subscription —
+              # a post-restart re-admission must supersede the pending
+              # sweep on BOTH planes
+              iv_dup = (in_view == holder_j[:, None]) \
+                  & (holder_j >= 0)[:, None]
+              ivstamp = jnp.where(iv_dup, st.rnd, ivstamp)
               prev = in_view
               in_view, _, _ = jax.vmap(ps.insert_evict, in_axes=(0, 0, None))(
                   in_view, holder_j, None)

@@ -66,21 +66,28 @@ def infer_causality(cfg: Config, proto: ProtocolBase,
     evolution — pass the workload's cluster-join setup so periodic sends
     that need a populated membership actually fire.  The
     ``__background__`` classification is relative to this state and
-    errs toward soundness in both directions: an unpopulated state
-    under-fills it (types misread as state-gated are merely never
-    pruned against — an efficiency cost), and a state evolved into a
-    timer gate cannot over-fill it because background requires
-    cluster-wide prevalence, not presence (see the 50% rule below)."""
+    errs toward soundness: background requires BOTH cluster-wide
+    prevalence (the 50% rule — presence of one gate-satisfying row is
+    not enough) AND delivery-insensitivity (no observed-wire message
+    delivered to a row may change whether the send fires — ADVICE r4;
+    see the probe pool below).  Types failing either test are merely
+    never pruned against — an efficiency cost, not a soundness one."""
     key = jax.random.PRNGKey(seed)
     state = proto.init(cfg, key)
+    # full-payload snapshots of the in-flight message buffer, one per
+    # evolution round — the OBSERVED-wire pool the delivery-sensitivity
+    # probes below draw from
+    obs_msgs = []
     if rounds_of_state:
         from ..engine import init_world, make_step
         w = init_world(cfg, proto)
         if setup is not None:
             w = setup(w)
+        obs_msgs.append(jax.tree_util.tree_map(np.asarray, w.msgs))
         step = make_step(cfg, proto, donate=False)
         for _ in range(rounds_of_state):
             w, _ = step(w)
+            obs_msgs.append(jax.tree_util.tree_map(np.asarray, w.msgs))
         state = w.state
 
     n = cfg.n_nodes
@@ -157,6 +164,61 @@ def infer_causality(cfg: Config, proto: ProtocolBase,
     )(me, rows, brnds, tkeys)
     tvalid = np.asarray(tems.valid).reshape(me.shape[0], -1)
     ttyps = np.asarray(tems.typ).reshape(me.shape[0], -1)
+    # the delivery-sensitivity cross-check (ADVICE r4): the pruning
+    # question __background__ answers is "can dropping/reordering OTHER
+    # messages ever change whether this timer send fires?".  Probe it
+    # directly — deliver ONE message of each type to every grid row
+    # (same node/round/tick-key), re-run the tick, and compare firing.
+    # A send whose gate a delivery can flip (a timeout cleared by the
+    # decision arriving, a suspicion cleared by an ack) flips on some
+    # probe and is excluded; a send no delivery can touch is genuinely
+    # schedule-independent, which is exactly what makes pruning against
+    # it sound.  Works for ANY gate type — int thresholds, single
+    # bools, conjunctions — unlike rate-over-random-states heuristics
+    # (randomize_row's biased bool draws defeat any fixed threshold).
+    #
+    # Probes are drawn from the OBSERVED-wire pool of the evolution,
+    # not white-noise: pruning soundness is relative to the deliveries
+    # a schedule can actually produce, and random payloads
+    # over-approximate into unreachable transitions (a random OR-set
+    # digest erases a healthy membership, which no real gossip does —
+    # measured: such probes flip 2/3 of gossip's firing points).  A
+    # type never observed on the wire cannot be rescheduled by the
+    # checker, so it contributes no probes.  Residual approximation:
+    # gates only a multi-delivery SEQUENCE can flip, or payloads from
+    # rounds beyond the evolution window, can slip through; the golden
+    # cross-walk (tests/test_prop_analysis.py::TestGoldenCrosswalk)
+    # checks the net classification against the reference's
+    # hand-checked files.  With rounds_of_state=0 there is no pool and
+    # classification falls back to the prevalence rule alone.
+    mut_obs = []
+    if obs_msgs:
+        leaves0, mdef = jax.tree_util.tree_flatten(obs_msgs[0])
+        cat = [np.concatenate(
+            [jax.tree_util.tree_flatten(o)[0][i] for o in obs_msgs],
+            axis=0) for i in range(len(leaves0))]
+        pool = jax.tree_util.tree_unflatten(mdef, cat)
+        pv = np.asarray(pool.valid)
+        ptyp = np.asarray(pool.typ)
+        rng_np = np.random.default_rng(seed ^ 0x5EED)
+        for tprime in range(len(proto.msg_types)):
+            sel = np.nonzero(pv & (ptyp == tprime))[0]
+            if sel.size == 0:
+                continue
+            idx = rng_np.choice(sel, size=me.shape[0], replace=True)
+            mm = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(np.asarray(x)[idx]), pool)
+
+            def deliver_then_tick(i, r, mi, rnd, k, _h=handlers[tprime]):
+                r2, _ = _h(cfg, i, r, mi, jax.random.fold_in(k, 55))
+                _, em = proto.tick(cfg, i, r2, rnd, k)
+                return em
+
+            ems_m = jax.vmap(deliver_then_tick)(me, rows, mm, brnds,
+                                                tkeys)
+            mut_obs.append(
+                (np.asarray(ems_m.typ).reshape(me.shape[0], -1),
+                 np.asarray(ems_m.valid).reshape(me.shape[0], -1)))
     # PREVALENCE rule: background = the cluster fires it ON SCHEDULE —
     # >=50% of sampled rows emit the type at its best probe round.  Mere
     # presence is not enough: a single row evolved into a timeout gate
@@ -164,12 +226,28 @@ def infer_causality(cfg: Config, proto: ProtocolBase,
     # must NOT be classed background, or the checker would prune
     # against a state-gated send and lose real counterexamples.
     # Misclassifying the other way (a phase-offset periodic send under
-    # 50%) only costs pruning efficiency.
+    # 50%, or a state-insensitive send the fuzz check can't certify)
+    # only costs pruning efficiency.
     background = set()
     for t in np.unique(ttyps[tvalid]):
         emits = ((ttyps == t) & tvalid).any(axis=-1)     # [8 * n_bg]
         frac = emits.reshape(8, n_bg).mean(axis=1)       # per probe round
-        if float(frac.max()) >= 0.5:
+        if float(frac.max()) < 0.5:
+            continue
+        # background ALSO requires delivery-insensitivity (ADVICE r4):
+        # firing must be unchanged when any single random delivery
+        # mutates the row first.  A state-gated timer send a majority
+        # of the EVOLVED rows happen to satisfy (all participants past
+        # a shared timeout) is unmasked by the delivery that clears its
+        # gate; a send whose firing no delivery can change is safe to
+        # prune against by construction.
+        sensitive = False
+        for mtyps, mvalid in mut_obs:
+            memits = ((mtyps == t) & mvalid).any(axis=-1)
+            if bool(np.any(memits != emits)):
+                sensitive = True
+                break
+        if not sensitive:
             background.add(proto.msg_types[int(t)])
 
     # 4x the per-handler sample count: gated timer predicates are

@@ -318,6 +318,40 @@ class TestMalformedFrames:
             assert r == (etf_mod.Atom("error"), etf_mod.Atom("bad_frame")), r
         assert replies[-1] == etf_mod.Atom("ok")   # clean stop
 
+    def test_server_survives_corrupt_length_prefix(self):
+        """ADVICE r4: the hardening must cover the FRAMING read too — a
+        corrupted 4-byte length prefix must not make the bridge try to
+        read (or allocate) gigabytes; it replies bad_frame and closes
+        the now-desynchronized session instead of blocking forever."""
+        import io
+        import struct as _struct
+        from partisan_tpu.bridge import etf as etf_mod
+        from partisan_tpu.bridge.port_server import serve
+
+        buf = io.BytesIO()
+        # length prefix claims ~4 GiB with 3 bytes of payload behind it
+        buf.write(_struct.pack(">I", 0xFFFFFFF0) + b"\x83\x61\x01")
+        buf.seek(0)
+        out = io.BytesIO()
+        serve(buf, out)                     # must not raise or hang
+        out.seek(0)
+        reply = etf_mod.decode(etf_mod.read_frame(out))
+        assert reply == (etf_mod.Atom("error"), etf_mod.Atom("bad_frame"))
+        assert not etf_mod.read_frame(out)  # session closed after reply
+
+    def test_read_frame_rejects_oversized_length(self):
+        import io
+        import struct as _struct
+        import pytest as _pytest
+        from partisan_tpu.bridge import etf as etf_mod
+
+        s = io.BytesIO(_struct.pack(">I", etf_mod.MAX_FRAME_LEN + 1))
+        with _pytest.raises(etf_mod.FrameTooLarge):
+            etf_mod.read_frame(s)
+        # at the cap is still allowed (header check only; body EOF here)
+        s2 = io.BytesIO(_struct.pack(">I", 8) + b"12345678")
+        assert etf_mod.read_frame(s2) == b"12345678"
+
     def test_decoder_rejects_garbage_without_hanging(self):
         """Randomized corrupt inputs raise promptly — no hangs, no
         silent wrong terms accepted past the version byte check."""

@@ -187,6 +187,76 @@ class TestAnalysis:
         assert "alarm" not in c["__background__"], c
         assert "alarm" in c["__tick__"], c
 
+    def test_background_rejects_cluster_wide_state_gate(self):
+        """ADVICE r4: prevalence alone is not enough — a state-gated
+        timer send must stay out of __background__ even when the evolved
+        state satisfies its gate on EVERY row (all participants past a
+        shared timeout / all suspecting).  The delivery-sensitivity
+        cross-check catches it: delivering the message that CLEARS the
+        gate (the decision arriving) flips whether the send fires, so
+        pruning against it would be unsound.  This includes single-BOOL
+        gates, which rate-over-random-states heuristics misread
+        (randomize_row biases bools toward True)."""
+        import jax.numpy as jnp
+        from flax import struct
+        from partisan_tpu.engine import ProtocolBase
+
+        @struct.dataclass
+        class _S:
+            timer: object
+            suspecting: object
+
+        class SharedTimeout(ProtocolBase):
+            msg_types = ("beat", "decision", "decision_request")
+
+            def __init__(self, cfg):
+                self.cfg = cfg
+                self.data_spec = {}
+                self.emit_cap = 1
+                self.tick_emit_cap = 2
+
+            def init(self, cfg, key):
+                n = cfg.n_nodes
+                return _S(timer=jnp.zeros((n,), jnp.int32),
+                          suspecting=jnp.ones((n,), bool))
+
+            def handle_beat(self, cfg, me, row, m, key):
+                return row, self.no_emit()
+
+            def handle_decision(self, cfg, me, row, m, key):
+                # the decision clears the timeout gate — the delivery
+                # the unsound pruning would have dropped
+                return row.replace(
+                    timer=jnp.zeros_like(row.timer),
+                    suspecting=jnp.zeros_like(row.suspecting)), \
+                    self.no_emit()
+
+            def handle_decision_request(self, cfg, me, row, m, key):
+                # a peer answers with the decision — which puts
+                # `decision` on the observed wire, where the
+                # sensitivity probe pool picks it up
+                return row, self.emit(m.src[None], self.typ("decision"))
+
+            def tick(self, cfg, me, row, rnd, key):
+                nxt = (me + 1) % cfg.n_nodes
+                # EVERY evolved row passes the gate after 8 rounds of
+                # timer ticks — cluster-wide prevalence, the exact
+                # shape the 50% rule alone cannot catch
+                gate = (row.timer >= 7) & row.suspecting
+                em = self.merge(
+                    self.emit(nxt[None], self.typ("beat")),
+                    self.emit(jnp.where(gate, nxt, -1)[None],
+                              self.typ("decision_request")),
+                    cap=self.tick_emit_cap)
+                return row.replace(timer=row.timer + 1), em
+
+        cfg = pt.Config(n_nodes=8, inbox_cap=8)
+        c = analysis.infer_causality(cfg, SharedTimeout(cfg), samples=64,
+                                     rounds_of_state=9)
+        assert "beat" in c["__background__"], c
+        assert "decision_request" not in c["__background__"], c
+        assert "decision_request" in c["__tick__"], c
+
     def test_roundtrip_and_reachability(self, tmp_path):
         cfg = pt.Config(n_nodes=4, inbox_cap=8)
         proto = TwoPhaseCommit(cfg)

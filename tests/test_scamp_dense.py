@@ -187,3 +187,56 @@ class TestStampSweepContract:
         rows, slots = np.nonzero(iv == v)
         for r_, s_ in zip(rows, slots):
             assert ivs[r_, s_] >= r0
+
+    def test_readmission_refreshes_in_view_stamp(self):
+        """ADVICE r4 pin: a restarted HOLDER re-admits a subject whose
+        stale in_view entry for that holder is still unswept.  The
+        keep-notification's insert is a no-op (holder already present),
+        so without the iv_dup stamp refresh the stale ivstamp survives
+        and the sweep deletes the record of a LIVE subscription.  Built
+        surgically: holder h restarted at r0=50, subject s still carries
+        in_view entry h stamped 10 < r0, and s has one walker standing
+        at h whose keep-coin is deterministic (partial[h] empty =>
+        p_keep = 1) — the admit + notification fire in round 60, before
+        the sweep's rotating window reaches the stale column."""
+        import jax.numpy as jnp
+        from partisan_tpu.models.scamp_dense import (
+            DenseScampState, make_dense_scamp_round)
+        n = 64
+        cfg = pt.Config(n_nodes=n, seed=3)
+        p, c = walker_caps(cfg)
+        h, s, x = 3, 7, 11
+        partial = jnp.full((n, p), -1, jnp.int32).at[s, 0].set(x)
+        in_view = jnp.full((n, p), -1, jnp.int32).at[s, 0].set(h)
+        walk_pos = jnp.full((n, c), -1, jnp.int32)
+        walk_pos = walk_pos.at[s, 0].set(h)   # s's walker, standing at h
+        walk_pos = walk_pos.at[h, 0].set(s)   # keeps h off the lonely path
+        r0, rnd0 = 50, 60
+        st = DenseScampState(
+            partial=partial, in_view=in_view, walk_pos=walk_pos,
+            walk_age=jnp.zeros((n, c), jnp.int32),
+            alive=jnp.ones((n,), bool),
+            insert_dropped=jnp.zeros((n,), jnp.int32),
+            walk_expired=jnp.zeros((n,), jnp.int32),
+            walk_truncated=jnp.zeros((n,), jnp.int32),
+            in_view_dropped=jnp.zeros((n,), jnp.int32),
+            last_reset=jnp.full((n,), -1000000, jnp.int32).at[h].set(r0),
+            pstamp=jnp.full((n, p), rnd0, jnp.int32),
+            ivstamp=jnp.full((n, p), rnd0, jnp.int32).at[s, 0].set(10),
+            rnd=jnp.int32(rnd0),
+        )
+        step = make_dense_scamp_round(cfg, 0.0)
+        st = step(st)
+        # premise check: the re-admission landed (walker kept at the
+        # empty-view holder with probability 1)
+        assert s in np.asarray(st.partial[h]), np.asarray(st.partial[h])
+        # run past a full sweep period: the refreshed stamp must keep
+        # the live subscription's in_view record alive
+        sweep_rounds = (2 * p + 7) // 8 + 4
+        for _ in range(sweep_rounds):
+            st = step(st)
+        iv_s = np.asarray(st.in_view[s])
+        assert h in iv_s, (
+            f"live re-admitted subscription swept from in_view: {iv_s}")
+        slot = int(np.nonzero(iv_s == h)[0][0])
+        assert int(np.asarray(st.ivstamp[s, slot])) >= r0
