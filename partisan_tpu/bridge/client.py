@@ -18,6 +18,15 @@ class PortClient:
     def __init__(self, env: Optional[dict] = None):
         e = dict(os.environ)
         e.setdefault("JAX_PLATFORMS", "cpu")
+        # hand the subprocess the same persistent XLA compile cache the
+        # test harness uses (tests/conftest.py): the port path spawns a
+        # fresh interpreter per session, and without the cache every
+        # session recompiles its step programs from scratch — the
+        # dominant cost of the port CT rows (107-117 s/row, VERDICT r4
+        # weak #5).  port_server.main applies it via jax.config.
+        e.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache"))
         e.update(env or {})
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "partisan_tpu.bridge.port_server"],

@@ -645,6 +645,17 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    # persistent XLA compile cache (same story as jax_platforms above:
+    # the TPU plugin's early config registration means env vars alone
+    # are not reliably honored, so apply explicitly).  PortClient
+    # defaults this to the repo's .jax_cache; honoring it here is what
+    # stops every port session recompiling identical step programs.
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 2.0)
     serve(sys.stdin.buffer, sys.stdout.buffer)
 
 

@@ -61,6 +61,18 @@ class Config:
     #   which yields the (c+1)·ln N view-size fixed point).  False: the
     #   reference's shape — the *joiner* fans over its own (trivial) view
     #   (v1 :51-100, v2 :64-117), so every join injects only ~3 walks.
+    scamp_walker_slots: int = 8
+    # ^ C: per-subject concurrent walk-copy slots in the DENSE SCAMP
+    #   re-layout (models/scamp_dense.walker_caps).  The walker plane's two
+    #   reverse_select sorts run over N·C slots, so C trades join fan-out
+    #   fidelity for throughput: 8 (default) truncates a typical join fan
+    #   (mean view ~4 + scamp_c extras, counted in walk_truncated) and
+    #   runs ~55-60% faster on chip than 16, with views settling thinner
+    #   (mean 3.6-3.8 vs 4.3-5.6 at 2^16) but weak connectivity unchanged
+    #   (99.59% vs 99.6% reached, results.csv round 4).  Raise back toward
+    #   16 when a workload needs the fatter-view equilibrium more than the
+    #   throughput; tests/test_scamp_dense.py's engine-matched parity band
+    #   red-lines below ~6.
 
     # --- plumtree (partisan.hrl:58-59, plumtree_broadcast.erl) --------------
     lazy_tick_period: int = 1          # 1 s

@@ -92,21 +92,23 @@ def walker_caps(cfg: Config) -> Tuple[int, int]:
     member + c extras, v2 :64-117) truncates to C with the excess
     counted (walk_truncated).
 
-    C = 8 (round 4; was 16): the walker plane's two reverse_select
-    sorts run over N·C slots, and steady-state occupancy is under ONE
-    walker per node (2^16 soak: ~60k active of 1M slots at C=16) — so
-    halving C bought +55-60% rounds/s on the chip (results.csv:
-    scamp_dense_65536 17.8 -> 27.5, scamp_dense_4096 298 -> 475).
-    The trade is explicit: a typical join fan is mean view ~4 +
-    scamp_c extras, which EXCEEDS 8, so truncation is a routine
-    per-join cut (counted, walk_truncated), not a rare burst — the
-    official rows show weak connectivity essentially unchanged
-    (99.59% vs 99.6% reached at 2^16; 4093/4096 at 4096) with views
-    settling thinner (mean 3.6-3.8 vs 4.3-5.6), still inside the
-    engine path's distributional band asserted by
-    tests/test_scamp_dense.py.  Raise C back toward 16 if a workload
-    needs the fatter-view equilibrium more than the throughput."""
-    return default_view_cap(cfg.n_nodes, cfg.scamp_c), 8
+    C comes from ``cfg.scamp_walker_slots`` (default 8; round 4 dropped
+    it from 16): the walker plane's two reverse_select sorts run over
+    N·C slots, and steady-state occupancy is under ONE walker per node
+    (2^16 soak: ~60k active of 1M slots at C=16) — so halving C bought
+    +55-60% rounds/s on the chip (results.csv: scamp_dense_65536
+    17.8 -> 27.5, scamp_dense_4096 298 -> 475).  The trade is explicit:
+    a typical join fan is mean view ~4 + scamp_c extras, which EXCEEDS
+    8, so truncation is a routine per-join cut (counted,
+    walk_truncated), not a rare burst — the official rows show weak
+    connectivity essentially unchanged (99.59% vs 99.6% reached at
+    2^16; 4093/4096 at 4096) with views settling thinner (mean 3.6-3.8
+    vs 4.3-5.6), inside the engine-matched parity band asserted by
+    tests/test_scamp_dense.py (which red-lines below C ~6).  Raise C
+    back toward 16 if a workload needs the fatter-view equilibrium
+    more than the throughput."""
+    return default_view_cap(cfg.n_nodes, cfg.scamp_c), \
+        cfg.scamp_walker_slots
 
 
 def dense_scamp_init(cfg: Config) -> DenseScampState:

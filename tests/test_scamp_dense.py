@@ -22,21 +22,50 @@ def _settled(n, rounds=300, churn=0.01, settle=60, seed=3):
 class TestDenseScamp:
     @pytest.mark.standard
     def test_overlay_connects_and_sizes_match_engine_regime(self):
-        """Weak connectivity + view sizes in the engine path's measured
-        regime (engine ScampV2 N=1024: mean ~2.5, tests/test_scamp.py
-        asserts >= 2.0): the same protocol dynamics must land the same
-        equilibrium, not the paper's (c+1)·ln N (which needs lease
-        renewal neither implementation has)."""
-        _, st = _settled(256)
-        h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
-        # equilibrium, not perfection: SCAMP under restart churn with no
-        # lease renewal occasionally leaves a tiny absorbing island (a
-        # saturated 2-node clique) — the chip rows show the same
-        # (scamp_dense_4096: reached=4087/4096, results.csv), and which
-        # seeds produce one is RNG-stream-sensitive.  The distributional
-        # bar is near-total weak connectivity.
-        assert h["reached"] >= 0.97 * h["live"], h
-        assert 1.5 <= h["mean_view"] <= 12.0, h
+        """Engine-anchored distributional parity (VERDICT r4 #4; the old
+        1.5..12.0 band was wide enough to hide a 25% view thinning).
+        The anchor is a LIVE matched-N engine-path run (ScampV2, N=256,
+        the test_scamp.py harness), and the band is asymmetric because
+        the two paths' loss mechanisms differ in direction: the engine
+        loses subscription walks to inbox caps during join storms, the
+        dense path's only thinning force is the counted walker-slot
+        truncation — so a correctly-sized dense equilibrium sits AT or
+        ABOVE the engine's, never below, and within 2x (calibrated
+        2026-08-01: engine mean 2.87; dense C=8 4.0-4.1, C=6 3.1-3.4,
+        C=4 2.69-2.71 => scamp_walker_slots=4 red-lines the lower
+        bound, the C=16 regime stays inside the upper)."""
+        from partisan_tpu import peer_service
+        from partisan_tpu.models.scamp import ScampV2
+        n = 256
+        ecfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=5)
+        proto = ScampV2(ecfg)
+        world = pt.init_world(ecfg, proto)
+        estep = pt.make_step(ecfg, proto, donate=False)
+        world = peer_service.cluster(
+            world, proto, [(i, 0) for i in range(1, n)], stagger=8)
+        for _ in range(220):
+            world, _ = estep(world)
+        pv = np.asarray(world.state.partial)
+        engine_mean = float((pv >= 0).sum(axis=1).mean())
+
+        means, unreached = [], []
+        for seed in (3, 11):
+            _, st = _settled(256, seed=seed)
+            h = {k: float(np.asarray(v))
+                 for k, v in scamp_health(st).items()}
+            means.append(h["mean_view"])
+            unreached.append(1.0 - h["reached"] / h["live"])
+        dense_mean = float(np.mean(means))
+        # the unreached fraction is asserted EXPLICITLY (it was folded
+        # into a 3% connectivity slack before): per-seed <= 1.5% (one
+        # absorbing 2-node island at N=256 is 0.8%), mean <= 1%
+        assert max(unreached) <= 0.015, (unreached, means)
+        assert float(np.mean(unreached)) <= 0.01, (unreached, means)
+        assert engine_mean <= dense_mean <= 2.0 * engine_mean, (
+            f"dense mean_view {dense_mean:.2f} outside the "
+            f"engine-anchored band [{engine_mean:.2f}, "
+            f"{2 * engine_mean:.2f}] — walker C "
+            f"(config.scamp_walker_slots) mis-sized?")
 
     def test_subscriptions_spread_beyond_contacts(self):
         """Walk keeps must land subscriptions at nodes OTHER than the
