@@ -63,6 +63,65 @@ def place_world(world: World, mesh: Mesh) -> World:
     return jax.tree_util.tree_map(put, world)
 
 
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2,
+                "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
+                "f64": 8, "s64": 8, "u64": 8}
+
+
+def collective_stats(compiled: Any) -> dict:
+    """Parse a compiled (SPMD-partitioned) executable's HLO for its
+    cross-device collectives — the hardware-free multi-chip perf proxy
+    (VERDICT r4 #7): on a real slice these are the ICI transfers, so
+    their count and byte volume are the per-round communication cost.
+
+    Returns ``{"counts": {op: n}, "all_gather_outputs": [(shape_str,
+    elements, bytes)], "all_gather_total_bytes": int}``.  Byte figures
+    are whole-array (the per-device wire cost is that times
+    (devices-1)/devices for a ring all-gather).
+
+    Handles the partitioner's variadic/combined form (tuple result
+    shapes) and the async split (``all-gather-start``; the matching
+    ``-done`` is not double-counted).  For async/tuple forms every
+    shape token in the result is accounted, which can include operand
+    aliases — a slight OVERcount, i.e. conservative for the cap tests
+    built on top.  Raises if an all-gather was counted but no result
+    shape could be parsed (parser drift must fail loudly, not let the
+    quality gate pass vacuously)."""
+    import re
+    txt = compiled.as_text()
+    counts = {op: 0 for op in (
+        "all-gather", "collective-permute", "reduce-scatter",
+        "all-reduce", "all-to-all")}
+    ag = []
+    line_re = re.compile(
+        r"= (.*?) (all-gather|collective-permute|reduce-scatter|"
+        r"all-reduce|all-to-all)(-start)?\(")
+    for line in txt.splitlines():
+        m = line_re.search(line)
+        if not m:
+            continue
+        res, op = m.group(1), m.group(2)
+        counts[op] += 1
+        if op != "all-gather":
+            continue
+        for sm in re.finditer(r"(\w+)\[([\d,]*)\]", res):
+            dt, dims = sm.group(1), sm.group(2)
+            if dt not in _DTYPE_BYTES:
+                continue
+            shape = [int(d) for d in dims.split(",")] if dims else []
+            elems = int(np.prod(shape)) if shape else 1
+            ag.append((f"{dt}[{dims}]", elems,
+                       elems * _DTYPE_BYTES[dt]))
+    if counts["all-gather"] > 0 and not ag:
+        raise ValueError(
+            "collective_stats: all-gather instructions present but no "
+            "result shapes parsed — HLO text format drifted; fix the "
+            "parser before trusting the comms quality gate")
+    return {"counts": counts,
+            "all_gather_outputs": ag,
+            "all_gather_total_bytes": sum(b for _, _, b in ag)}
+
+
 def constrain(tree: Any, mesh: Mesh) -> Any:
     """with_sharding_constraint over a pytree — used inside jitted steps to
     pin intermediate layouts when XLA's propagation needs a hint."""
