@@ -176,9 +176,23 @@ def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
 def make_dense_round(cfg: Config, churn: float = 0.0,
                      skip: frozenset = frozenset(),
                      faults: bool = False,
-                     interpose=None):
+                     interpose=None,
+                     phase_window: int = 1,
+                     shuffle_window: Optional[int] = None):
     """Compile one dense round: ``state -> state``.  Deterministic from
     (cfg.seed, state.rnd) like the engine's rounds.
+
+    ``phase_window=k`` > 1 is the HEAVY half of the phase-staggered
+    cadence (run_dense_staggered): the promotion and shuffle due-masks
+    widen to cover every node whose nominal due round falls in
+    [rnd, rnd+k), so a heavy round run every k-th round batches exactly
+    the actions the every-round program would have spread over the
+    window — per-node cadence is preserved (each node still acts once
+    per interval, on the heavy grid), only the action's round is
+    quantized.  That quantization is the reference's own shape: its
+    shuffle and promotion run on 10 s / 5 s timers against 1 s delivery
+    (partisan_hyparview_peer_service_manager.erl:27-28), so maintenance
+    actions never align with delivery rounds there either.
 
     ``skip`` names phases to OMIT from the program entirely —
     {"repair", "promotion", "shuffle", "merge"} — the surface
@@ -273,6 +287,14 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
             jax.random.PRNGKey(cfg.seed ^ 0xDE45E), state.rnd)
         active, passive, alive = state.active, state.passive, state.alive
 
+        def alive_at(idx):
+            """alive[idx] via a [N, 1] ROW gather: a scalar-index
+            gather from an [N] vector lowers ~6x slower on TPU than a
+            row gather of the same indices (scripts/profile_ops.py,
+            BASELINE round-4 notes) — at 2^20 the two uses below cost
+            ~7 ms each as vector gathers."""
+            return alive[:, None][jnp.clip(idx, 0, N - 1), 0]
+
         def wire_ok(dst, phase):
             """Fault plane for one wire-analog exchange: partition drop
             + interposition mask (None-safe identity when faults off)."""
@@ -345,12 +367,19 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
 
         rbits = make_rbits(key)
 
+        def due_in_window(interval, window=None):
+            """Nodes whose nominal due round (rnd + ids ≡ 0 mod
+            interval) falls in [rnd, rnd + window) — reduces to the
+            every-round mask at window=1."""
+            w = phase_window if window is None else window
+            x = (state.rnd + ids) % interval
+            return ((interval - x) % interval) < w
+
         # ---- promotion / join (neighbor_request :975-1089)
         if "promotion" not in skip:
             sizes = jnp.sum(active >= 0, axis=1)
             isolated = sizes == 0
-            due = (((state.rnd + ids) % cfg.random_promotion_interval)
-                   == 0) | isolated
+            due = due_in_window(cfg.random_promotion_interval) | isolated
             cand = jax.vmap(ps.random_member_bits)(passive, rbits(3, P))
             in_act = jax.vmap(ps.contains)(active, cand)
             cand = jnp.where(in_act, -1, cand)
@@ -364,7 +393,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
             # failed-connect analog: a proposal to a dead candidate is
             # refused below AND the candidate is dropped from passive
             # (the reference drops unconnectable promotion candidates)
-            t_dead = propose & ~alive[jnp.clip(target, 0, N - 1)]
+            t_dead = propose & ~alive_at(target)
             passive = jnp.where(
                 (passive == jnp.where(t_dead, target, -2)[:, None]),
                 -1, passive)
@@ -379,6 +408,11 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 high = jnp.sum(
                     _gather_rows(active, p_j[:, None])[:, 0] >= 0,
                     axis=-1) == 0                  # proposer isolated
+                # (a pre-computed width-1 isolation-flag gather here
+                # was chip-measured REGRESSING the staggered 2^20
+                # round 24.7 -> 23.8 r/s — the [N, 1, A] gather+reduce
+                # fuses better than the "cheaper" op; schedule
+                # composition outweighs op savings again)
                 room = jnp.sum(active >= 0, axis=1) < A
                 a_j = (p_j >= 0) & alive & (room | high)
                 acc = acc.at[:, j].set(a_j)
@@ -403,8 +437,8 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
 
         # ---- shuffle (passive_view_maintenance :572-607)
         if "shuffle" not in skip:
-            due_s = alive \
-                & (((state.rnd + ids) % cfg.shuffle_interval) == 0)
+            due_s = alive & due_in_window(cfg.shuffle_interval,
+                                          shuffle_window)
             # every node's own sample: me ++ k_a active ++ k_p passive
             samp = jnp.concatenate([
                 ids[:, None],
@@ -413,7 +447,13 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 jax.vmap(ps.random_k_bits, in_axes=(0, 0, None))(
                     passive, rbits(12, P), cfg.shuffle_k_passive),
             ], axis=1)                                      # [N, S]
-            # ARWL-hop walk through active views (one gather per hop)
+            # ARWL-hop walk through active views (one gather per hop).
+            # A sliced variant walking only the due cohort (contiguous
+            # block-phase stagger + modulo-rolled slice) was built and
+            # chip-measured REGRESSING both sizes (2^20: 40.5 ->
+            # 55.9 ms/round staggered) despite touching k/I of the
+            # rows — schedule composition outweighs op savings on this
+            # round, the recurring round-4 lesson.
             e = ids
             for h in range(cfg.arwl):
                 rows = _gather_rows(active, e)
@@ -423,7 +463,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 )(rows, rbits(13 + h, A), jnp.stack([ids, e], axis=1))
                 e = jnp.where(step_to >= 0, step_to, e)
             ep = wire_ok(jnp.where(
-                due_s & (e != ids) & alive[jnp.clip(e, 0, N - 1)], e, -1),
+                due_s & (e != ids) & alive_at(e), e, -1),
                 "shuffle_fwd")
             # forward merge: origin folds the endpoint's sample
             # (shuffle_reply)
@@ -468,6 +508,67 @@ def run_dense(state: DenseHvState, n_rounds: int, cfg: Config,
         return step(s), None
 
     out, _ = jax.lax.scan(body, state, None, length=n_rounds)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def run_dense_staggered(state: DenseHvState, n_blocks: int, cfg: Config,
+                        churn: float = 0.0, k: int = 5) -> DenseHvState:
+    """Phase-staggered cadence (VERDICT r4 #2), mirroring the
+    reference's own timer layout — shuffle every 2k rounds, random
+    promotion every k, delivery/failure-plane every round
+    (partisan_hyparview_peer_service_manager.erl:27-28: 10 s / 5 s /
+    1 s with the default k=5) — instead of compiling every maintenance
+    phase into every round, which ran maintenance 5-10x hotter than
+    the system it models.
+
+    One 2k-round block is
+      [promotion+shuffle heavy, light x k-1, promotion heavy, light x k-1]
+    with due-masks widened to each phase's full window
+    (make_dense_round(phase_window=k, shuffle_window=2k)): per-node
+    cadence is EXACT — every node promotes once per k rounds and
+    shuffles once per 2k, quantized to the heavy grid.  LIGHT rounds
+    carry churn + isolation reseed only (chip-measured 1.7 ms at 2^20
+    vs 48 ms with the repair gather in).  Skipping repair between
+    heavies bounds failure-DETECTION latency at 2k rounds, inside the
+    engine path's own detector (keepalive_interval=2 x ttl=8 rounds,
+    Config) and the reference's TCP keepalive window — a dead edge
+    lingers at most one window before the heavy repair prunes and
+    demotes it, and under restart-in-place churn the peer is alive
+    again the next round anyway.
+
+    Runs n_blocks * 2k rounds total.  tests/test_hyparview_dense.py
+    asserts the staggered overlay's health matches the every-round
+    program's distributionally."""
+    # exactness precondition: a window may contain at most ONE nominal
+    # due round per node, else the batching silently UNDER-runs the
+    # cadence (a node due twice in a window acts once) — e.g. the hot
+    # 4/2 test cadence under k=5 would shuffle 2.5x too rarely
+    assert cfg.random_promotion_interval >= k \
+        and cfg.shuffle_interval >= 2 * k, (
+        f"staggered cadence needs random_promotion_interval >= k and "
+        f"shuffle_interval >= 2k (k={k}, got "
+        f"{cfg.random_promotion_interval}/{cfg.shuffle_interval}); "
+        f"use run_dense for hotter cadences")
+    heavy_ps = make_dense_round(cfg, churn, phase_window=k,
+                                shuffle_window=2 * k)
+    heavy_p = make_dense_round(cfg, churn, phase_window=k,
+                               skip=frozenset({"shuffle"}))
+    light = make_dense_round(
+        cfg, churn,
+        skip=frozenset({"repair", "promotion", "shuffle", "merge"}))
+
+    def light_body(s, _):
+        return light(s), None
+
+    def block(s, _):
+        s = heavy_ps(s)
+        s, _ = jax.lax.scan(light_body, s, None, length=k - 1)
+        s = heavy_p(s)
+        s, _ = jax.lax.scan(light_body, s, None, length=k - 1)
+        return s, None
+
+    out, _ = jax.lax.scan(block, state, None, length=n_blocks)
     return out
 
 

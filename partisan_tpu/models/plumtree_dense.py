@@ -44,7 +44,8 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..config import Config
-from .hyparview_dense import refuse_tpu_shape_bug, DenseHvState, make_dense_round
+from .hyparview_dense import (refuse_tpu_shape_bug, DenseHvState,
+                              make_dense_round)
 
 
 @struct.dataclass
@@ -82,15 +83,21 @@ def make_pt_dense_round(cfg: Config, root: int = 0,
             seq = seq.at[root].add(jnp.where(bump, 1, 0))
 
         nb = hv.active                                     # [N, A]
-        nb_ok = (nb >= 0) & hv.alive[jnp.clip(nb, 0, N - 1)]
-        nb_seq = jnp.where(nb_ok, seq[jnp.clip(nb, 0, N - 1)], -1)
+        # (seq, alive) packed into one [N, 2] plane so the digest scan
+        # costs ONE row gather — two separate [N·A]-index gathers from
+        # [N] vectors lower ~6x slower on TPU (the scalar-gather cliff,
+        # BASELINE round-4 notes / scripts/profile_ops.py)
+        plane = jnp.stack([seq, hv.alive.astype(jnp.int32)], axis=1)
+        rows = plane[jnp.clip(nb, 0, N - 1)]               # [N, A, 2]
+        nb_ok = (nb >= 0) & (rows[..., 1] > 0)
+        nb_seq = jnp.where(nb_ok, rows[..., 0], -1)
         known = jnp.max(nb_seq, axis=1)                    # digest plane
 
         # payload plane: one tree hop from the parent
         parent_ok = (parent >= 0) \
             & jnp.any((nb == parent[:, None]) & nb_ok, axis=1)
-        p_seq = jnp.where(parent_ok, seq[jnp.clip(parent, 0, N - 1)],
-                          -1)
+        p_seq = jnp.where(parent_ok,
+                          plane[jnp.clip(parent, 0, N - 1), 0], -1)
         delivered = p_seq > seq
         seq = jnp.maximum(seq, p_seq)
 
@@ -135,6 +142,60 @@ def run_pt_dense(hv: DenseHvState, pt: PtDense, n_rounds: int,
         return (hv2, pt2), None
 
     (hv, pt), _ = jax.lax.scan(body, (hv, pt), None, length=n_rounds)
+    return hv, pt
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def run_pt_dense_staggered(hv: DenseHvState, pt: PtDense, n_blocks: int,
+                           cfg: Config, churn: float = 0.0,
+                           root: int = 0, k: int = 5,
+                           ) -> Tuple[DenseHvState, PtDense]:
+    """Stacked(HyParView, Plumtree) on the phase-staggered membership
+    cadence (hyparview_dense.run_dense_staggered's 2k-round block:
+    promotion+shuffle heavy, k-1 light, promotion heavy, k-1 light):
+    the BROADCAST plane runs every round — payload delivery is the 1 s
+    cadence in the reference (lazy_tick_period, partisan.hrl:58) —
+    while membership maintenance runs on its 2k/k timers.  This is
+    exactly the reference's timer layout: plumtree ticks at 1 s over a
+    HyParView whose shuffle/promotion timers fire at 10 s / 5 s.  Runs
+    n_blocks * 2k rounds."""
+    refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree")
+    # same exactness precondition as run_dense_staggered: one nominal
+    # due round per node per window, or the batching under-runs
+    assert cfg.random_promotion_interval >= k \
+        and cfg.shuffle_interval >= 2 * k, (
+        f"staggered cadence needs random_promotion_interval >= k and "
+        f"shuffle_interval >= 2k (k={k}, got "
+        f"{cfg.random_promotion_interval}/{cfg.shuffle_interval}); "
+        f"use run_pt_dense for hotter cadences")
+    hv_hps = make_dense_round(cfg, churn, phase_window=k,
+                              shuffle_window=2 * k)
+    hv_hp = make_dense_round(cfg, churn, phase_window=k,
+                             skip=frozenset({"shuffle"}))
+    hv_light = make_dense_round(
+        cfg, churn,
+        skip=frozenset({"repair", "promotion", "shuffle", "merge"}))
+    pt_step = make_pt_dense_round(cfg, root=root, broadcast_interval=5)
+
+    def one(hv_step):
+        def body(carry, _):
+            hv, ptd = carry
+            hv2 = hv_step(hv)
+            ptd2 = pt_step(hv2, ptd, hv.rnd)
+            return (hv2, ptd2), None
+        return body
+
+    hps_body, hp_body, light_body = one(hv_hps), one(hv_hp), \
+        one(hv_light)
+
+    def block(carry, _):
+        carry, _ = hps_body(carry, None)
+        carry, _ = jax.lax.scan(light_body, carry, None, length=k - 1)
+        carry, _ = hp_body(carry, None)
+        carry, _ = jax.lax.scan(light_body, carry, None, length=k - 1)
+        return carry, None
+
+    (hv, pt), _ = jax.lax.scan(block, (hv, pt), None, length=n_blocks)
     return hv, pt
 
 
