@@ -185,6 +185,14 @@ def _spawn_walks(st: DenseScampState, contact: jax.Array,
     )
 
 
+# columns of the concatenated (partial ++ in_view) planes re-checked
+# per round by the amortized stale-entry sweep: removal latency is
+# ceil(W/K_SWEEP) rounds.  Module-level so the 2^20 shape search
+# (scripts/repro_scamp_dense_fault.py --ksweep) can vary it; jit cache
+# correctness is per-process (fresh process per variant).
+K_SWEEP = 8
+
+
 def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
                            max_age: int = 64,
                            skip: Tuple[str, ...] = ()):
@@ -261,8 +269,6 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         cat = jnp.concatenate([partial, in_view], axis=1)
         scat = jnp.concatenate([pstamp, ivstamp], axis=1)
         W = cat.shape[1]
-        K_SWEEP = 8              # columns re-checked per round: removal
-                                 # latency is ceil(W/K) rounds
         for j in range(K_SWEEP):
             cj = (st.rnd * K_SWEEP + j) % W
             col = jnp.take(cat, cj, axis=1)                  # [N]

@@ -36,8 +36,19 @@ make_dense_scamp_round's skip= parameter (phases: churn, admit,
 inview) and scan length.  Production code chunks launches at
 scamp_dense.LAUNCH_CAP=100 regardless.
 
-Run:  python scripts/repro_scamp_dense_fault.py [rounds=100 [log2_n=20]]
+Round-5 2^20 shape search (VERDICT r4 #1): the knobs below sweep the
+program-shape levers that moved the 2^16 failing length in round 4 —
+launch length, walker slots C, sweep width K_SWEEP, and the skip=
+phase ablations.  Each variant runs in a FRESH process (one jit cache,
+one worker session); results are recorded in the RESULTS table at the
+bottom of this docstring as they land.
+
+Run:  python scripts/repro_scamp_dense_fault.py [rounds [log2_n]]
+          [--c C] [--ksweep K] [--skip churn,admit,inview]
+          [--launches L]   (L chained launches of `rounds` each,
+                            exercising the LAUNCH_CAP chunking shape)
 """
+import argparse
 import os
 import sys
 
@@ -50,16 +61,35 @@ import jax.numpy as jnp
 
 sys.path.insert(0, '.')
 from partisan_tpu.config import Config
+from partisan_tpu.models import scamp_dense
 from partisan_tpu.models.scamp_dense import (
     _run_dense_scamp_launch, dense_scamp_init)
 
-rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 100
-log2n = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-cfg = Config(n_nodes=1 << log2n, seed=7)
-print(f"device={jax.devices()[0]} n={cfg.n_nodes} rounds={rounds} "
-      f"(single scan launch)", flush=True)
+ap = argparse.ArgumentParser()
+ap.add_argument("rounds", nargs="?", type=int, default=100)
+ap.add_argument("log2_n", nargs="?", type=int, default=20)
+ap.add_argument("--c", type=int, default=None,
+                help="walker slots (Config.scamp_walker_slots)")
+ap.add_argument("--ksweep", type=int, default=None,
+                help="stale-sweep columns/round (scamp_dense.K_SWEEP)")
+ap.add_argument("--skip", default="",
+                help="comma list of phases to ablate")
+ap.add_argument("--launches", type=int, default=1,
+                help="chained launches of `rounds` each")
+args = ap.parse_args()
+
+if args.ksweep is not None:
+    scamp_dense.K_SWEEP = args.ksweep
+skip = tuple(s for s in args.skip.split(",") if s)
+kw = {} if args.c is None else {"scamp_walker_slots": args.c}
+cfg = Config(n_nodes=1 << args.log2_n, seed=7, **kw)
+print(f"device={jax.devices()[0]} n={cfg.n_nodes} rounds={args.rounds}"
+      f" launches={args.launches} C={cfg.scamp_walker_slots}"
+      f" K_SWEEP={scamp_dense.K_SWEEP} skip={skip or '()'}", flush=True)
 st = dense_scamp_init(cfg)
 st.partial.block_until_ready()
-out = _run_dense_scamp_launch(st, rounds, cfg, 0.01, ())
-print("walkers:", int(jnp.sum(out.walk_pos >= 0)), flush=True)
+for i in range(args.launches):
+    st = _run_dense_scamp_launch(st, args.rounds, cfg, 0.01, skip)
+    print(f"launch {i}: walkers={int(jnp.sum(st.walk_pos >= 0))}",
+          flush=True)
 print("clean exit", flush=True)
