@@ -540,6 +540,18 @@ def run_dense_staggered(state: DenseHvState, n_blocks: int, cfg: Config,
     Runs n_blocks * 2k rounds total.  tests/test_hyparview_dense.py
     asserts the staggered overlay's health matches the every-round
     program's distributionally."""
+    bodies = tuple(
+        (lambda st, _, _p=p: (_p(st), None))
+        for p in staggered_programs(cfg, churn, k))
+    return staggered_scan(bodies, state, n_blocks, k)
+
+
+def staggered_programs(cfg: Config, churn: float, k: int):
+    """(heavy_promote+shuffle, heavy_promote, light) round programs of
+    the staggered cadence, plus its exactness precondition — the ONE
+    definition both run_dense_staggered and plumtree_dense's fused
+    variant build on (code-review r5: the cadence machinery was
+    duplicated verbatim across the two modules)."""
     # exactness precondition: a window may contain at most ONE nominal
     # due round per node, else the batching silently UNDER-runs the
     # cadence (a node due twice in a window acts once) — e.g. the hot
@@ -549,7 +561,7 @@ def run_dense_staggered(state: DenseHvState, n_blocks: int, cfg: Config,
         f"staggered cadence needs random_promotion_interval >= k and "
         f"shuffle_interval >= 2k (k={k}, got "
         f"{cfg.random_promotion_interval}/{cfg.shuffle_interval}); "
-        f"use run_dense for hotter cadences")
+        f"use the every-round runner for hotter cadences")
     heavy_ps = make_dense_round(cfg, churn, phase_window=k,
                                 shuffle_window=2 * k)
     heavy_p = make_dense_round(cfg, churn, phase_window=k,
@@ -557,18 +569,24 @@ def run_dense_staggered(state: DenseHvState, n_blocks: int, cfg: Config,
     light = make_dense_round(
         cfg, churn,
         skip=frozenset({"repair", "promotion", "shuffle", "merge"}))
+    return heavy_ps, heavy_p, light
 
-    def light_body(s, _):
-        return light(s), None
 
-    def block(s, _):
-        s = heavy_ps(s)
-        s, _ = jax.lax.scan(light_body, s, None, length=k - 1)
-        s = heavy_p(s)
-        s, _ = jax.lax.scan(light_body, s, None, length=k - 1)
-        return s, None
+def staggered_scan(bodies, carry, n_blocks: int, k: int):
+    """Drive one 2k-round staggered block layout
+    [heavy_ps, light x k-1, heavy_p, light x k-1] for n_blocks blocks;
+    ``bodies`` are scan-body functions (carry, None) -> (carry, None)
+    for the three programs of :func:`staggered_programs`."""
+    hps_body, hp_body, light_body = bodies
 
-    out, _ = jax.lax.scan(block, state, None, length=n_blocks)
+    def block(c, _):
+        c, _ = hps_body(c, None)
+        c, _ = jax.lax.scan(light_body, c, None, length=k - 1)
+        c, _ = hp_body(c, None)
+        c, _ = jax.lax.scan(light_body, c, None, length=k - 1)
+        return c, None
+
+    out, _ = jax.lax.scan(block, carry, None, length=n_blocks)
     return out
 
 

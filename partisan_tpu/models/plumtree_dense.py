@@ -45,7 +45,9 @@ from flax import struct
 
 from ..config import Config
 from .hyparview_dense import (refuse_tpu_shape_bug, DenseHvState,
-                              make_dense_round)
+                              make_dense_round, staggered_programs,
+                              staggered_scan)
+from .scamp_dense import launch_cap_for
 
 
 @struct.dataclass
@@ -127,11 +129,17 @@ def run_pt_dense(hv: DenseHvState, pt: PtDense, n_rounds: int,
     the Stacked(HyParView, Plumtree) composition at TPU scale.
 
     N gate: at N = 2^20 this fused program faults the v5e TPU worker
-    (the XLA scatter/fusion bug family of ROADMAP 1d /
-    scripts/repro_scamp_dense_fault.py — the bare dense-HyParView scan
-    runs 2^20 CLEAN, so the trigger is in the added broadcast planes'
-    composition); loudly refuse rather than crash the chip."""
-    refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree")
+    in a LONG single scan (the XLA scatter/fusion bug family of
+    ROADMAP 1d / scripts/repro_pt_dense_fault.py — the bare
+    dense-HyParView scan runs 2^20 clean, so the trigger is in the
+    added broadcast planes' composition), but launches of at most
+    launch_cap_for(N)=50 scanned rounds run 2^20 clean (round-5 probe,
+    same scan-length sensitivity as the SCAMP plane).  The gate admits
+    2^20 only for capped launches — use :func:`run_pt_dense_chunked`
+    there; loudly refuse rather than crash the chip."""
+    limit = (1 << 20) if n_rounds <= launch_cap_for(cfg.n_nodes) \
+        else (1 << 16)
+    refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree", limit=limit)
     hv_step = make_dense_round(cfg, churn)
     pt_step = make_pt_dense_round(cfg, root=root, broadcast_interval=5)
 
@@ -158,23 +166,11 @@ def run_pt_dense_staggered(hv: DenseHvState, pt: PtDense, n_blocks: int,
     while membership maintenance runs on its 2k/k timers.  This is
     exactly the reference's timer layout: plumtree ticks at 1 s over a
     HyParView whose shuffle/promotion timers fire at 10 s / 5 s.  Runs
-    n_blocks * 2k rounds."""
-    refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree")
-    # same exactness precondition as run_dense_staggered: one nominal
-    # due round per node per window, or the batching under-runs
-    assert cfg.random_promotion_interval >= k \
-        and cfg.shuffle_interval >= 2 * k, (
-        f"staggered cadence needs random_promotion_interval >= k and "
-        f"shuffle_interval >= 2k (k={k}, got "
-        f"{cfg.random_promotion_interval}/{cfg.shuffle_interval}); "
-        f"use run_pt_dense for hotter cadences")
-    hv_hps = make_dense_round(cfg, churn, phase_window=k,
-                              shuffle_window=2 * k)
-    hv_hp = make_dense_round(cfg, churn, phase_window=k,
-                             skip=frozenset({"shuffle"}))
-    hv_light = make_dense_round(
-        cfg, churn,
-        skip=frozenset({"repair", "promotion", "shuffle", "merge"}))
+    n_blocks * 2k rounds (same launch-length gate as run_pt_dense —
+    chunk via :func:`run_pt_dense_staggered_chunked` at N > 2^16)."""
+    limit = (1 << 20) if n_blocks * 2 * k <= launch_cap_for(cfg.n_nodes) \
+        else (1 << 16)
+    refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree", limit=limit)
     pt_step = make_pt_dense_round(cfg, root=root, broadcast_interval=5)
 
     def one(hv_step):
@@ -185,17 +181,42 @@ def run_pt_dense_staggered(hv: DenseHvState, pt: PtDense, n_blocks: int,
             return (hv2, ptd2), None
         return body
 
-    hps_body, hp_body, light_body = one(hv_hps), one(hv_hp), \
-        one(hv_light)
+    # the cadence (block layout + exactness precondition) is defined
+    # ONCE, in hyparview_dense.staggered_programs/staggered_scan — the
+    # broadcast plane only wraps each membership program with its own
+    # every-round tick
+    bodies = tuple(one(p) for p in staggered_programs(cfg, churn, k))
+    return staggered_scan(bodies, (hv, pt), n_blocks, k)
 
-    def block(carry, _):
-        carry, _ = hps_body(carry, None)
-        carry, _ = jax.lax.scan(light_body, carry, None, length=k - 1)
-        carry, _ = hp_body(carry, None)
-        carry, _ = jax.lax.scan(light_body, carry, None, length=k - 1)
-        return carry, None
 
-    (hv, pt), _ = jax.lax.scan(block, (hv, pt), None, length=n_blocks)
+def run_pt_dense_chunked(hv: DenseHvState, pt: PtDense, n_rounds: int,
+                         cfg: Config, churn: float = 0.0,
+                         root: int = 0) -> Tuple[DenseHvState, PtDense]:
+    """run_pt_dense in launches of at most launch_cap_for(N) scanned
+    rounds — the shape validated clean at N=2^20 (chunking is
+    semantically invisible: the carried (hv, pt) state is identical)."""
+    cap = launch_cap_for(cfg.n_nodes)
+    done = 0
+    while done < n_rounds:
+        step_n = min(cap, n_rounds - done)
+        hv, pt = run_pt_dense(hv, pt, step_n, cfg, churn, root)
+        done += step_n
+    return hv, pt
+
+
+def run_pt_dense_staggered_chunked(hv: DenseHvState, pt: PtDense,
+                                   n_blocks: int, cfg: Config,
+                                   churn: float = 0.0, root: int = 0,
+                                   k: int = 5,
+                                   ) -> Tuple[DenseHvState, PtDense]:
+    """run_pt_dense_staggered in launches of whole 2k-round blocks,
+    at most launch_cap_for(N) rounds per launch."""
+    cap_blocks = max(1, launch_cap_for(cfg.n_nodes) // (2 * k))
+    done = 0
+    while done < n_blocks:
+        b = min(cap_blocks, n_blocks - done)
+        hv, pt = run_pt_dense_staggered(hv, pt, b, cfg, churn, root, k)
+        done += b
     return hv, pt
 
 

@@ -203,10 +203,13 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
     # silently reuse a stale program).  Production runs leave it empty.
     _dbg = frozenset(skip)
     N = cfg.n_nodes
-    # Loud gate: dense SCAMP faults the v5e worker at N = 2^20 even in
-    # the shape that runs 2^16 clean at any launch length — the XLA
-    # bug re-manifests at the larger shape (see LAUNCH_CAP's comment).
-    refuse_tpu_shape_bug(N, "dense SCAMP")
+    # Loud gate, now at 2^20 (round 5): single launches of <=50 scanned
+    # rounds run N=2^20 clean (1000-round soak) and run_dense_scamp
+    # chunks to launch_cap_for(N)=50 there, so 2^20 is admitted; a
+    # single >=100-round launch at 2^20 still faults the v5e worker
+    # (see LAUNCH_CAP's comment), and shapes beyond 2^20 are unprobed —
+    # the gate holds at the largest validated shape.
+    refuse_tpu_shape_bug(N, "dense SCAMP", limit=1 << 20)
     P, C = walker_caps(cfg)
     ids = jnp.arange(N, dtype=jnp.int32)
 
@@ -450,24 +453,33 @@ def _run_dense_scamp_launch(st: DenseScampState, n_rounds: int,
 #     (skip=admit) crashed the COMPILER outright
 #     (scatter_emitter.cc:2824 Check failure in the fusion pass);
 #   * round-4 final shape (stamp-exact amortized sweep): clean at 500+
-#     single-launch.
+#     single-launch at 2^16, but a single 100-round launch faults at
+#     N=2^20 — while 25- and 50-round launches run 2^20 CLEAN (round-5
+#     search: 8x25, 4x50, and a 20x50 = 1000-round soak, identical
+#     walker trajectories across chunkings).
 # Every constituent op is individually clean and CPU runs are clean at
-# any length — not a code bug.  The current shape no longer needs the
-# cap, but the bug is plainly nearby, chunking is semantically
-# invisible (the carried state is identical), and it costs one host
-# round-trip per LAUNCH_CAP rounds — so it stays.
+# any length — not a code bug.  Chunking is semantically invisible
+# (the carried state is identical) and costs one host round-trip per
+# launch, so the cap stays and TIGHTENS with shape: 100 up to 2^16
+# (validated round 4), 50 above (validated at 2^20 round 5).
 LAUNCH_CAP = 100
+LAUNCH_CAP_BIG = 50
+
+
+def launch_cap_for(n_nodes: int) -> int:
+    return LAUNCH_CAP if n_nodes <= (1 << 16) else LAUNCH_CAP_BIG
 
 
 def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,
                     churn: float = 0.0,
                     skip: Tuple[str, ...] = ()) -> DenseScampState:
     """Run ``n_rounds`` dense-SCAMP rounds, chunked into launches of at
-    most :data:`LAUNCH_CAP` scanned rounds (see its comment; one jit
-    cache entry per distinct chunk length)."""
+    most :func:`launch_cap_for` scanned rounds (see LAUNCH_CAP's
+    comment; one jit cache entry per distinct chunk length)."""
+    cap = launch_cap_for(cfg.n_nodes)
     done = 0
     while done < n_rounds:
-        step_n = min(LAUNCH_CAP, n_rounds - done)
+        step_n = min(cap, n_rounds - done)
         st = _run_dense_scamp_launch(st, step_n, cfg, churn, skip)
         done += step_n
     return st

@@ -156,32 +156,45 @@ def main() -> None:
         import statistics as _st
         from partisan_tpu.models.hyparview_dense import (
             connectivity, dense_init, run_dense, run_dense_staggered)
+        def hv_bench(name, n, total_rounds, cfg, run_trial, cadence):
+            """Shared hv_dense timing discipline (one copy for the
+            flat continuity row AND the staggered sweep): warmup
+            compile + sync, 3 trials on reseeded worlds with a scalar
+            readback in the timed region, churn-free flat-round heal
+            (repair every round — connectivity must restore once churn
+            stops), connectivity health row."""
+            warm = run_trial(dense_init(cfg))
+            float(jnp.sum(warm.active))          # compile + real sync
+            rates = []
+            for t in range(3):
+                w0 = dense_init(cfg.replace(seed=11 + 13 * t))
+                t0 = time.perf_counter()
+                out = run_trial(w0)
+                float(jnp.sum(out.active))                    # sync
+                rates.append(total_rounds / (time.perf_counter() - t0))
+            out = run_dense(out, 20, cfg)
+            h = {kk: float(np.asarray(v)) for kk, v in
+                 connectivity(out).items()}
+            rps = _st.median(rates)
+            health = ("connected" if h.get("connected") else
+                      f"reached={h.get('reached'):.0f}/"
+                      f"{h.get('live'):.0f}")
+            rows.append([name, n, total_rounds,
+                         round(total_rounds / rps, 4), round(rps, 1),
+                         f"{health},"
+                         f"mean_active={h.get('mean_active'):.1f},"
+                         f"cadence={cadence},churn=0.01"])
+            print(f"{name:28s} N={n:<7d} {rps:9.1f} rounds/s"
+                  f"  ({health})")
+
         # continuity row: round-4's every-round program at its hotter
         # 4/2 cadence, so the cross-round speedup decomposition stays
         # honest (program improvements vs cadence change)
         n, rnds = 1 << 12, (200 if args.quick else 2000)
         fcfg = pt.Config(n_nodes=n, shuffle_interval=4,
                          random_promotion_interval=2)
-        warm = run_dense(dense_init(fcfg), rnds, fcfg, 0.01)
-        float(jnp.sum(warm.active))
-        rates = []
-        for t in range(3):
-            w0 = dense_init(fcfg.replace(seed=11 + 13 * t))
-            t0 = time.perf_counter()
-            out = run_dense(w0, rnds, fcfg, 0.01)
-            float(jnp.sum(out.active))
-            rates.append(rnds / (time.perf_counter() - t0))
-        out = run_dense(out, 20, fcfg)
-        h = {k: float(np.asarray(v)) for k, v in connectivity(out).items()}
-        rps = _st.median(rates)
-        health = ("connected" if h.get("connected") else
-                  f"reached={h.get('reached'):.0f}/{h.get('live'):.0f}")
-        rows.append(["hv_dense_flat_4096", n, rnds, round(rnds / rps, 4),
-                     round(rps, 1),
-                     f"{health},mean_active={h.get('mean_active'):.1f},"
-                     f"cadence=flat4/2,churn=0.01"])
-        print(f"{'hv_dense_flat_4096':28s} N={n:<7d} {rps:9.1f} rounds/s"
-              f"  ({health})")
+        hv_bench("hv_dense_flat_4096", n, rnds, fcfg,
+                 lambda w: run_dense(w, rnds, fcfg, 0.01), "flat4/2")
         # official rows: staggered, reference cadence
         sweep = [(1 << 12, 2000), (1 << 16, 500), (1 << 20, 200)]
         k = 5
@@ -191,31 +204,11 @@ def main() -> None:
             blocks = rnds // (2 * k)          # one block = 2k rounds
             total = blocks * 2 * k
             cfg = pt.Config(n_nodes=n)
-            warm = run_dense_staggered(dense_init(cfg), blocks, cfg,
-                                       0.01, k)
-            float(jnp.sum(warm.active))          # compile + real sync
-            rates = []
-            for t in range(3):
-                w0 = dense_init(cfg.replace(seed=11 + 13 * t))
-                t0 = time.perf_counter()
-                out = run_dense_staggered(w0, blocks, cfg, 0.01, k)
-                float(jnp.sum(out.active))                    # sync
-                rates.append(total / (time.perf_counter() - t0))
-            # heal: churn-free flat rounds (repair every round) — the
-            # same invariant as before: connectivity restores once the
-            # churn stops
-            out = run_dense(out, 20, cfg)
-            h = {kk: float(np.asarray(v)) for kk, v in
-                 connectivity(out).items()}
-            rps = _st.median(rates)
-            name = f"hv_dense_{n}"
-            health = ("connected" if h.get("connected") else
-                      f"reached={h.get('reached'):.0f}/{h.get('live'):.0f}")
-            rows.append([name, n, total, round(total / rps, 4),
-                         round(rps, 1),
-                         f"{health},mean_active={h.get('mean_active'):.1f},"
-                         f"cadence=ref10/5k5,churn=0.01"])
-            print(f"{name:28s} N={n:<7d} {rps:9.1f} rounds/s  ({health})")
+            hv_bench(
+                f"hv_dense_{n}", n, total, cfg,
+                lambda w, blocks=blocks, cfg=cfg: run_dense_staggered(
+                    w, blocks, cfg, 0.01, k),
+                f"ref10/5k{k}")
 
     if want("scamp_dense") and jax.devices()[0].platform == "tpu":
         # round 3: the second membership strategy re-laid TPU-fast —
@@ -225,11 +218,12 @@ def main() -> None:
         import statistics as _st
         from partisan_tpu.models.scamp_dense import (
             dense_scamp_init, run_dense_scamp, scamp_health)
-        # N=2^16 runs chunked (scamp_dense.LAUNCH_CAP): single launches
-        # beyond ~100 scanned rounds at that shape fault the TPU worker
+        # N>=2^16 runs chunked (scamp_dense.launch_cap_for): single
+        # launches beyond ~100 scanned rounds at 2^16 — and beyond ~50
+        # at 2^20 — fault the TPU worker
         # (scripts/repro_scamp_dense_fault.py pins it, ROADMAP 1d);
-        # 100-round launches soak clean (1000+ rounds, round 4)
-        for n, rnds in ((1 << 12, 2000), (1 << 16, 200)):
+        # the capped launches soak clean (1000+ rounds at both shapes)
+        for n, rnds in ((1 << 12, 2000), (1 << 16, 200), (1 << 20, 100)):
             if args.quick:
                 rnds = min(rnds, 200)
             cfg = pt.Config(n_nodes=n)
@@ -358,6 +352,29 @@ def main() -> None:
             lambda hv_, pt0: run_pt_dense_staggered(
                 hv_, pt0, blocks16, cfg16, 0.01, 0, k),
             rnds16, "cadence=ref10/5k5,")
+
+        # round 5: broadcast at 2^20 — the fused program runs clean in
+        # <=50-round launches (scripts/repro_pt_dense_fault.py), so the
+        # 1M-node row rides run_pt_dense_staggered_chunked
+        if not args.quick:
+            from partisan_tpu.models.plumtree_dense import (
+                run_pt_dense_staggered_chunked)
+            n20 = 1 << 20
+            blocks20 = 10                      # 100 rounds
+            rnds20 = blocks20 * 2 * k
+            cfg20 = pt.Config(n_nodes=n20)
+            hv0 = run_dense_staggered(dense_init(cfg20), 20, cfg20,
+                                      0.01, k)
+            hv0 = run_dense(hv0, 20, cfg20)    # heal for coverage
+            cov_ok20 = bool(np.asarray(connectivity(hv0)["connected"]))
+            pt_bench(
+                n20, cfg20, hv0, cov_ok20,
+                lambda t: run_dense_staggered(
+                    dense_init(cfg20.replace(seed=23 + 7 * t)), 20,
+                    cfg20, 0.01, k),
+                lambda hv_, pt0: run_pt_dense_staggered_chunked(
+                    hv_, pt0, blocks20, cfg20, 0.01, 0, k),
+                rnds20, "cadence=ref10/5k5,")
 
     if want("echo"):
         # the reference's performance_test proper: SIZE x CONCURRENCY x RTT

@@ -1,0 +1,62 @@
+"""Chunked-launch probe for the dense-plumtree TPU fault at N=2^20
+(ROADMAP 1d family; the SCAMP sibling is repro_scamp_dense_fault.py).
+
+History: the fused membership+broadcast scan (run_pt_dense) faulted
+the v5e worker at N=2^20 in a SINGLE long scan (the bare dense-
+HyParView scan runs 2^20 clean, so the trigger is the added broadcast
+planes' composition) — the same scan-length-sensitive XLA bug family
+the SCAMP plane hit.  Round 5 found 2^20 dense SCAMP runs CLEAN when
+the scan is chunked into <=50-round launches; this script asks the
+same question of the pt plane: chain L launches of a bounded-length
+scan (flat cadence) or bounded-block staggered scan and see whether
+the chunked shape survives where the long scan faulted.
+
+Run:  python scripts/repro_pt_dense_fault.py [rounds_per_launch
+          [log2_n]] [--launches L] [--flat]  (default: staggered
+          cadence, rounds_per_launch rounded to whole 2k-blocks)
+"""
+import argparse
+import os
+import sys
+
+os.environ["PARTISAN_TPU_UNGATE"] = "1"
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, '.')
+from partisan_tpu.config import Config
+from partisan_tpu.models.hyparview_dense import dense_init
+from partisan_tpu.models.plumtree_dense import (pt_dense_init,
+                                                run_pt_dense,
+                                                run_pt_dense_staggered)
+
+ap = argparse.ArgumentParser()
+ap.add_argument("rounds", nargs="?", type=int, default=50)
+ap.add_argument("log2_n", nargs="?", type=int, default=20)
+ap.add_argument("--launches", type=int, default=4)
+ap.add_argument("--flat", action="store_true",
+                help="every-round cadence (run_pt_dense) instead of "
+                     "the staggered block cadence")
+args = ap.parse_args()
+
+k = 5
+cfg = Config(n_nodes=1 << args.log2_n, seed=7)
+blocks = max(1, args.rounds // (2 * k))
+per = args.rounds if args.flat else blocks * 2 * k
+print(f"device={jax.devices()[0]} n={cfg.n_nodes} per_launch={per} "
+      f"launches={args.launches} cadence="
+      f"{'flat' if args.flat else f'ref10/5k{k}'}", flush=True)
+hv = dense_init(cfg)
+ptd = pt_dense_init(cfg)
+hv.active.block_until_ready()
+for i in range(args.launches):
+    if args.flat:
+        hv, ptd = run_pt_dense(hv, ptd, args.rounds, cfg, 0.01)
+    else:
+        hv, ptd = run_pt_dense_staggered(hv, ptd, blocks, cfg, 0.01,
+                                         0, k)
+    print(f"launch {i}: root_seq={int(ptd.seq[0])} "
+          f"tracked={float(jnp.mean((ptd.seq[0] - ptd.seq) <= 5)):.3f}",
+          flush=True)
+print("clean exit", flush=True)

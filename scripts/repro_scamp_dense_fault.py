@@ -40,8 +40,24 @@ Round-5 2^20 shape search (VERDICT r4 #1): the knobs below sweep the
 program-shape levers that moved the 2^16 failing length in round 4 —
 launch length, walker slots C, sweep width K_SWEEP, and the skip=
 phase ablations.  Each variant runs in a FRESH process (one jit cache,
-one worker session); results are recorded in the RESULTS table at the
-bottom of this docstring as they land.
+one worker session).
+
+RESULTS (v5e, jax 0.9.0 axon tunnel, 2026-08-01, all at N=2^20,
+churn=0.01, C=8, K_SWEEP=8 unless noted):
+  * 100-round single launch            -> WORKER FAULT on first
+    readback (unchanged from round 4's gate observation)
+  * 25-round launches x 8  (200 rds)   -> CLEAN
+  * 50-round launches x 4  (200 rds)   -> CLEAN, walker counts at
+    matching round boundaries IDENTICAL to the 25-round chunking
+    (chunk boundaries don't perturb the trajectory)
+  * 50-round launches x 20 (1000 rds)  -> CLEAN (the soak)
+So the fault tracks SINGLE-SCAN LENGTH at this shape — between 50 and
+100 scanned rounds at 2^20 vs >500 at 2^16 — and chunking at
+launch_cap_for(N)=50 is a complete production workaround; no C /
+K_SWEEP / skip ablation was needed.  The same recipe clears the fused
+plumtree plane (scripts/repro_pt_dense_fault.py: staggered 4x50
+clean at 2^20 where one long scan faulted).  make_dense_scamp_round's
+gate now admits N<=2^20; beyond 2^20 remains unprobed and gated.
 
 Run:  python scripts/repro_scamp_dense_fault.py [rounds [log2_n]]
           [--c C] [--ksweep K] [--skip churn,admit,inview]

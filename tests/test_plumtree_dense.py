@@ -63,3 +63,32 @@ class TestCoverage:
         lag = root_seq - seq
         # the overwhelming majority of nodes track the root closely
         assert (lag <= 5).mean() >= 0.9, (root_seq, np.percentile(lag, 95))
+
+
+class TestChunkedLaunches:
+    def test_chunked_matches_single_scan(self):
+        """The launch_cap_for chunking (the shape that unlocks N=2^20
+        on TPU) is semantically invisible: chunked and single-scan runs
+        carry identical state.  120 rounds at cap 100 forces a 100+20
+        split."""
+        from partisan_tpu.models.plumtree_dense import (
+            run_pt_dense_chunked)
+        cfg, hv = overlay(256)
+        p0 = pt_dense_init(cfg)
+        hv1, p1 = run_pt_dense(hv, p0, 120, cfg, 0.01)
+        hv2, p2 = run_pt_dense_chunked(hv, p0, 120, cfg, 0.01)
+        assert (np.asarray(hv1.active) == np.asarray(hv2.active)).all()
+        assert (np.asarray(p1.seq) == np.asarray(p2.seq)).all()
+        assert (np.asarray(p1.parent) == np.asarray(p2.parent)).all()
+
+    def test_staggered_chunked_matches(self):
+        from partisan_tpu.models.plumtree_dense import (
+            run_pt_dense_staggered, run_pt_dense_staggered_chunked)
+        cfg = pt.Config(n_nodes=256, seed=5)
+        hv = run_dense(dense_init(cfg), 60, cfg)
+        p0 = pt_dense_init(cfg)
+        # 12 blocks at cap 100 rounds -> 10-block + 2-block launches
+        hv1, p1 = run_pt_dense_staggered(hv, p0, 12, cfg, 0.01)
+        hv2, p2 = run_pt_dense_staggered_chunked(hv, p0, 12, cfg, 0.01)
+        assert (np.asarray(hv1.active) == np.asarray(hv2.active)).all()
+        assert (np.asarray(p1.seq) == np.asarray(p2.seq)).all()
