@@ -4,14 +4,16 @@ computing which message sends each receive can cause, feeding the
 ``analysis/partisan-causality-<mod>`` + ``annotations/…`` files the model
 checker prunes with).
 
-Static analysis of traced-and-compiled JAX has no cerl equivalent, so the
-rebuild infers the same relation *dynamically*: every handler is executed
+This module is the DYNAMIC half of the analog: every handler is executed
 (vmapped) over randomized state rows and message payloads, and the types
 observed among its valid emissions form the causality edge set.  Sampling
 makes this an under-approximation of rare branches (more samples tighten
-it) and the random payloads an over-approximation of unreachable ones —
-the same soundness trade the reference's annotations make in practice
-(its README calls the annotations hand-checked).
+it) and the random payloads an over-approximation of unreachable ones.
+The STATIC half — the direction the reference's cerl walk actually takes
+— is verify/static_analysis.py: an AST walk over the handler methods
+whose edge map provably over-approximates this one (pruning-sound);
+``static_analysis.merged_causality`` combines the static superset with
+this module's probe-certified ``__background__`` classification.
 
 Output shape mirrors the reference's annotation files: a JSON map
 ``{type: [caused types]}`` with the pseudo-sources ``__tick__`` (timer

@@ -59,6 +59,26 @@ def time_engine(name, cfg, proto, rounds, health_fn, rows, out_cap=None):
           f"({health})")
 
 
+class _RowSink(list):
+    """Row collector that FLUSHES each row to the CSV as it lands —
+    a crashed group (e.g. a TPU OOM mid-sweep) no longer discards the
+    rows every earlier group already measured."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+
+    def append(self, row) -> None:
+        super().append(row)
+        new = not os.path.exists(self._path)
+        with open(self._path, "a", newline="") as f:
+            w = csv.writer(f)
+            if new:
+                w.writerow(["config", "n_nodes", "rounds", "seconds",
+                            "rounds_per_sec", "health"])
+            w.writerow(row)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="results.csv")
@@ -80,7 +100,7 @@ def main() -> None:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     R = 50 if args.quick else 200
-    rows = []
+    rows = _RowSink(args.out)
     want = lambda name: args.only is None or any(
         tok and tok in name for tok in args.only.split(","))
 
@@ -229,13 +249,20 @@ def main() -> None:
             cfg = pt.Config(n_nodes=n)
             warm = run_dense_scamp(dense_scamp_init(cfg), rnds, cfg, 0.01)
             float(jnp.sum(warm.partial))         # compile + real sync
-            rates = []
+            # the 2^20 state is ~2.8 GB (P=166 view cap x 4 int32
+            # planes); holding warm + the previous trial's out + the
+            # in-flight trial OOMs the chip — keep at most two states
+            # live (the in-flight trial's input and output)
+            del warm
+            rates, out = [], None
             for t in range(3):
                 s0 = dense_scamp_init(cfg.replace(seed=17 + 5 * t))
+                out = None                       # free previous trial
                 t0 = time.perf_counter()
                 out = run_dense_scamp(s0, rnds, cfg, 0.01)
                 float(jnp.sum(out.partial))      # sync
                 rates.append(rnds / (time.perf_counter() - t0))
+                del s0
             out = run_dense_scamp(out, 60, cfg)  # settle, then health
             h = {k: float(np.asarray(v))
                  for k, v in scamp_health(out).items()}
@@ -519,13 +546,6 @@ def main() -> None:
                     w, rnds, nn, 2, 1, 0.01, 1024, False, True),
                 nn, rnds)
 
-    new = not os.path.exists(args.out)
-    with open(args.out, "a", newline="") as f:
-        w = csv.writer(f)
-        if new:
-            w.writerow(["config", "n_nodes", "rounds", "seconds",
-                        "rounds_per_sec", "health"])
-        w.writerows(rows)
     print(f"appended {len(rows)} rows to {args.out} "
           f"(device={jax.devices()[0].platform})")
 

@@ -92,6 +92,12 @@ ap.add_argument("--skip", default="",
                 help="comma list of phases to ablate")
 ap.add_argument("--launches", type=int, default=1,
                 help="chained launches of `rounds` each")
+ap.add_argument("--settle", type=int, default=0,
+                help="churn-free settle rounds after the launches "
+                     "(chunked via run_dense_scamp)")
+ap.add_argument("--health", action="store_true",
+                help="run the jitted scamp_health BFS readback at the "
+                     "end (the perf-suite shape that faulted at 2^20)")
 args = ap.parse_args()
 
 if args.ksweep is not None:
@@ -108,4 +114,13 @@ for i in range(args.launches):
     st = _run_dense_scamp_launch(st, args.rounds, cfg, 0.01, skip)
     print(f"launch {i}: walkers={int(jnp.sum(st.walk_pos >= 0))}",
           flush=True)
+if args.settle:
+    from partisan_tpu.models.scamp_dense import run_dense_scamp
+    st = run_dense_scamp(st, args.settle, cfg, 0.0)
+    print(f"settle {args.settle}: walkers="
+          f"{int(jnp.sum(st.walk_pos >= 0))}", flush=True)
+if args.health:
+    from partisan_tpu.models.scamp_dense import scamp_health
+    h = {k: float(v) for k, v in scamp_health(st).items()}
+    print("health:", h, flush=True)
 print("clean exit", flush=True)
