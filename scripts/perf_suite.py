@@ -192,7 +192,11 @@ def main() -> None:
                 out = run_trial(w0)
                 float(jnp.sum(out.active))                    # sync
                 rates.append(total_rounds / (time.perf_counter() - t0))
-            out = run_dense(out, 20, cfg)
+            # heal window: 60 churn-free every-round-repair rounds —
+            # the staggered cadence accrues more un-repaired damage
+            # than the flat program did, and 20 rounds left a
+            # 10^-4-fraction of 2^16/2^20 nodes still re-attaching
+            out = run_dense(out, 60, cfg)
             h = {kk: float(np.asarray(v)) for kk, v in
                  connectivity(out).items()}
             rps = _st.median(rates)
@@ -243,7 +247,7 @@ def main() -> None:
         # at 2^20 — fault the TPU worker
         # (scripts/repro_scamp_dense_fault.py pins it, ROADMAP 1d);
         # the capped launches soak clean (1000+ rounds at both shapes)
-        for n, rnds in ((1 << 12, 2000), (1 << 16, 200), (1 << 20, 100)):
+        for n, rnds in ((1 << 12, 2000), (1 << 16, 200), (1 << 20, 200)):
             if args.quick:
                 rnds = min(rnds, 200)
             cfg = pt.Config(n_nodes=n)
@@ -330,16 +334,21 @@ def main() -> None:
                          f"track<=5={lag_ok:.2f},{cadence}churn=0.01"])
             print(f"{'pt_dense_' + str(n_):28s} N={n_:<7d} "
                   f"{rps:9.1f} rounds/s  (track={lag_ok:.2f})")
-            if cov_ok_:
-                cov_r, cov = coverage_rounds(hv0_, cfg_, max_rounds=64)
-                rows.append([f"pt_dense_cov_{n_}", n_, cov_r, 0, 0,
-                             f"coverage={cov:.4f},"
-                             f"rounds_to_full={cov_r}"])
-                print(f"{'pt_dense_cov_' + str(n_):28s} N={n_:<7d} "
-                      f"full coverage in {cov_r} rounds")
-            else:
-                print(f"WARN: N={n_} overlay failed to connect; "
-                      f"skipping the coverage row")
+            # measure coverage regardless and report the honest
+            # fraction: at 2^16+/1M a 10^-4 sliver of the overlay can
+            # still be re-attaching after the heal window (absorbing
+            # saturated islands, an equilibrium the reference shares),
+            # and skipping the row entirely hid the broadcast-depth
+            # number the row exists to record
+            if not cov_ok_:
+                print(f"WARN: N={n_} overlay not fully connected; "
+                      f"coverage fraction below reflects it")
+            cov_r, cov = coverage_rounds(hv0_, cfg_, max_rounds=64)
+            rows.append([f"pt_dense_cov_{n_}", n_, cov_r, 0, 0,
+                         f"coverage={cov:.4f},"
+                         f"rounds_to_full={cov_r}"])
+            print(f"{'pt_dense_cov_' + str(n_):28s} N={n_:<7d} "
+                  f"coverage {cov:.4f} in {cov_r} rounds")
 
         pt_bench(
             n, cfg, hv0, cov_ok,
@@ -363,13 +372,12 @@ def main() -> None:
         rnds16 = blocks16 * 2 * k
         cfg16 = pt.Config(n_nodes=n16)
         hv0 = run_dense_staggered(dense_init(cfg16), 30, cfg16, 0.01, k)
-        hv0 = run_dense(hv0, 20, cfg16)          # heal for coverage
+        hv0 = run_dense(hv0, 60, cfg16)          # heal for coverage
         cov_ok16 = bool(np.asarray(connectivity(hv0)["connected"]))
         for _ in range(3):
             if cov_ok16:
                 break
-            hv0 = run_dense_staggered(hv0, 10, cfg16, 0.01, k)
-            hv0 = run_dense(hv0, 20, cfg16)
+            hv0 = run_dense(hv0, 60, cfg16)      # more heal, no damage
             cov_ok16 = bool(np.asarray(connectivity(hv0)["connected"]))
         pt_bench(
             n16, cfg16, hv0, cov_ok16,
@@ -392,8 +400,14 @@ def main() -> None:
             cfg20 = pt.Config(n_nodes=n20)
             hv0 = run_dense_staggered(dense_init(cfg20), 20, cfg20,
                                       0.01, k)
-            hv0 = run_dense(hv0, 20, cfg20)    # heal for coverage
+            hv0 = run_dense(hv0, 60, cfg20)    # heal for coverage
             cov_ok20 = bool(np.asarray(connectivity(hv0)["connected"]))
+            for _ in range(2):
+                if cov_ok20:
+                    break
+                hv0 = run_dense(hv0, 60, cfg20)
+                cov_ok20 = bool(
+                    np.asarray(connectivity(hv0)["connected"]))
             pt_bench(
                 n20, cfg20, hv0, cov_ok20,
                 lambda t: run_dense_staggered(
