@@ -166,6 +166,19 @@ def refuse_tpu_shape_bug(n_nodes: int, what: str,
             f"jaxlib.")
 
 
+# Per-LAUNCH scan-length caps for the dense programs on TPU — the
+# workaround for the scan-length-sensitive worker-fault family the
+# refuse_tpu_shape_bug gate documents (full history at the re-export
+# site in scamp_dense.py): single launches of <= 100 scanned rounds
+# are validated clean at N <= 2^16, <= 50 at N <= 2^20.
+LAUNCH_CAP = 100
+LAUNCH_CAP_BIG = 50
+
+
+def launch_cap_for(n_nodes: int) -> int:
+    return LAUNCH_CAP if n_nodes <= (1 << 16) else LAUNCH_CAP_BIG
+
+
 def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
     """views[idx] with idx < 0 yielding an all-empty row."""
     n = views.shape[0]
@@ -546,6 +559,21 @@ def run_dense_staggered(state: DenseHvState, n_blocks: int, cfg: Config,
     return staggered_scan(bodies, state, n_blocks, k)
 
 
+def run_dense_staggered_chunked(state: DenseHvState, n_blocks: int,
+                                cfg: Config, churn: float = 0.0,
+                                k: int = 5) -> DenseHvState:
+    """run_dense_staggered in launches of whole 2k-round blocks, at
+    most launch_cap_for(N) rounds per launch — the bounded-launch
+    shape for probing N beyond the single-launch-validated 2^20."""
+    cap_blocks = max(1, launch_cap_for(cfg.n_nodes) // (2 * k))
+    done = 0
+    while done < n_blocks:
+        b = min(cap_blocks, n_blocks - done)
+        state = run_dense_staggered(state, b, cfg, churn, k)
+        done += b
+    return state
+
+
 def staggered_programs(cfg: Config, churn: float, k: int):
     """(heavy_promote+shuffle, heavy_promote, light) round programs of
     the staggered cadence, plus its exactness precondition — the ONE
@@ -592,36 +620,79 @@ def staggered_scan(bodies, carry, n_blocks: int, k: int):
 
 # ------------------------------------------------------------- health
 
+def _hv_expand(active: jax.Array, alive: jax.Array,
+               r: jax.Array) -> jax.Array:
+    """One BFS hop over the active overlay (live nodes only)."""
+    n = active.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    nb = _gather_rows(active, jnp.where(r, ids, -1))  # rows of reached
+    hit = jnp.zeros((n,), bool).at[
+        jnp.clip(nb, 0, n - 1)].max(nb >= 0, mode="drop")
+    return r | (hit & alive)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _hv_expand_hops(active: jax.Array, alive: jax.Array, r: jax.Array,
+                    hops: int) -> Tuple[jax.Array, jax.Array]:
+    out = r
+    for _ in range(hops):
+        out = _hv_expand(active, alive, out)
+    return out, jnp.any(out != r)
+
+
 @jax.jit
+def _hv_reach_fused(state: DenseHvState) -> jax.Array:
+    """BFS via gather-OR to FIXPOINT (while_loop): one hop per
+    iteration, stop when the reached set stops growing (a capped loop
+    would misreport long-diameter degraded overlays as disconnected)."""
+    active, alive = state.active, state.alive
+    n = active.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    start = jnp.argmax(alive).astype(jnp.int32)  # some live node
+    reach0 = ids == start
+
+    def body(c):
+        r, _ = c
+        r2 = _hv_expand(active, alive, r)
+        return r2, jnp.any(r2 != r)
+
+    reach, _ = jax.lax.while_loop(lambda c: c[1], body,
+                                  (reach0, jnp.bool_(True)))
+    return reach
+
+
+def _reach(state: DenseHvState) -> jax.Array:
+    """Fused while_loop BFS up to 2^20 (validated); beyond, the fused
+    health program is in the same worker-fault family the scamp BFS
+    hit at [2^20, 166] (scamp_dense.scamp_health), so the walk is
+    host-driven in 8-hop jitted launches to a fixpoint."""
+    n = state.active.shape[0]
+    if n <= (1 << 20):
+        return _hv_reach_fused(state)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    r = ids == jnp.argmax(state.alive).astype(jnp.int32)
+    for _ in range(16):
+        r, changed = _hv_expand_hops(state.active, state.alive, r, 8)
+        if not bool(changed):
+            break
+    return r
+
+
 def connectivity(state: DenseHvState) -> Dict[str, jax.Array]:
     """On-device health: BFS reachability over the active overlay from
     node 0 (restricted to live nodes), symmetry rate, view-size stats —
     the hyparview_membership_check (test/partisan_SUITE.erl:2044-2109)
     as array reductions."""
+    reach = _reach(state)
+    return _hv_stats(state, reach)
+
+
+@jax.jit
+def _hv_stats(state: DenseHvState, reach: jax.Array
+              ) -> Dict[str, jax.Array]:
     active, alive = state.active, state.alive
     n = active.shape[0]
     ids = jnp.arange(n, dtype=jnp.int32)
-    # BFS via gather-OR to FIXPOINT: one hop per iteration, stop when
-    # the reached set stops growing (a capped loop would misreport
-    # long-diameter degraded overlays as disconnected)
-    start = jnp.argmax(alive).astype(jnp.int32)  # some live node
-    reach0 = ids == start
-
-    def expand(r):
-        nb = _gather_rows(active, jnp.where(r, ids, -1))  # rows of reached
-        hit = jnp.zeros((n,), bool).at[
-            jnp.clip(nb, 0, n - 1)].max(nb >= 0, mode="drop")
-        return r | (hit & alive)
-
-    def cond(c):
-        return c[1]
-
-    def body(c):
-        r, _ = c
-        r2 = expand(r)
-        return r2, jnp.any(r2 != r)
-
-    reach, _ = jax.lax.while_loop(cond, body, (reach0, jnp.bool_(True)))
     peer_rows = _gather_rows(active, active)
     mutual = jnp.any(peer_rows == ids[:, None, None], axis=-1)
     occ = active >= 0

@@ -45,9 +45,8 @@ from flax import struct
 
 from ..config import Config
 from .hyparview_dense import (refuse_tpu_shape_bug, DenseHvState,
-                              make_dense_round, staggered_programs,
-                              staggered_scan)
-from .scamp_dense import launch_cap_for
+                              launch_cap_for, make_dense_round,
+                              staggered_programs, staggered_scan)
 
 
 @struct.dataclass

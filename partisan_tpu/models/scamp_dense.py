@@ -444,9 +444,11 @@ def _run_dense_scamp_launch(st: DenseScampState, n_rounds: int,
     return out
 
 
-# Per-LAUNCH scan-length cap — defense-in-depth against a
-# program-shape-sensitive XLA/TPU bug this module has now hit in THREE
-# shapes (scripts/repro_scamp_dense_fault.py):
+# Per-LAUNCH scan-length caps — shared across the dense programs; the
+# constants and launch_cap_for live in hyparview_dense (next to the
+# refuse_tpu_shape_bug gate) and are re-exported here for the callers
+# that learned them at this address.  History of the bug this bounds
+# (scripts/repro_scamp_dense_fault.py):
 #   * round-3 shape: worker "kernel fault" beyond ~50 scanned rounds;
 #   * round-4 mid shape (one _spawn_walks + instant scrub): clean at
 #     100, faulted at ~200 — and a neighboring ablation variant
@@ -462,12 +464,8 @@ def _run_dense_scamp_launch(st: DenseScampState, n_rounds: int,
 # (the carried state is identical) and costs one host round-trip per
 # launch, so the cap stays and TIGHTENS with shape: 100 up to 2^16
 # (validated round 4), 50 above (validated at 2^20 round 5).
-LAUNCH_CAP = 100
-LAUNCH_CAP_BIG = 50
-
-
-def launch_cap_for(n_nodes: int) -> int:
-    return LAUNCH_CAP if n_nodes <= (1 << 16) else LAUNCH_CAP_BIG
+from .hyparview_dense import (LAUNCH_CAP, LAUNCH_CAP_BIG,  # noqa: F401
+                              launch_cap_for)
 
 
 def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,

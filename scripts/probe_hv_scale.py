@@ -1,0 +1,58 @@
+"""Probe dense HyParView beyond the 2^20 headline shape (2^21, 2^22):
+staggered cadence in bounded launches (launch_cap_for), churn 1%/round,
+then a churn-free heal and the hop-chunked connectivity readback.
+
+The dense SCAMP/plumtree planes are gated at 2^20 (largest validated
+shape); the bare HyParView plane has no known fault, but every shape
+step so far has found one eventually — this probe is how the next row
+gets validated before any gate moves.
+
+Run:  python scripts/probe_hv_scale.py [log2_n=21] [blocks=10] [--time]
+"""
+import argparse
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, '.')
+from partisan_tpu.config import Config
+from partisan_tpu.models.hyparview_dense import (
+    connectivity, dense_init, run_dense, run_dense_staggered_chunked)
+
+ap = argparse.ArgumentParser()
+ap.add_argument("log2_n", nargs="?", type=int, default=21)
+ap.add_argument("blocks", nargs="?", type=int, default=10)
+ap.add_argument("--time", action="store_true",
+                help="3 timed reseeded trials after the probe")
+args = ap.parse_args()
+
+cfg = Config(n_nodes=1 << args.log2_n, seed=7)
+k = 5
+rounds = args.blocks * 2 * k
+print(f"device={jax.devices()[0]} n={cfg.n_nodes} rounds={rounds} "
+      f"(chunked staggered, cap={50})", flush=True)
+w = dense_init(cfg)
+w.active.block_until_ready()
+t0 = time.perf_counter()
+w = run_dense_staggered_chunked(w, args.blocks, cfg, 0.01, k)
+float(jnp.sum(w.active))
+print(f"churn run: {rounds / (time.perf_counter() - t0):.1f} rounds/s "
+      f"(incl. compile)", flush=True)
+w = run_dense(w, 60, cfg)
+h = {kk: float(np.asarray(v)) for kk, v in connectivity(w).items()}
+print(f"health: {h}", flush=True)
+if args.time:
+    import statistics
+    rates = []
+    for t in range(3):
+        w0 = dense_init(cfg.replace(seed=11 + 13 * t))
+        t0 = time.perf_counter()
+        out = run_dense_staggered_chunked(w0, args.blocks, cfg, 0.01, k)
+        float(jnp.sum(out.active))
+        rates.append(rounds / (time.perf_counter() - t0))
+    print(f"median rate: {statistics.median(rates):.1f} rounds/s "
+          f"({[round(r, 1) for r in rates]})", flush=True)
+print("clean exit", flush=True)
