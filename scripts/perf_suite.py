@@ -344,11 +344,13 @@ def main() -> None:
                 print(f"WARN: N={n_} overlay not fully connected; "
                       f"coverage fraction below reflects it")
             cov_r, cov = coverage_rounds(hv0_, cfg_, max_rounds=64)
+            # .6f: at 2^16+ a 2-node absorbing island reads 0.99997 —
+            # 4 decimals rounded that up to a false "1.0000"
             rows.append([f"pt_dense_cov_{n_}", n_, cov_r, 0, 0,
-                         f"coverage={cov:.4f},"
+                         f"coverage={cov:.6f},"
                          f"rounds_to_full={cov_r}"])
             print(f"{'pt_dense_cov_' + str(n_):28s} N={n_:<7d} "
-                  f"coverage {cov:.4f} in {cov_r} rounds")
+                  f"coverage {cov:.6f} in {cov_r} rounds")
 
         pt_bench(
             n, cfg, hv0, cov_ok,
