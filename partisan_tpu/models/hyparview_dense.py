@@ -169,14 +169,21 @@ def refuse_tpu_shape_bug(n_nodes: int, what: str,
 # Per-LAUNCH scan-length caps for the dense programs on TPU — the
 # workaround for the scan-length-sensitive worker-fault family the
 # refuse_tpu_shape_bug gate documents (full history at the re-export
-# site in scamp_dense.py): single launches of <= 100 scanned rounds
-# are validated clean at N <= 2^16, <= 50 at N <= 2^20.
+# site in scamp_dense.py).  Validated clean per shape
+# (scripts/probe_hv_scale.py, scripts/repro_scamp_dense_fault.py):
+# <= 100 scanned rounds at N <= 2^16, <= 50 at N <= 2^21, <= 25 at
+# 2^22 (where a 50-round churn-free flat launch faults).
 LAUNCH_CAP = 100
 LAUNCH_CAP_BIG = 50
+LAUNCH_CAP_HUGE = 25
 
 
 def launch_cap_for(n_nodes: int) -> int:
-    return LAUNCH_CAP if n_nodes <= (1 << 16) else LAUNCH_CAP_BIG
+    if n_nodes <= (1 << 16):
+        return LAUNCH_CAP
+    if n_nodes <= (1 << 21):
+        return LAUNCH_CAP_BIG
+    return LAUNCH_CAP_HUGE
 
 
 def _gather_rows(views: jax.Array, idx: jax.Array) -> jax.Array:
@@ -559,6 +566,21 @@ def run_dense_staggered(state: DenseHvState, n_blocks: int, cfg: Config,
     return staggered_scan(bodies, state, n_blocks, k)
 
 
+def run_dense_chunked(state: DenseHvState, n_rounds: int, cfg: Config,
+                      churn: float = 0.0) -> DenseHvState:
+    """run_dense in launches of at most launch_cap_for(N) scanned
+    rounds — the bounded-launch shape for N beyond 2^20 (a 60-round
+    single-launch heal faulted the worker at 2^22;
+    scripts/probe_hv_scale.py)."""
+    cap = launch_cap_for(cfg.n_nodes)
+    done = 0
+    while done < n_rounds:
+        step_n = min(cap, n_rounds - done)
+        state = run_dense(state, step_n, cfg, churn)
+        done += step_n
+    return state
+
+
 def run_dense_staggered_chunked(state: DenseHvState, n_blocks: int,
                                 cfg: Config, churn: float = 0.0,
                                 k: int = 5) -> DenseHvState:
@@ -665,14 +687,18 @@ def _reach(state: DenseHvState) -> jax.Array:
     """Fused while_loop BFS up to 2^20 (validated); beyond, the fused
     health program is in the same worker-fault family the scamp BFS
     hit at [2^20, 166] (scamp_dense.scamp_health), so the walk is
-    host-driven in 8-hop jitted launches to a fixpoint."""
+    host-driven in bounded jitted launches to a fixpoint.  The launch
+    size shrinks with shape like the round caps do: 8 hops/launch at
+    2^21 (validated), 2 beyond (8 unrolled hops at 2^22 faulted the
+    worker — scripts/probe_hv_scale.py)."""
     n = state.active.shape[0]
     if n <= (1 << 20):
         return _hv_reach_fused(state)
+    hops = 8 if n <= (1 << 21) else 2
     ids = jnp.arange(n, dtype=jnp.int32)
     r = ids == jnp.argmax(state.alive).astype(jnp.int32)
-    for _ in range(16):
-        r, changed = _hv_expand_hops(state.active, state.alive, r, 8)
+    for _ in range(128 // hops):
+        r, changed = _hv_expand_hops(state.active, state.alive, r, hops)
         if not bool(changed):
             break
     return r

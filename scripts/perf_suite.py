@@ -175,7 +175,8 @@ def main() -> None:
         # plane hot; health asserts the overlay heals once churn stops.
         import statistics as _st
         from partisan_tpu.models.hyparview_dense import (
-            connectivity, dense_init, run_dense, run_dense_staggered)
+            connectivity, dense_init, run_dense, run_dense_chunked,
+            run_dense_staggered, run_dense_staggered_chunked)
         def hv_bench(name, n, total_rounds, cfg, run_trial, cadence):
             """Shared hv_dense timing discipline (one copy for the
             flat continuity row AND the staggered sweep): warmup
@@ -196,7 +197,8 @@ def main() -> None:
             # the staggered cadence accrues more un-repaired damage
             # than the flat program did, and 20 rounds left a
             # 10^-4-fraction of 2^16/2^20 nodes still re-attaching
-            out = run_dense(out, 60, cfg)
+            # (chunked: a 60-round flat launch faults at 2^22)
+            out = run_dense_chunked(out, 60, cfg)
             h = {kk: float(np.asarray(v)) for kk, v in
                  connectivity(out).items()}
             rps = _st.median(rates)
@@ -219,8 +221,11 @@ def main() -> None:
                          random_promotion_interval=2)
         hv_bench("hv_dense_flat_4096", n, rnds, fcfg,
                  lambda w: run_dense(w, rnds, fcfg, 0.01), "flat4/2")
-        # official rows: staggered, reference cadence
-        sweep = [(1 << 12, 2000), (1 << 16, 500), (1 << 20, 200)]
+        # official rows: staggered, reference cadence.  2^21/2^22
+        # (round 5): the same program in launch_cap_for-bounded
+        # launches — 2M and 4M simulated nodes on ONE chip
+        sweep = [(1 << 12, 2000), (1 << 16, 500), (1 << 20, 200),
+                 (1 << 21, 100), (1 << 22, 100)]
         k = 5
         for n, rnds in sweep:
             if args.quick:
@@ -230,8 +235,8 @@ def main() -> None:
             cfg = pt.Config(n_nodes=n)
             hv_bench(
                 f"hv_dense_{n}", n, total, cfg,
-                lambda w, blocks=blocks, cfg=cfg: run_dense_staggered(
-                    w, blocks, cfg, 0.01, k),
+                lambda w, blocks=blocks, cfg=cfg:
+                    run_dense_staggered_chunked(w, blocks, cfg, 0.01, k),
                 f"ref10/5k{k}")
 
     if want("scamp_dense") and jax.devices()[0].platform == "tpu":

@@ -20,14 +20,21 @@ import numpy as np
 sys.path.insert(0, '.')
 from partisan_tpu.config import Config
 from partisan_tpu.models.hyparview_dense import (
-    connectivity, dense_init, run_dense, run_dense_staggered_chunked)
+    connectivity, dense_init, run_dense_chunked,
+    run_dense_staggered_chunked)
 
 ap = argparse.ArgumentParser()
 ap.add_argument("log2_n", nargs="?", type=int, default=21)
 ap.add_argument("blocks", nargs="?", type=int, default=10)
 ap.add_argument("--time", action="store_true",
                 help="3 timed reseeded trials after the probe")
+ap.add_argument("--cap", type=int, default=None,
+                help="override LAUNCH_CAP_BIG (rounds per launch)")
 args = ap.parse_args()
+
+if args.cap is not None:
+    from partisan_tpu.models import hyparview_dense as _hvd
+    _hvd.LAUNCH_CAP_BIG = args.cap
 
 cfg = Config(n_nodes=1 << args.log2_n, seed=7)
 k = 5
@@ -41,7 +48,9 @@ w = run_dense_staggered_chunked(w, args.blocks, cfg, 0.01, k)
 float(jnp.sum(w.active))
 print(f"churn run: {rounds / (time.perf_counter() - t0):.1f} rounds/s "
       f"(incl. compile)", flush=True)
-w = run_dense(w, 60, cfg)
+w = run_dense_chunked(w, 60, cfg)
+float(jnp.sum(w.active))                 # sync: localize any fault here
+print("heal done", flush=True)
 h = {kk: float(np.asarray(v)) for kk, v in connectivity(w).items()}
 print(f"health: {h}", flush=True)
 if args.time:
