@@ -186,13 +186,19 @@ def main() -> None:
             stops), connectivity health row."""
             warm = run_trial(dense_init(cfg))
             float(jnp.sum(warm.active))          # compile + real sync
-            rates = []
+            # same memory discipline as the scamp block: at 2^22 the
+            # overlay planes + staggered sort temporaries OOM with a
+            # third state live
+            del warm
+            rates, out = [], None
             for t in range(3):
                 w0 = dense_init(cfg.replace(seed=11 + 13 * t))
+                out = None                       # free previous trial
                 t0 = time.perf_counter()
                 out = run_trial(w0)
                 float(jnp.sum(out.active))                    # sync
                 rates.append(total_rounds / (time.perf_counter() - t0))
+                del w0
             # heal window: 60 churn-free every-round-repair rounds —
             # the staggered cadence accrues more un-repaired damage
             # than the flat program did, and 20 rounds left a
