@@ -132,11 +132,14 @@ def run_pt_dense(hv: DenseHvState, pt: PtDense, n_rounds: int,
     ROADMAP 1d / scripts/repro_pt_dense_fault.py — the bare
     dense-HyParView scan runs 2^20 clean, so the trigger is in the
     added broadcast planes' composition), but launches of at most
-    launch_cap_for(N)=50 scanned rounds run 2^20 clean (round-5 probe,
-    same scan-length sensitivity as the SCAMP plane).  The gate admits
-    2^20 only for capped launches — use :func:`run_pt_dense_chunked`
-    there; loudly refuse rather than crash the chip."""
-    limit = (1 << 20) if n_rounds <= launch_cap_for(cfg.n_nodes) \
+    launch_cap_for(N)=50 scanned rounds run 2^20 AND 2^21 clean
+    (round-5 probes, same scan-length sensitivity as the SCAMP plane).
+    The gate admits them only for capped launches — use
+    :func:`run_pt_dense_chunked` there; loudly refuse rather than
+    crash the chip.  (Dense SCAMP cannot follow past 2^20: its four
+    [N, ~170] stamp/view planes OOM the chip at 2^21 — a memory wall,
+    not the fault family.)"""
+    limit = (1 << 21) if n_rounds <= launch_cap_for(cfg.n_nodes) \
         else (1 << 16)
     refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree", limit=limit)
     hv_step = make_dense_round(cfg, churn)
@@ -167,7 +170,7 @@ def run_pt_dense_staggered(hv: DenseHvState, pt: PtDense, n_blocks: int,
     HyParView whose shuffle/promotion timers fire at 10 s / 5 s.  Runs
     n_blocks * 2k rounds (same launch-length gate as run_pt_dense —
     chunk via :func:`run_pt_dense_staggered_chunked` at N > 2^16)."""
-    limit = (1 << 20) if n_blocks * 2 * k <= launch_cap_for(cfg.n_nodes) \
+    limit = (1 << 21) if n_blocks * 2 * k <= launch_cap_for(cfg.n_nodes) \
         else (1 << 16)
     refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree", limit=limit)
     pt_step = make_pt_dense_round(cfg, root=root, broadcast_interval=5)

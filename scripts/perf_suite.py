@@ -401,34 +401,40 @@ def main() -> None:
                 hv_, pt0, blocks16, cfg16, 0.01, 0, k),
             rnds16, "cadence=ref10/5k5,")
 
-        # round 5: broadcast at 2^20 — the fused program runs clean in
-        # <=50-round launches (scripts/repro_pt_dense_fault.py), so the
-        # 1M-node row rides run_pt_dense_staggered_chunked
+        # round 5: broadcast at 2^20 and 2^21 — the fused program
+        # runs clean in <=50-round launches at both shapes
+        # (scripts/repro_pt_dense_fault.py), so the big-N rows ride
+        # run_pt_dense_staggered_chunked (SCAMP cannot follow past
+        # 2^20: its stamp/view planes hit a memory wall at 2^21)
         if not args.quick:
+            from partisan_tpu.models.hyparview_dense import (
+                run_dense_chunked, run_dense_staggered_chunked)
             from partisan_tpu.models.plumtree_dense import (
                 run_pt_dense_staggered_chunked)
-            n20 = 1 << 20
-            blocks20 = 10                      # 100 rounds
-            rnds20 = blocks20 * 2 * k
-            cfg20 = pt.Config(n_nodes=n20)
-            hv0 = run_dense_staggered(dense_init(cfg20), 20, cfg20,
-                                      0.01, k)
-            hv0 = run_dense(hv0, 60, cfg20)    # heal for coverage
-            cov_ok20 = bool(np.asarray(connectivity(hv0)["connected"]))
-            for _ in range(2):
-                if cov_ok20:
-                    break
-                hv0 = run_dense(hv0, 60, cfg20)
-                cov_ok20 = bool(
+            for nbig in (1 << 20, 1 << 21):
+                blocksb = 10                      # 100 rounds
+                rndsb = blocksb * 2 * k
+                cfgb = pt.Config(n_nodes=nbig)
+                hv0 = run_dense_staggered_chunked(
+                    dense_init(cfgb), 20, cfgb, 0.01, k)
+                hv0 = run_dense_chunked(hv0, 60, cfgb)  # heal for cov
+                cov_okb = bool(
                     np.asarray(connectivity(hv0)["connected"]))
-            pt_bench(
-                n20, cfg20, hv0, cov_ok20,
-                lambda t: run_dense_staggered(
-                    dense_init(cfg20.replace(seed=23 + 7 * t)), 20,
-                    cfg20, 0.01, k),
-                lambda hv_, pt0: run_pt_dense_staggered_chunked(
-                    hv_, pt0, blocks20, cfg20, 0.01, 0, k),
-                rnds20, "cadence=ref10/5k5,")
+                for _ in range(2):
+                    if cov_okb:
+                        break
+                    hv0 = run_dense_chunked(hv0, 60, cfgb)
+                    cov_okb = bool(
+                        np.asarray(connectivity(hv0)["connected"]))
+                pt_bench(
+                    nbig, cfgb, hv0, cov_okb,
+                    lambda t, cfgb=cfgb: run_dense_staggered_chunked(
+                        dense_init(cfgb.replace(seed=23 + 7 * t)), 20,
+                        cfgb, 0.01, k),
+                    lambda hv_, pt0, cfgb=cfgb, blocksb=blocksb:
+                        run_pt_dense_staggered_chunked(
+                            hv_, pt0, blocksb, cfgb, 0.01, 0, k),
+                    rndsb, "cadence=ref10/5k5,")
 
     if want("echo"):
         # the reference's performance_test proper: SIZE x CONCURRENCY x RTT

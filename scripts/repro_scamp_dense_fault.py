@@ -57,7 +57,13 @@ launch_cap_for(N)=50 is a complete production workaround; no C /
 K_SWEEP / skip ablation was needed.  The same recipe clears the fused
 plumtree plane (scripts/repro_pt_dense_fault.py: staggered 4x50
 clean at 2^20 where one long scan faulted).  make_dense_scamp_round's
-gate now admits N<=2^20; beyond 2^20 remains unprobed and gated.
+gate now admits N<=2^20.  Beyond: 2^21 is a MEMORY wall, not the
+fault family — RESOURCE_EXHAUSTED at init (four [N, 174] int32
+stamp/view planes = ~5.8 GB/state; the sweep needs two states + sort
+temporaries).  Shrinking the stamp planes (uint16 wrapping rounds) is
+the lever if 2M-node SCAMP is ever needed; HyParView and plumtree,
+whose planes are ~6x smaller, run 2^21-2^22 (probe_hv_scale.py,
+repro_pt_dense_fault.py).
 
 Run:  python scripts/repro_scamp_dense_fault.py [rounds [log2_n]]
           [--c C] [--ksweep K] [--skip churn,admit,inview]
