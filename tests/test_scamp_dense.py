@@ -269,3 +269,23 @@ class TestStampSweepContract:
             f"live re-admitted subscription swept from in_view: {iv_s}")
         slot = int(np.nonzero(iv_s == h)[0][0])
         assert int(np.asarray(st.ivstamp[s, slot])) >= r0
+
+
+class TestChunkedLaunches:
+    def test_chunked_matches_single_launch(self):
+        """launch_cap_for chunking (the shape that unlocks 2^20 on
+        TPU) is semantically invisible: a 120-round run split 100+20
+        carries state identical to one 120-round launch.  (Chip-side,
+        the walker counts at matching boundaries were identical across
+        25- and 50-round chunkings — scripts/repro_scamp_dense_fault.py
+        RESULTS.)"""
+        import numpy as np
+        from partisan_tpu.models.scamp_dense import (
+            _run_dense_scamp_launch, dense_scamp_init, run_dense_scamp)
+        cfg = pt.Config(n_nodes=64, seed=9)
+        s0 = dense_scamp_init(cfg)
+        one = _run_dense_scamp_launch(s0, 120, cfg, 0.02, ())
+        chunked = run_dense_scamp(s0, 120, cfg, 0.02)
+        assert (np.asarray(one.partial) == np.asarray(chunked.partial)).all()
+        assert (np.asarray(one.walk_pos) == np.asarray(chunked.walk_pos)).all()
+        assert (np.asarray(one.in_view) == np.asarray(chunked.in_view)).all()
