@@ -587,7 +587,13 @@ def run_dense_staggered_chunked(state: DenseHvState, n_blocks: int,
     """run_dense_staggered in launches of whole 2k-round blocks, at
     most launch_cap_for(N) rounds per launch — the bounded-launch
     shape for probing N beyond the single-launch-validated 2^20."""
-    cap_blocks = max(1, launch_cap_for(cfg.n_nodes) // (2 * k))
+    cap = launch_cap_for(cfg.n_nodes)
+    # one block is 2k rounds; if a single block exceeds the cap the
+    # "chunked" runner would silently launch past the validated length
+    assert 2 * k <= cap, (
+        f"staggered block of 2k={2 * k} rounds exceeds the validated "
+        f"launch cap {cap} at N={cfg.n_nodes}; lower k")
+    cap_blocks = max(1, cap // (2 * k))
     done = 0
     while done < n_blocks:
         b = min(cap_blocks, n_blocks - done)
@@ -683,6 +689,30 @@ def _hv_reach_fused(state: DenseHvState) -> jax.Array:
     return reach
 
 
+def bounded_bfs(expand_hops, alive: jax.Array, n: int,
+                hops: int) -> jax.Array:
+    """Host-driven BFS to FIXPOINT in bounded jitted launches — the
+    shared driver for the big-N health paths (this module's _reach and
+    scamp_dense.scamp_health), where the fused while_loop BFS is in
+    the worker-fault family.  ``expand_hops(r, hops) -> (r2, changed)``
+    must be a bounded-launch jitted walk.  Runs until the reached set
+    stops growing; raises loudly if the safety bound is exhausted
+    rather than silently misreporting connectivity (the misreport the
+    fused fixpoint loop exists to prevent)."""
+    ids = jnp.arange(n, dtype=jnp.int32)
+    r = ids == jnp.argmax(alive).astype(jnp.int32)
+    # safety bound: diameter can never exceed n, but a healthy overlay
+    # converges in O(log n) launches — 4096 hops total is far past any
+    # real fixpoint and only guards against a cyclic-expand bug
+    for _ in range(max(1, 4096 // hops)):
+        r, changed = expand_hops(r, hops)
+        if not bool(changed):
+            return r
+    raise RuntimeError(
+        f"bounded_bfs: no fixpoint within 4096 hops at n={n} — "
+        f"refusing to report connectivity from a truncated walk")
+
+
 def _reach(state: DenseHvState) -> jax.Array:
     """Fused while_loop BFS up to 2^20 (validated); beyond, the fused
     health program is in the same worker-fault family the scamp BFS
@@ -695,13 +725,9 @@ def _reach(state: DenseHvState) -> jax.Array:
     if n <= (1 << 20):
         return _hv_reach_fused(state)
     hops = 8 if n <= (1 << 21) else 2
-    ids = jnp.arange(n, dtype=jnp.int32)
-    r = ids == jnp.argmax(state.alive).astype(jnp.int32)
-    for _ in range(128 // hops):
-        r, changed = _hv_expand_hops(state.active, state.alive, r, hops)
-        if not bool(changed):
-            break
-    return r
+    return bounded_bfs(
+        lambda r, h: _hv_expand_hops(state.active, state.alive, r, h),
+        state.alive, n, hops)
 
 
 def connectivity(state: DenseHvState) -> Dict[str, jax.Array]:

@@ -213,7 +213,12 @@ def run_pt_dense_staggered_chunked(hv: DenseHvState, pt: PtDense,
                                    ) -> Tuple[DenseHvState, PtDense]:
     """run_pt_dense_staggered in launches of whole 2k-round blocks,
     at most launch_cap_for(N) rounds per launch."""
-    cap_blocks = max(1, launch_cap_for(cfg.n_nodes) // (2 * k))
+    cap = launch_cap_for(cfg.n_nodes)
+    # same overflow guard as hyparview_dense.run_dense_staggered_chunked
+    assert 2 * k <= cap, (
+        f"staggered block of 2k={2 * k} rounds exceeds the validated "
+        f"launch cap {cap} at N={cfg.n_nodes}; lower k")
+    cap_blocks = max(1, cap // (2 * k))
     done = 0
     while done < n_blocks:
         b = min(cap_blocks, n_blocks - done)

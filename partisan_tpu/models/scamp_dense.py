@@ -438,6 +438,14 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
 def _run_dense_scamp_launch(st: DenseScampState, n_rounds: int,
                             cfg: Config, churn: float,
                             skip: Tuple[str, ...]) -> DenseScampState:
+    # launch-length-conditioned gate (the plumtree runners' pattern):
+    # a single scan LONGER than the validated cap at N > 2^16 is the
+    # documented-faulting shape — refuse it loudly even though
+    # make_dense_scamp_round's shape-only gate admits the N
+    limit = (1 << 20) if n_rounds <= launch_cap_for(cfg.n_nodes) \
+        else (1 << 16)
+    refuse_tpu_shape_bug(cfg.n_nodes, "dense SCAMP long scan",
+                         limit=limit)
     step = make_dense_scamp_round(cfg, churn, skip=skip)
     out, _ = jax.lax.scan(lambda s, _: (step(s), None), st, None,
                           length=n_rounds)
@@ -550,18 +558,14 @@ def scamp_health(st: DenseScampState) -> Dict[str, jax.Array]:
     At N > 2^16 the fused while_loop BFS is ITSELF a worker-faulting
     program shape at [N, P] (round-5 probe: the round scans run 2^20
     clean chunked, then the health readback crashed the worker) — the
-    same launch-bounding medicine applies: the BFS is host-driven in
-    8-hop jitted launches with a fixpoint check per launch."""
+    same launch-bounding medicine applies: the walk rides the shared
+    host-driven driver (hyparview_dense.bounded_bfs) in 8-hop jitted
+    launches to a fixpoint."""
+    from .hyparview_dense import bounded_bfs
     n = st.partial.shape[0]
     if n <= (1 << 16):
-        return {k: v for k, v in
-                _health_stats(st, _scamp_reach_fused(st)).items()}
-    ids = jnp.arange(n, dtype=jnp.int32)
-    r = ids == jnp.argmax(st.alive).astype(jnp.int32)
-    # overlay diameter ~ log N / log(mean view); cap generously — each
-    # iteration is 8 hops, and the fixpoint check ends the walk early
-    for _ in range(16):
-        r, changed = _expand_hops(st.partial, st.alive, r, 8)
-        if not bool(changed):
-            break
-    return _health_stats(st, r)
+        return _health_stats(st, _scamp_reach_fused(st))
+    reach = bounded_bfs(
+        lambda r, h: _expand_hops(st.partial, st.alive, r, h),
+        st.alive, n, 8)
+    return _health_stats(st, reach)

@@ -32,15 +32,21 @@ ap.add_argument("--cap", type=int, default=None,
                 help="override LAUNCH_CAP_BIG (rounds per launch)")
 args = ap.parse_args()
 
+from partisan_tpu.models import hyparview_dense as _hvd
+
 if args.cap is not None:
-    from partisan_tpu.models import hyparview_dense as _hvd
-    _hvd.LAUNCH_CAP_BIG = args.cap
+    # override EVERY tier the shape could hit — rebinding only
+    # LAUNCH_CAP_BIG silently ignored --cap at the 2^22+ tier
+    # (launch_cap_for reads the module globals at call time)
+    _hvd.LAUNCH_CAP = _hvd.LAUNCH_CAP_BIG = _hvd.LAUNCH_CAP_HUGE = \
+        args.cap
 
 cfg = Config(n_nodes=1 << args.log2_n, seed=7)
 k = 5
 rounds = args.blocks * 2 * k
 print(f"device={jax.devices()[0]} n={cfg.n_nodes} rounds={rounds} "
-      f"(chunked staggered, cap={50})", flush=True)
+      f"(chunked staggered, cap={_hvd.launch_cap_for(cfg.n_nodes)})",
+      flush=True)
 w = dense_init(cfg)
 w.active.block_until_ready()
 t0 = time.perf_counter()
