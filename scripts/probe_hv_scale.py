@@ -2,10 +2,20 @@
 staggered cadence in bounded launches (launch_cap_for), churn 1%/round,
 then a churn-free heal and the hop-chunked connectivity readback.
 
-The dense SCAMP/plumtree planes are gated at 2^20 (largest validated
-shape); the bare HyParView plane has no known fault, but every shape
-step so far has found one eventually — this probe is how the next row
-gets validated before any gate moves.
+The dense SCAMP/plumtree planes are gated at 2^20/2^21 (largest
+validated shapes); the bare HyParView plane has no refuse gate, but
+every shape step so far has found a limit eventually — this probe is
+how the next row gets validated before any gate moves.
+
+Probed ladder (v5e, jax 0.9.0 axon, 2026-08-01):
+  2^21  clean at cap 50 (12.7 r/s staggered; official row)
+  2^22  clean at cap 25 + 2-hop BFS launches (3.3 r/s; official row)
+  2^23  COMPILE FAILURE — the remote TpuAotCompiler subprocess itself
+        exits 1 on the staggered program (HTTP 500 from
+        remote_compile; the compiler half of the ROADMAP-1d fault
+        family, like round 4's scatter_emitter SIGABRT).  No launch
+        cap can help a program that never compiles: 2^22 (4M nodes)
+        is the single-chip ceiling on this toolchain.
 
 Run:  python scripts/probe_hv_scale.py [log2_n=21] [blocks=10] [--time]
 """
