@@ -525,13 +525,19 @@ def main() -> None:
                   f"({msgs} echoes)")
 
     if want("rumor"):
-        # BASELINE #5: rumor fast path at 1e6 (the bench.py headline)
+        # BASELINE #5: rumor fast path at 1e6 (the bench.py headline).
+        # The timed seed must be FRESH per invocation, not merely
+        # different from the warmup: the tunnel's (executable, input)
+        # result cache persists across processes, and a fixed timed
+        # seed replayed a cached run as a bogus 600k-rounds/s row
+        # (round 5; bench.py's notes describe the same trap)
         n, rounds = 1_000_000, 1000
+        seed = int.from_bytes(os.urandom(4), "little") % n
         out = rumor_run(rumor_init(n, 0), rounds, n, 2, 1, 0.01)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
-        out = rumor_run(rumor_init(n, 7919), rounds, n, 2, 1, 0.01)
-        jax.block_until_ready(out)
+        out = rumor_run(rumor_init(n, seed), rounds, n, 2, 1, 0.01)
+        float(out.infected.mean())       # scalar readback = real sync
         dt = time.perf_counter() - t0
         rows.append(["rumor_mongering_1e6", n, rounds, round(dt, 4),
                      round(rounds / dt, 1),
@@ -548,8 +554,12 @@ def main() -> None:
         out = run_fn(rumor_pack(rumor_init(n, 0)))
         float(jnp.mean(jnp.bitwise_count(out.infected)))  # sync
         rates, frac = [], 0.0
+        # per-invocation salt: fixed trial seeds re-used across
+        # processes can hit the tunnel's persistent result cache (the
+        # rumor_mongering_1e6 row measured a replay as 600k rounds/s)
+        salt = int.from_bytes(os.urandom(4), "little")
         for t in range(3):
-            w0 = rumor_pack(rumor_init(n, (104729 * (t + 3)) % n))
+            w0 = rumor_pack(rumor_init(n, (104729 * (t + 3) + salt) % n))
             t0 = time.perf_counter()
             out = run_fn(w0)
             frac = float(jnp.mean(jnp.bitwise_count(out.infected) / 32.0))
