@@ -26,6 +26,7 @@ Prints exactly ONE JSON line:
 """
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from partisan_tpu.models.demers import rumor_init, rumor_run
+from partisan_tpu.telemetry.sinks import JsonlSink
 
 
 def main() -> None:
@@ -73,14 +75,26 @@ def main() -> None:
     # one run can still replay a previous invocation's execution as a
     # near-instant bogus trial (observed on the perf-suite's 1e6 row:
     # a fixed timed seed read back 600k rounds/s)
-    import os as _os
-    salt = int.from_bytes(_os.urandom(4), "little")
+    salt = int.from_bytes(os.urandom(4), "little")
+    # per-trial rows go through the telemetry JSONL sink so BENCH_*
+    # snapshots gain a per-trial artifact; stdout stays the one parsed
+    # JSON summary line (contract unchanged)
+    trial_sink = JsonlSink(
+        os.environ.get("PARTISAN_BENCH_JSONL", "BENCH_trials.jsonl"))
     for t in range(trials):
         w = rumor_init(n, (7919 * (t + 101) + salt) % n)
         t0 = time.perf_counter()
         out = rumor_run(w, rounds, n, fanout, 1, churn, variant)
         infected = float(jnp.mean(out.infected))   # scalar readback = sync
-        rates.append(rounds / (time.perf_counter() - t0))
+        dt = time.perf_counter() - t0
+        rates.append(rounds / dt)
+        trial_sink.write_row({
+            "trial": t, "seconds": dt, "rounds_per_sec": rounds / dt,
+            "rounds": rounds, "n": n, "churn": churn, "fanout": fanout,
+            "variant": variant, "infected": infected,
+            "device": jax.devices()[0].platform, "t_wall": time.time(),
+        })
+    trial_sink.close()
 
     rps = statistics.median(rates)
     result = {

@@ -552,9 +552,11 @@ def make_step(
 
         # -- split delayed messages out first so interposition and fault
         #    masks apply exactly once, at delivery time (not per held round)
+        inflight = jnp.sum(msgs.valid).astype(jnp.int32)
         held = msgs.replace(valid=msgs.valid & (msgs.delay > 0),
                             delay=jnp.maximum(msgs.delay - 1, 0))
         now = msgs.replace(valid=msgs.valid & (msgs.delay <= 0))
+        ready = jnp.sum(now.valid).astype(jnp.int32)
 
         # -- fault plane: crashed nodes neither send nor receive; messages
         #    crossing a partition boundary are dropped (hyparview partition
@@ -565,6 +567,7 @@ def make_step(
         same_part = (world.partition[jnp.clip(now.src, 0, N - 1)]
                      == world.partition[jnp.clip(now.dst, 0, N - 1)])
         now = now.replace(valid=now.valid & same_part)
+        re_held_ct = jnp.int32(0)
         if interpose_recv is not None:
             now = _interp(interpose_recv, now, rnd, world)
             # the '$delay' verb on the RECV side: a hook that bumps delay
@@ -575,6 +578,12 @@ def make_step(
                                   delay=jnp.maximum(now.delay - 1, 0))
             held = msgops.concat(held, re_held)
             now = now.replace(valid=now.valid & (now.delay <= 0))
+            re_held_ct = jnp.sum(re_held.valid).astype(jnp.int32)
+        # fault-plane drop count: crash masks + partitions + omission
+        # interposition (re-held delays are not drops) — the telemetry
+        # tap for "how much traffic did the fault plane eat this round"
+        fault_dropped = (ready - jnp.sum(now.valid).astype(jnp.int32)
+                         - re_held_ct)
 
         # -- connection lanes: partition-key hash or random spread over the
         #    k parallel connections (dispatch_pid, partisan_util.erl:142-201)
@@ -591,6 +600,7 @@ def make_step(
 
         # -- route (index form: fields stay in the flat buffer, gathered
         #    at delivery)
+        routed = jnp.sum(now.valid).astype(jnp.int32)
         route_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed ^ 0x5EED), rnd) \
             if randomize_delivery else None
         ib_idx, ib_valid, overflow = msgops.build_inbox_idx(
@@ -657,6 +667,12 @@ def make_step(
             "sent": out.count(),
             "inbox_overflow": overflow,
             "out_dropped": dropped,
+            # telemetry counter taps (telemetry/runner.ENGINE_KEYMAP):
+            # per-phase counts cheap enough to compute every round
+            "routed": routed,            # entered the router post fault plane
+            "fault_dropped": fault_dropped,
+            "inflight": inflight,        # buffer occupancy at round start
+            "alive": jnp.sum(world.alive).astype(jnp.int32),
             # a message whose typ matches no handler (e.g. rewritten by an
             # interposition fun) is ignored like the reference's unhandled-
             # message log sites — but counted, never silent

@@ -10,9 +10,10 @@ contract)."""
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .engine import ProtocolBase, World
@@ -24,7 +25,9 @@ class PeerServiceEvents:
     def __init__(self, proto: ProtocolBase):
         self.proto = proto
         self._callbacks: List[Callback] = []
-        self._last: Optional[np.ndarray] = None
+        # previous [N, N] member masks: a device array while no callback
+        # is registered (cheap path), a host ndarray once one is
+        self._last: Optional[Any] = None
 
     def add_sup_callback(self, fn: Callback) -> None:
         """partisan_peer_service:add_sup_callback/1."""
@@ -32,11 +35,26 @@ class PeerServiceEvents:
 
     def update(self, world: World) -> int:
         """Diff membership against the previous call; fire callbacks for
-        changed nodes.  Returns the number of changed nodes."""
-        masks = np.asarray(jax.vmap(self.proto.member_mask)(world.state))
+        changed nodes.  Returns the number of changed nodes.
+
+        With no callbacks registered the full [N, N] device->host mask
+        transfer is skipped: the per-node change flags reduce to ONE
+        scalar on device and only that count crosses to the host (the
+        still-cheap change signal a poll loop can watch)."""
+        masks_dev = jax.vmap(self.proto.member_mask)(world.state)
+        if not self._callbacks:
+            changed = 0
+            if self._last is not None:
+                last = (self._last if not isinstance(self._last, np.ndarray)
+                        else jnp.asarray(self._last))
+                changed = int(jnp.sum(
+                    jnp.any(masks_dev != last, axis=1)))
+            self._last = masks_dev
+            return changed
+        masks = np.asarray(masks_dev)
         changed = 0
         if self._last is not None:
-            diff = (masks != self._last).any(axis=1)
+            diff = (masks != np.asarray(self._last)).any(axis=1)
             for node in np.flatnonzero(diff):
                 changed += 1
                 for fn in self._callbacks:

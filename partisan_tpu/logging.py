@@ -16,13 +16,37 @@ import os
 
 logger = logging.getLogger("partisan_tpu")
 
-_TRACING = os.environ.get("PARTISAN_TRACING", "") in ("1", "true")
+# case-insensitive truthy set (the usual env-flag spellings)
+_TRUTHY = ("1", "true", "yes", "on")
+
+_TRACING = (os.environ.get("PARTISAN_TRACING", "")
+            .strip().lower() in _TRUTHY)
+
+
+def _ensure_visible() -> None:
+    """Make traces actually reach a stream under default logging config:
+    without any handler (root unconfigured) and with the default WARNING
+    level, ``logger.info`` is silently swallowed."""
+    if not logger.handlers and not logging.getLogger().handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    if logger.getEffectiveLevel() > logging.INFO:
+        logger.setLevel(logging.INFO)
 
 
 def set_tracing(on: bool) -> None:
-    """partisan_config:set(tracing, ...)."""
+    """partisan_config:set(tracing, ...).  Enabling also ensures the
+    ``partisan_tpu`` logger has a handler and an INFO-permitting level."""
     global _TRACING
-    _TRACING = on
+    _TRACING = bool(on)
+    if _TRACING:
+        _ensure_visible()
+
+
+if _TRACING:  # env-enabled tracing must be visible too
+    _ensure_visible()
 
 
 def tracing() -> bool:

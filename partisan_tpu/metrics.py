@@ -7,7 +7,14 @@ RTT is degenerate in a round-synchronous simulator (always one round), so
 the useful health signals are topology ones: view-size histograms, isolated
 node counts, convergence.  Everything here is jittable and cheap enough to
 run every round inside a scan; stream the dict to host at whatever cadence
-observability needs."""
+observability needs.
+
+For full-speed in-scan collection use :mod:`partisan_tpu.telemetry`: its
+windowed runner wires these collectors (plus the engine counter taps)
+into a [window, K] device ring behind a per-metric enable mask, flushes
+to host once per window, and exports through JSONL / Prometheus sinks —
+see the "Observability" section of README.md for the registry, the
+ring/window model, and the exported metric names."""
 
 from __future__ import annotations
 

@@ -38,6 +38,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 LANES = 128
 WORD = 32
 CELL = LANES * WORD  # node bits per row
@@ -177,7 +181,7 @@ def rumor_run_fused(packed, n_rounds: int, n: int, fanout: int = 2,
         kern,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(shape2, jnp.uint32)] * 2,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(seed, re2(packed.infected), re2(packed.hot), re2(packed.alive))

@@ -58,6 +58,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 from .rumor_kernel import CELL, LANES, WORD, _bernoulli_words, pz_bit
 
 
@@ -445,7 +449,7 @@ def rumor_run_hbm(packed, n_rounds: int, n: int, fanout: int = 2,
         kern,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(shape, jnp.uint32)] * 4,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(sref, halo(packed.infected), halo(packed.hot), halo(packed.alive))

@@ -30,6 +30,7 @@ import numpy as np
 from .engine import ProtocolBase, World
 from . import events as events_mod
 from . import peer_service
+from . import telemetry
 
 
 class OrchestrationStrategy(Protocol):
@@ -177,14 +178,18 @@ class OrchestrationBackend:
         self.node_table = node_table or {}
 
     def poll(self, world: World) -> World:
-        """Upload my membership artifact; join any discovered stranger."""
+        """Upload my membership artifact; join any discovered stranger.
+        Each poll's outcome (members known, artifacts seen, joins issued)
+        is recorded as an ``orchestration_poll`` telemetry event."""
         mine = events_mod.members(world, self.proto, self.my_node)
         payload = json.dumps(
             {"node": self.my_node, "members": mine}).encode()
         self.strategy.upload_artifact(self.name, payload)
 
+        joins = 0
+        artifacts = self.strategy.download_artifacts()
         known = set(mine) | {self.my_node}
-        for _, blob in sorted(self.strategy.download_artifacts().items()):
+        for _, blob in sorted(artifacts.items()):
             try:
                 art = json.loads(blob)
             except (ValueError, UnicodeDecodeError):
@@ -194,20 +199,28 @@ class OrchestrationBackend:
             for p in peers:
                 if p >= 0 and p not in known:
                     known.add(p)
+                    joins += 1
                     world = peer_service.join(world, self.proto,
                                               self.my_node, p)
 
         # pod discovery (kubernetes): join every discovered pod that maps
         # to a virtual node id (the backend's refresh-membership timer,
         # partisan_orchestration_backend.erl:38-70)
+        pods_seen = 0
         if hasattr(self.strategy, "clients"):
             pods = self.strategy.clients() + self.strategy.servers()
+            pods_seen = len(pods)
             for pod in pods:
                 p = self.node_table.get(pod["name"], -1)
                 if p >= 0 and p not in known:
                     known.add(p)
+                    joins += 1
                     world = peer_service.join(world, self.proto,
                                               self.my_node, p)
+        telemetry.emit_event(
+            "orchestration_poll", node=self.my_node, name=self.name,
+            members=len(mine), artifacts=len(artifacts),
+            pods=pods_seen, joins=joins)
         return world
 
     def debug_get_tree(self, world: World) -> Dict[int, List[int]]:

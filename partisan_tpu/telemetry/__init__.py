@@ -1,0 +1,82 @@
+"""In-scan device telemetry + host exporters (SURVEY §5.5 rebuilt for the
+scan era).
+
+The reference scatters instrumentation across lager tracing, plumtree
+transmission logs, and queue-depth probes; our simulator runs whole
+executions inside ``lax.scan``, so telemetry is collected ON DEVICE at
+full speed and streamed to the host at a chosen cadence:
+
+  * :mod:`.registry` — metric names -> ring slots + the enable mask
+    (disabled metrics cost a constant-folded ``where``, not a branch);
+  * :mod:`.ring` — the [window, K] device buffer carried in the scan
+    state, flushed with one transfer per window;
+  * :mod:`.runner` — windowed scan harness wiring the engine counter
+    taps and the topology metrics into the ring;
+  * :mod:`.sinks` — JSONL and Prometheus-text exporters;
+  * :mod:`.timeline` — per-window wall-clock / rounds-per-sec recorder
+    and the opt-in ``jax.profiler`` trace context.
+
+Host events (fault injections, orchestration polls) flow through the
+module-level :func:`emit_event`, which fans out to sinks registered with
+:func:`add_global_sink` — a no-op when none are (the hot-path guard, like
+``logging.trace``).  See README.md "Observability" for the full model.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from .registry import (COUNTER, GAUGE, DEFAULT_SPECS, HOST_SPECS,
+                       MetricRegistry, MetricSpec, default_registry)
+from .ring import TelemetryRing, flush, make_ring, record
+from .runner import (ENGINE_KEYMAP, collect_round_metrics,
+                     make_window_runner, run_with_telemetry)
+from .sinks import JsonlSink, PrometheusSink, TelemetrySink, parse_exposition
+from .timeline import RoundTimeline, profile_trace
+
+__all__ = [
+    "COUNTER", "GAUGE", "DEFAULT_SPECS", "HOST_SPECS",
+    "MetricRegistry", "MetricSpec", "default_registry",
+    "TelemetryRing", "flush", "make_ring", "record",
+    "ENGINE_KEYMAP", "collect_round_metrics", "make_window_runner",
+    "run_with_telemetry",
+    "JsonlSink", "PrometheusSink", "TelemetrySink", "parse_exposition",
+    "RoundTimeline", "profile_trace",
+    "add_global_sink", "remove_global_sink", "global_sinks", "emit_event",
+]
+
+# ------------------------------------------------------- host event bus
+
+_GLOBAL_SINKS: List[TelemetrySink] = []
+
+
+def add_global_sink(sink: TelemetrySink) -> TelemetrySink:
+    """Register a sink for host events (fault injections, orchestration
+    polls, bench trials).  Returns the sink for chaining."""
+    _GLOBAL_SINKS.append(sink)
+    return sink
+
+
+def remove_global_sink(sink: TelemetrySink) -> None:
+    try:
+        _GLOBAL_SINKS.remove(sink)
+    except ValueError:
+        pass
+
+
+def global_sinks() -> tuple:
+    return tuple(_GLOBAL_SINKS)
+
+
+def emit_event(event: str, /, **fields) -> None:
+    """Emit one host telemetry event to every registered global sink.
+    Free when no sink is registered (the ``logging.trace`` guard
+    pattern) — instrumented call sites never pay for disabled
+    observability.  The event name is positional-only so any field
+    name (even ``event``-adjacent ones like ``name``) stays usable."""
+    if not _GLOBAL_SINKS:
+        return
+    row = {"event": str(event), "t_wall": time.time(), **fields}
+    for s in list(_GLOBAL_SINKS):
+        s.write_row(row)

@@ -1,0 +1,176 @@
+"""Metric registry — the name -> ring-slot mapping plus the per-metric
+enable mask.
+
+The registry is host-side and immutable once a runner is built from it:
+metric *names* exist only on the host (SURVEY §5.6 — device code sees
+slot indices), and the enable mask is baked into the jitted window
+program as a compile-time constant.  A disabled metric therefore costs a
+``jnp.where`` against a constant-``False`` predicate, which XLA's
+simplifier folds to the zero operand and dead-code-eliminates the
+collector feeding it — no ``lax.cond`` branch, no program-shape change
+between masks (the in-scan requirement: fixed shapes, ``lax``-only
+control flow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+COUNTER = "counter"
+GAUGE = "gauge"
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One metric: its stable name, Prometheus kind, and help text."""
+    name: str
+    kind: str = GAUGE
+    help: str = ""
+
+
+# The default metric set: the engine counter taps (engine.step's
+# route/deliver/tick/collect phases) plus the topology health metrics of
+# metrics.py.  ``convergence`` ships disabled — its collector compares
+# every pair of [N]-wide membership masks (O(N^2)), the full-membership
+# metric, not a default-on cost.
+DEFAULT_SPECS: Tuple[MetricSpec, ...] = (
+    MetricSpec("round", GAUGE, "Simulation round index."),
+    MetricSpec("msgs_routed", COUNTER,
+               "Messages entering the router this round (post fault plane "
+               "and interposition)."),
+    MetricSpec("msgs_delivered", COUNTER,
+               "Inbox slots delivered to handlers this round."),
+    MetricSpec("msgs_sent", COUNTER,
+               "Messages in the outgoing flat buffer after collect."),
+    MetricSpec("fault_dropped", COUNTER,
+               "Messages dropped by the fault plane (crash masks, "
+               "partitions, omission interposition) this round."),
+    MetricSpec("inbox_overflow", COUNTER,
+               "Messages lost to per-node inbox capacity this round."),
+    MetricSpec("out_dropped", COUNTER,
+               "Messages dropped at the emission cap / flat-buffer "
+               "compaction this round."),
+    MetricSpec("unhandled", COUNTER,
+               "Delivered messages whose type matched no handler."),
+    MetricSpec("inflight", GAUGE,
+               "In-flight buffer occupancy at round start."),
+    MetricSpec("alive", GAUGE, "Nodes with alive=True."),
+    MetricSpec("isolated", GAUGE,
+               "Alive nodes with an empty view (metrics.view_stats)."),
+    MetricSpec("mean_view", GAUGE,
+               "Mean view size over alive nodes (metrics.view_stats)."),
+    MetricSpec("convergence", GAUGE,
+               "Fraction of alive nodes sharing the modal membership view "
+               "(metrics.convergence)."),
+)
+
+# Host-side metrics emitted per window flush by the timeline recorder —
+# never in the ring, but sinks should know their kinds.
+HOST_SPECS: Tuple[MetricSpec, ...] = (
+    MetricSpec("rounds_per_sec", GAUGE,
+               "Device rounds per wall-clock second over the last "
+               "flushed window."),
+)
+
+_DEFAULT_DISABLED = frozenset({"convergence"})
+
+
+class MetricRegistry:
+    """Ordered metric table: ``names[i]`` occupies ring column ``i``."""
+
+    def __init__(self, specs: Sequence[MetricSpec] = DEFAULT_SPECS,
+                 disabled: Iterable[str] = _DEFAULT_DISABLED):
+        self.specs: Tuple[MetricSpec, ...] = tuple(specs)
+        self.names: Tuple[str, ...] = tuple(s.name for s in self.specs)
+        if len(set(self.names)) != len(self.names):
+            raise ValueError("duplicate metric names in registry")
+        self._slots: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+        unknown = set(disabled) - set(self.names)
+        if unknown:
+            raise KeyError(f"disabled metrics not in registry: {unknown}")
+        self._mask = np.array([n not in set(disabled) for n in self.names],
+                              dtype=bool)
+
+    # ------------------------------------------------------------- queries
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._slots
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def slot(self, name: str) -> int:
+        return self._slots[name]
+
+    def spec(self, name: str) -> MetricSpec:
+        return self.specs[self._slots[name]]
+
+    def kind(self, name: str) -> str:
+        return self.spec(name).kind
+
+    def enabled(self, name: str) -> bool:
+        return bool(self._mask[self._slots[name]])
+
+    @property
+    def mask(self) -> np.ndarray:
+        """[K] bool host constant — bake into jit, never a traced array."""
+        return self._mask.copy()
+
+    # ------------------------------------------------------- reconfigure
+
+    def enable(self, *names: str) -> "MetricRegistry":
+        off = {n for n in self.names if not self.enabled(n)} - set(names)
+        return MetricRegistry(self.specs, off)
+
+    def disable(self, *names: str) -> "MetricRegistry":
+        off = {n for n in self.names if not self.enabled(n)} | set(names)
+        return MetricRegistry(self.specs, off)
+
+    def with_specs(self, extra: Sequence[MetricSpec]) -> "MetricRegistry":
+        off = {n for n in self.names if not self.enabled(n)}
+        return MetricRegistry(self.specs + tuple(extra), off)
+
+    # ------------------------------------------------------------- device
+
+    def pack(self, values: Mapping[str, jax.Array]) -> jax.Array:
+        """Build one [K] float32 ring row from a name -> scalar mapping.
+
+        Jit-safe: the enable mask is applied per metric with a Python-bool
+        predicate, so a disabled metric's collector is constant-folded out
+        of the compiled program (a ``where``, not a branch); missing
+        metrics record 0."""
+        cols = []
+        for i, name in enumerate(self.names):
+            v = values.get(name)
+            if v is None:
+                cols.append(jnp.float32(0))
+                continue
+            v = jnp.asarray(v, jnp.float32).reshape(())
+            cols.append(jnp.where(bool(self._mask[i]), v, jnp.float32(0)))
+        return jnp.stack(cols)
+
+
+def default_registry(disabled: Optional[Iterable[str]] = None
+                     ) -> MetricRegistry:
+    """The engine's default metric set (convergence off — see above)."""
+    return MetricRegistry(
+        DEFAULT_SPECS,
+        _DEFAULT_DISABLED if disabled is None else disabled)
+
+
+def all_kinds(registry: Optional[MetricRegistry]) -> Dict[str, str]:
+    """name -> kind for ring + host metrics (sink configuration helper)."""
+    specs = (tuple(registry.specs) if registry is not None
+             else DEFAULT_SPECS) + HOST_SPECS
+    return {s.name: s.kind for s in specs}
+
+
+def all_help(registry: Optional[MetricRegistry]) -> Dict[str, str]:
+    specs = (tuple(registry.specs) if registry is not None
+             else DEFAULT_SPECS) + HOST_SPECS
+    return {s.name: s.help for s in specs}

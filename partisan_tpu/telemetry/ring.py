@@ -1,0 +1,71 @@
+"""The device-side metrics ring.
+
+A fixed-shape ``[window, K]`` float32 buffer carried in the scan state:
+``record`` writes one row per round (a ``dynamic_update_slice`` at the
+cursor — jit-safe, shape-stable), and ``flush`` moves the whole window to
+the host in ONE transfer every ``window`` rounds.  This is the in-step
+metrics-accumulation pattern of production JAX training stacks applied to
+the gossip engine: the scan never syncs per round, observability pays one
+[window, K] device->host copy per window.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from .registry import MetricRegistry
+
+
+@struct.dataclass
+class TelemetryRing:
+    buf: jax.Array     # [window, K] float32 metric rows
+    cursor: jax.Array  # scalar int32 — rows recorded since the last flush
+
+
+def make_ring(registry: MetricRegistry, window: int) -> TelemetryRing:
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return TelemetryRing(
+        buf=jnp.zeros((window, len(registry)), jnp.float32),
+        cursor=jnp.int32(0),
+    )
+
+
+def record(ring: TelemetryRing, registry: MetricRegistry,
+           values: Mapping[str, jax.Array]) -> TelemetryRing:
+    """Write one round's metrics into the ring (device, inside scan).
+
+    Overflow wraps (cursor % window) so a missed flush degrades to
+    keep-latest rather than an out-of-bounds write; the harness flushes
+    every window rounds, so in normal operation the ring never wraps.
+    """
+    row = registry.pack(values)
+    window = ring.buf.shape[0]
+    slot = jnp.mod(ring.cursor, window)
+    buf = jax.lax.dynamic_update_slice(
+        ring.buf, row[None, :], (slot, jnp.int32(0)))
+    return ring.replace(buf=buf, cursor=ring.cursor + 1)
+
+
+def flush(ring: TelemetryRing, registry: MetricRegistry
+          ) -> Tuple[List[Dict[str, float]], TelemetryRing]:
+    """ONE device->host transfer of the whole window; returns the recorded
+    rows (as name -> float dicts, oldest first) and the reset ring.
+
+    Host-side only — never call under jit.  Blocks until the device has
+    produced the buffer, so it doubles as the per-window sync point.
+    """
+    buf = np.asarray(jax.device_get(ring.buf))
+    n = int(ring.cursor)
+    window = buf.shape[0]
+    if n > window:  # wrapped: only the latest `window` rows survive
+        start = n % window
+        buf = np.concatenate([buf[start:], buf[:start]])
+        n = window
+    rows = [dict(zip(registry.names, map(float, buf[i]))) for i in range(n)]
+    return rows, ring.replace(cursor=jnp.int32(0))

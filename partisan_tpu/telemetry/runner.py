@@ -1,0 +1,153 @@
+"""In-scan telemetry harness: run whole windows on device, flush per
+window.
+
+``make_window_runner`` compiles ``window`` engine rounds into one
+``lax.scan`` that carries (World, TelemetryRing): every round the engine
+counter taps (route/deliver/tick/collect phases) plus the topology
+metrics of :mod:`partisan_tpu.metrics` are packed into the ring through
+the registry's enable mask.  ``run_with_telemetry`` drives the outer
+loop: one host sync + ONE [window, K] transfer per window, rows fanned
+out to sinks, wall-clock per window recorded on the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import metrics as metrics_mod
+from ..config import Config
+from ..engine import ProtocolBase, World, init_world, make_step
+from .registry import MetricRegistry, default_registry
+from .ring import TelemetryRing, flush, make_ring, record
+from .sinks import TelemetrySink
+from .timeline import RoundTimeline, profile_trace
+
+# engine step-metrics key -> registry metric name
+ENGINE_KEYMAP: Dict[str, str] = {
+    "round": "round",
+    "routed": "msgs_routed",
+    "delivered": "msgs_delivered",
+    "sent": "msgs_sent",
+    "fault_dropped": "fault_dropped",
+    "inbox_overflow": "inbox_overflow",
+    "out_dropped": "out_dropped",
+    "unhandled": "unhandled",
+    "inflight": "inflight",
+    "alive": "alive",
+}
+
+
+def _find_views(state: Any) -> Optional[jax.Array]:
+    """Locate the protocol's padded view array ([N, C], -1 padding) —
+    the same active/partial unwrap metrics.world_health performs."""
+    st = state
+    while st is not None:
+        views = getattr(st, "active", None)
+        if views is None:
+            views = getattr(st, "partial", None)
+        if views is not None:
+            return views
+        st = getattr(st, "lower", None)  # unwrap Stacked layers
+    return None
+
+
+def collect_round_metrics(proto: ProtocolBase, world: World,
+                          step_metrics: Dict[str, jax.Array],
+                          registry: MetricRegistry
+                          ) -> Dict[str, jax.Array]:
+    """Map one round's engine metrics + topology collectors to registry
+    names (device, inside scan).  Disabled metrics still appear here —
+    the registry's constant mask zeroes them in ``pack`` and XLA removes
+    the dead collectors (a ``where``, not a branch)."""
+    vals: Dict[str, jax.Array] = {}
+    for k, name in ENGINE_KEYMAP.items():
+        if k in step_metrics and name in registry:
+            vals[name] = step_metrics[k]
+    views = _find_views(world.state)
+    if views is not None and "isolated" in registry:
+        vs = metrics_mod.view_stats(views, world.alive)
+        vals["isolated"] = vs["isolated"]
+        vals["mean_view"] = vs["mean_view"]
+    if "convergence" in registry and hasattr(proto, "member_mask"):
+        masks = jax.vmap(proto.member_mask)(world.state)
+        vals["convergence"] = metrics_mod.convergence(masks, world.alive)
+    return vals
+
+
+def make_window_runner(
+    cfg: Config, proto: ProtocolBase, registry: MetricRegistry,
+    window: int, *,
+    step: Optional[Callable] = None,
+    **step_kw: Any,
+) -> Callable[[World, TelemetryRing], Tuple[World, TelemetryRing]]:
+    """Compile ``window`` rounds + ring recording into one jitted scan."""
+    step = step or make_step(cfg, proto, donate=False, **step_kw)
+
+    @jax.jit
+    def run_window(world: World, ring: TelemetryRing):
+        def body(carry, _):
+            w, r = carry
+            w2, m = step(w)
+            vals = collect_round_metrics(proto, w2, m, registry)
+            return (w2, record(r, registry, vals)), None
+
+        (w2, r2), _ = jax.lax.scan(body, (world, ring), None, length=window)
+        return w2, r2
+
+    return run_window
+
+
+def run_with_telemetry(
+    cfg: Config, proto: ProtocolBase, n_rounds: int, *,
+    window: int = 64,
+    registry: Optional[MetricRegistry] = None,
+    sinks: Sequence[TelemetrySink] = (),
+    timeline: Optional[RoundTimeline] = None,
+    world: Optional[World] = None,
+    profile_dir: Optional[str] = None,
+    profile_window: int = 0,
+    step_kw: Optional[Dict[str, Any]] = None,
+) -> Tuple[World, RoundTimeline]:
+    """Run ``n_rounds`` with in-scan telemetry, flushing every ``window``.
+
+    Per window: one jitted scan (no host round-trips inside), then one
+    [window, K] device->host transfer, rows written to every sink
+    (per-round metric rows, then the window timeline row carrying
+    ``rounds_per_sec``).  A trailing partial window compiles a second,
+    shorter scan.  ``profile_dir`` wraps window ``profile_window`` in a
+    ``jax.profiler`` trace.
+    """
+    registry = registry or default_registry()
+    world = world if world is not None else init_world(cfg, proto)
+    timeline = timeline or RoundTimeline()
+    ring = make_ring(registry, window)
+    # one compiled step shared by the full- and partial-window scans
+    step = make_step(cfg, proto, donate=False, **(step_kw or {}))
+    runner = make_window_runner(cfg, proto, registry, window, step=step)
+    n_full, rem = divmod(n_rounds, window)
+    chunks = [(runner, window)] * n_full
+    if rem:
+        chunks.append((
+            make_window_runner(cfg, proto, registry, rem, step=step), rem))
+
+    for wi, (run_window, length) in enumerate(chunks):
+        ctx = (profile_trace(profile_dir)
+               if profile_dir is not None and wi == profile_window
+               else contextlib.nullcontext())
+        t0 = time.perf_counter()
+        with ctx:
+            world, ring = run_window(world, ring)
+            rows, ring = flush(ring, registry)  # blocks: the sync point
+        dt = time.perf_counter() - t0
+        wrow = timeline.observe(length, dt)
+        for row in rows:
+            for s in sinks:
+                s.write_row(row)
+        for s in sinks:
+            s.write_row(wrow)
+    return world, timeline

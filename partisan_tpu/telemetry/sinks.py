@@ -1,0 +1,187 @@
+"""Host-side telemetry sinks.
+
+A :class:`TelemetrySink` consumes row dicts — per-round metric rows
+flushed from the device ring, per-window timeline rows, and host events
+(fault injections, orchestration polls).  Implementations:
+
+  * :class:`JsonlSink` — one JSON object per row, append-ordered (the
+    dets-trace-file analog for metrics; verify/trace.py uses the same
+    format for wire traces).
+  * :class:`PrometheusSink` — accumulates counters / latest gauges and
+    renders the text exposition format (``# HELP`` / ``# TYPE`` /
+    samples).  Counter rows carry per-round *deltas*; the sink
+    accumulates them into the cumulative ``_total`` samples Prometheus
+    expects.  Host events count into
+    ``partisan_events_total{event="..."}``.
+
+:func:`parse_exposition` is the minimal exposition-line parser used by
+the smoke test to round-trip the output.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import re
+from typing import Dict, IO, List, Mapping, Optional, Protocol, Union
+
+import numpy as np
+
+from .registry import MetricRegistry, all_help, all_kinds
+
+Row = Mapping[str, object]
+
+
+class TelemetrySink(Protocol):
+    def write_row(self, row: Row) -> None: ...
+    def close(self) -> None: ...
+
+
+def _jsonable(v):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if hasattr(v, "item") and getattr(v, "ndim", None) == 0:  # jax scalar
+        return v.item()
+    return v
+
+
+class JsonlSink:
+    """One JSON object per row; flushed per write so readers (and crashed
+    runs) always see whole lines."""
+
+    def __init__(self, path_or_file: Union[str, IO[str]], mode: str = "w"):
+        if isinstance(path_or_file, str):
+            self.path: Optional[str] = path_or_file
+            self._f: IO[str] = open(path_or_file, mode)
+            self._owns = True
+        else:
+            self.path = None
+            self._f = path_or_file
+            self._owns = False
+        self.rows_written = 0
+
+    def write_row(self, row: Row) -> None:
+        self._f.write(json.dumps(
+            {k: _jsonable(v) for k, v in row.items()}) + "\n")
+        self._f.flush()
+        self.rows_written += 1
+
+    def close(self) -> None:
+        if self._owns and not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PrometheusSink:
+    """Text-exposition accumulator.
+
+    Exports every registry metric seen so far (counters accumulate
+    per-round deltas, gauges keep the latest value) plus the host-side
+    ``rounds_per_sec`` gauge and an ``events_total`` counter labelled by
+    event name.  Row keys outside the registry (window bookkeeping like
+    ``window`` / ``seconds``) are ignored rather than polluting the
+    namespace.
+    """
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 path: Optional[str] = None, namespace: str = "partisan"):
+        self.namespace = namespace
+        self.path = path
+        self._kinds = all_kinds(registry)
+        self._help = all_help(registry)
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._events: Dict[str, int] = {}
+
+    def write_row(self, row: Row) -> None:
+        ev = row.get("event")
+        if ev is not None:
+            self._events[str(ev)] = self._events.get(str(ev), 0) + 1
+            return
+        for name, v in row.items():
+            kind = self._kinds.get(name)
+            if kind is None or not isinstance(v, numbers.Number):
+                continue
+            if kind == "counter":
+                self._counters[name] = self._counters.get(name, 0.0) + float(v)
+            else:
+                self._gauges[name] = float(v)
+
+    # ------------------------------------------------------------ export
+
+    def _fmt(self, v: float) -> str:
+        return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+    def expose(self) -> str:
+        """Render the Prometheus text exposition format, one family per
+        metric: ``# HELP`` / ``# TYPE`` headers then the sample line."""
+        ns = self.namespace
+        lines: List[str] = []
+        for name in sorted(self._counters):
+            fam = f"{ns}_{name}_total"
+            lines.append(f"# HELP {fam} {self._help.get(name, name)}")
+            lines.append(f"# TYPE {fam} counter")
+            lines.append(f"{fam} {self._fmt(self._counters[name])}")
+        for name in sorted(self._gauges):
+            fam = f"{ns}_{name}"
+            lines.append(f"# HELP {fam} {self._help.get(name, name)}")
+            lines.append(f"# TYPE {fam} gauge")
+            lines.append(f"{fam} {self._fmt(self._gauges[name])}")
+        if self._events:
+            fam = f"{ns}_events_total"
+            lines.append(f"# HELP {fam} Host telemetry events by name.")
+            lines.append(f"# TYPE {fam} counter")
+            for ev in sorted(self._events):
+                lines.append(f'{fam}{{event="{ev}"}} {self._events[ev]}')
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def close(self) -> None:
+        if self.path is not None:
+            with open(self.path, "w") as f:
+                f.write(self.expose())
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[str, object]]:
+    """Minimal Prometheus text-format parser (the smoke-test round-trip):
+    returns ``{family: {"help": str, "type": str, "samples":
+    {label_string_or_'': float}}}``.  Raises ValueError on lines that are
+    neither comments, blanks, nor well-formed samples.
+    """
+    out: Dict[str, Dict[str, object]] = {}
+
+    def fam(name: str) -> Dict[str, object]:
+        return out.setdefault(
+            name, {"help": "", "type": "", "samples": {}})
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            fam(name)["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            fam(name)["type"] = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line {lineno}: {line!r}")
+        fam(m["name"])["samples"][m["labels"] or ""] = float(m["value"])
+    return out

@@ -1,0 +1,69 @@
+"""Round-timeline recorder + opt-in profiler trace.
+
+The ring gives per-round *device* metrics; the timeline adds the host
+view: each flush is timestamped, yielding wall-clock per window and
+rounds/sec — the number the ROADMAP north star is denominated in.
+:func:`profile_trace` wraps one window in a ``jax.profiler`` trace for
+kernel-level profiling (opt-in; traces are large).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional
+
+import jax
+
+
+class RoundTimeline:
+    """Timestamps each window flush: wall-clock per window, rounds/sec."""
+
+    def __init__(self) -> None:
+        self.windows: List[Dict[str, float]] = []
+
+    def observe(self, rounds: int, seconds: float,
+                t_wall: Optional[float] = None) -> Dict[str, float]:
+        row = {
+            "window": len(self.windows),
+            "rounds": int(rounds),
+            "seconds": float(seconds),
+            "rounds_per_sec": (rounds / seconds) if seconds > 0
+            else float("inf"),
+            "t_wall": time.time() if t_wall is None else t_wall,
+        }
+        self.windows.append(row)
+        return row
+
+    @property
+    def total_rounds(self) -> int:
+        return int(sum(w["rounds"] for w in self.windows))
+
+    @property
+    def total_seconds(self) -> float:
+        return float(sum(w["seconds"] for w in self.windows))
+
+    @property
+    def rounds_per_sec(self) -> float:
+        """Aggregate sustained rate over every observed window."""
+        s = self.total_seconds
+        return self.total_rounds / s if s > 0 else float("inf")
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "windows": len(self.windows),
+            "rounds": self.total_rounds,
+            "seconds": self.total_seconds,
+            "rounds_per_sec": self.rounds_per_sec,
+        }
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str) -> Iterator[None]:
+    """``jax.profiler`` trace context for kernel-level profiling of a
+    window (opt-in: pass ``profile_dir`` to ``run_with_telemetry``)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

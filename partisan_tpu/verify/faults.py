@@ -15,6 +15,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..engine import World
 from ..ops.msg import Msgs
 
@@ -36,6 +37,9 @@ def send_omission(src: Optional[int] = None, dst: Optional[int] = None,
     """Drop matching messages (interposition returning `undefined`,
     crash_fault_model :116-128).  ``rounds=(lo, hi)`` limits the fault
     window; None = always."""
+    telemetry.emit_event("fault_omission_installed", src=src, dst=dst,
+                         typ=typ, rounds=rounds)
+
     def fn(m: Msgs, rnd: jax.Array) -> Msgs:
         hit = _match(m, src, dst, typ)
         if rounds is not None:
@@ -53,6 +57,9 @@ def message_delay(extra: int, src: Optional[int] = None,
                   dst: Optional[int] = None, typ: Optional[int] = None,
                   rounds: Optional[Tuple[int, int]] = None):
     """The '$delay' interposition verb / ingress+egress delay sleeps."""
+    telemetry.emit_event("fault_delay_installed", extra=extra, src=src,
+                         dst=dst, typ=typ, rounds=rounds)
+
     def fn(m: Msgs, rnd: jax.Array) -> Msgs:
         hit = _match(m, src, dst, typ)
         if rounds is not None:
@@ -134,6 +141,7 @@ def crash(world: World, nodes: Sequence[int]) -> World:
     alive = world.alive
     for n in nodes:
         alive = alive.at[n].set(False)
+    telemetry.emit_event("fault_crash", nodes=[int(n) for n in nodes])
     return world.replace(alive=alive)
 
 
@@ -141,6 +149,7 @@ def recover(world: World, nodes: Sequence[int]) -> World:
     alive = world.alive
     for n in nodes:
         alive = alive.at[n].set(True)
+    telemetry.emit_event("fault_recover", nodes=[int(n) for n in nodes])
     return world.replace(alive=alive)
 
 
@@ -151,8 +160,11 @@ def inject_partition(world: World, groups: Sequence[Sequence[int]]) -> World:
     for gid, members in enumerate(groups, start=1):
         for n in members:
             part = part.at[n].set(gid)
+    telemetry.emit_event("fault_partition_inject",
+                         groups=[[int(n) for n in g] for g in groups])
     return world.replace(partition=part)
 
 
 def resolve_partition(world: World) -> World:
+    telemetry.emit_event("fault_partition_resolve")
     return world.replace(partition=jnp.zeros_like(world.partition))

@@ -1,0 +1,129 @@
+"""Telemetry overhead bench (ISSUE 1 acceptance): HyParView at N=4096
+with the full default metric set recorded in-scan (window >= 64 rounds)
+must cost <= 5% rounds/sec versus telemetry disabled, while producing
+non-trivial ``msgs_delivered`` / ``out_dropped`` / ``isolated`` /
+``rounds_per_sec`` in both the JSONL and Prometheus outputs.
+
+Both arms run the SAME windowed-scan shape with one host sync per
+window; the only difference is the ring + collectors.  Results land in
+``BENCH_telemetry.jsonl`` (per-round + per-window rows) and
+``BENCH_telemetry.prom`` (exposition snapshot); stdout prints one JSON
+summary line.
+
+Run:  JAX_PLATFORMS=cpu python scripts/bench_telemetry.py [--n 4096]
+"""
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import jax
+
+sys.path.insert(0, ".")  # run from the repo root
+
+import partisan_tpu as pt                                   # noqa: E402
+from partisan_tpu import peer_service, telemetry            # noqa: E402
+from partisan_tpu.models.hyparview import HyParView         # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--window", type=int, default=64)
+    ap.add_argument("--windows", type=int, default=3,
+                    help="timed windows per arm (after 1 warmup window)")
+    args = ap.parse_args()
+    n, window = args.n, args.window
+
+    cfg = pt.Config(n_nodes=n, inbox_cap=8)
+    proto = HyParView(cfg)
+    world0 = pt.init_world(cfg, proto)
+    # binary-tree contacts spread the join storm (vs. a single-contact
+    # storm that serializes on node 0's inbox)
+    world0 = peer_service.cluster(
+        world0, proto, [(i, (i - 1) // 2) for i in range(1, n)])
+
+    registry = telemetry.default_registry()
+    step = pt.make_step(cfg, proto, donate=False)
+
+    # -- telemetry-disabled arm: same windowed scan, metrics dict dropped
+    #    (XLA dead-code-eliminates the unused counter taps)
+    @jax.jit
+    def plain_window(world):
+        def body(w, _):
+            w2, _m = step(w)
+            return w2, None
+        w2, _ = jax.lax.scan(body, world, None, length=window)
+        return w2
+
+    telem_window = telemetry.make_window_runner(
+        cfg, proto, registry, window, step=step)
+
+    jsonl = telemetry.JsonlSink("BENCH_telemetry.jsonl")
+    prom = telemetry.PrometheusSink(registry, path="BENCH_telemetry.prom")
+    timeline = telemetry.RoundTimeline()
+    ring = telemetry.make_ring(registry, window)
+
+    # -- telemetry arm: warmup window (compile + join storm, captured so
+    #    the artifact holds the non-trivial out_dropped/isolated phase),
+    #    then timed steady-state windows
+    all_rows = []
+
+    def telem_run(world, ring, timed):
+        nonlocal all_rows
+        t0 = time.perf_counter()
+        world, ring = telem_window(world, ring)
+        rows, ring = telemetry.flush(ring, registry)
+        dt = time.perf_counter() - t0
+        wrow = timeline.observe(window, dt)
+        for row in rows:
+            jsonl.write_row(row)
+            prom.write_row(row)
+        jsonl.write_row(wrow)
+        prom.write_row(wrow)
+        all_rows += rows
+        return world, ring, (dt if timed else None)
+
+    wt, ring, _ = telem_run(world0, ring, timed=False)
+    telem_secs = []
+    for _ in range(args.windows):
+        wt, ring, dt = telem_run(wt, ring, timed=True)
+        telem_secs.append(dt)
+
+    # -- plain arm: identical schedule from the same initial world
+    wp = plain_window(world0)
+    int(wp.rnd)                                   # sync (warmup/compile)
+    plain_secs = []
+    for _ in range(args.windows):
+        t0 = time.perf_counter()
+        wp = plain_window(wp)
+        int(wp.rnd)                               # scalar readback = sync
+        plain_secs.append(time.perf_counter() - t0)
+
+    jsonl.close()
+    prom.close()
+
+    plain_rps = window / statistics.median(plain_secs)
+    telem_rps = window / statistics.median(telem_secs)
+    overhead = (plain_rps - telem_rps) / plain_rps * 100.0
+    summary = {
+        "metric": f"telemetry overhead @ HyParView N={n}, window={window}",
+        "n": n, "window": window, "timed_windows": args.windows,
+        "plain_rounds_per_sec": round(plain_rps, 2),
+        "telemetry_rounds_per_sec": round(telem_rps, 2),
+        "overhead_pct": round(overhead, 2),
+        "msgs_delivered_total": sum(r["msgs_delivered"] for r in all_rows),
+        "out_dropped_total": sum(r["out_dropped"] for r in all_rows),
+        "isolated_max": max(r["isolated"] for r in all_rows),
+        "isolated_last": all_rows[-1]["isolated"],
+        "rounds_per_sec_last_window": round(
+            timeline.windows[-1]["rounds_per_sec"], 2),
+        "device": jax.devices()[0].platform,
+    }
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

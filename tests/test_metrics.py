@@ -1,0 +1,78 @@
+"""Edge-case coverage for metrics.view_stats / metrics.convergence
+(tier-1, quick tier): all-dead worlds, N=1, and all-padding views must
+produce finite, sane values — these feed the telemetry ring every round,
+so a NaN here poisons a whole window."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from partisan_tpu import metrics
+
+
+class TestViewStats:
+    def test_all_dead_world(self):
+        views = jnp.asarray([[1, 2], [0, 2], [0, 1]], jnp.int32)
+        alive = jnp.zeros((3,), bool)
+        out = metrics.view_stats(views, alive)
+        assert int(out["isolated"]) == 0
+        assert np.isfinite(float(out["mean_view"]))
+        assert float(out["mean_view"]) == 0.0
+        assert int(out["view_hist"].sum()) == 0
+
+    def test_single_node(self):
+        views = jnp.full((1, 4), -1, jnp.int32)
+        alive = jnp.ones((1,), bool)
+        out = metrics.view_stats(views, alive)
+        assert int(out["isolated"]) == 1
+        assert float(out["mean_view"]) == 0.0
+        assert out["view_hist"].shape == (5,)
+        assert int(out["view_hist"][0]) == 1
+
+    def test_all_padding_views(self):
+        views = jnp.full((6, 3), -1, jnp.int32)
+        alive = jnp.ones((6,), bool)
+        out = metrics.view_stats(views, alive)
+        assert int(out["isolated"]) == 6
+        assert float(out["mean_view"]) == 0.0
+        # the whole histogram mass sits in the size-0 bucket
+        assert int(out["view_hist"][0]) == 6
+        assert int(out["view_hist"].sum()) == 6
+
+    def test_dead_nodes_excluded_from_hist(self):
+        views = jnp.asarray([[1, -1], [0, -1], [-1, -1]], jnp.int32)
+        alive = jnp.asarray([True, False, True])
+        out = metrics.view_stats(views, alive)
+        assert int(out["isolated"]) == 1          # node 2 only
+        assert int(out["view_hist"].sum()) == 2   # dead node 1 not counted
+
+
+class TestConvergence:
+    def test_all_dead_world_no_nan(self):
+        masks = jnp.zeros((4, 4), bool)
+        alive = jnp.zeros((4,), bool)
+        c = float(metrics.convergence(masks, alive))
+        assert np.isfinite(c)
+        assert c == 0.0
+
+    def test_single_node_converged(self):
+        masks = jnp.zeros((1, 1), bool)
+        alive = jnp.ones((1,), bool)
+        assert float(metrics.convergence(masks, alive)) == 1.0
+
+    def test_reference_row_is_alive(self):
+        # node 0 is dead with a divergent view; agreement must be
+        # measured against the first ALIVE node's view, so the two
+        # agreeing alive nodes read as fully converged
+        masks = jnp.asarray([[1, 1, 1],
+                             [0, 1, 1],
+                             [0, 1, 1]], bool)
+        alive = jnp.asarray([False, True, True])
+        assert float(metrics.convergence(masks, alive)) == 1.0
+
+    def test_partial_agreement(self):
+        masks = jnp.asarray([[1, 1, 0, 0],
+                             [1, 1, 0, 0],
+                             [1, 1, 0, 0],
+                             [0, 0, 1, 1]], bool)
+        alive = jnp.ones((4,), bool)
+        assert float(metrics.convergence(masks, alive)) == 0.75

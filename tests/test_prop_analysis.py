@@ -282,6 +282,12 @@ class TestAnalysis:
 
 GOLDEN_DIR = "/root/reference/annotations"
 
+# the golden files live in the reference checkout, not this repo — skip
+# (not fail) in environments that ship the rebuild alone
+_needs_golden = pytest.mark.skipif(
+    not os.path.isdir(GOLDEN_DIR),
+    reason=f"reference golden annotations not present ({GOLDEN_DIR})")
+
 
 def _crosswalk(fname, proto, cfg, type_map=None, edge_map=None,
                samples=256):
@@ -319,6 +325,7 @@ def _crosswalk(fname, proto, cfg, type_map=None, edge_map=None,
     return g
 
 
+@_needs_golden
 class TestGoldenCrosswalk:
     def _cfg(self, n=4):
         return pt.Config(n_nodes=n, inbox_cap=16)

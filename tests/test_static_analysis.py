@@ -25,6 +25,12 @@ from partisan_tpu.verify.static_analysis import (merged_causality,
 
 GOLDEN_DIR = "/root/reference/annotations"
 
+# the golden files live in the reference checkout, not this repo — skip
+# (not fail) in environments that ship the rebuild alone
+_needs_golden = pytest.mark.skipif(
+    not os.path.isdir(GOLDEN_DIR),
+    reason=f"reference golden annotations not present ({GOLDEN_DIR})")
+
 
 class _Indirect(ProtocolBase):
     """Emission literal reachable only through two self-method hops."""
@@ -160,6 +166,7 @@ def _golden_static_cover(fname, proto, type_map=None, edge_map=None):
             assert t in spont_ok, (s, t, st)
 
 
+@_needs_golden
 class TestGoldenStaticCover:
     """The golden files, covered WITHOUT executing a single handler —
     the derivation direction the reference itself uses.  Type/edge maps

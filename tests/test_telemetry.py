@@ -1,0 +1,163 @@
+"""Telemetry smoke tests (tier-1, CPU, quick tier): a small HyParView sim
+with in-scan telemetry enabled, one window flushed, JSONL rows parsing
+and the Prometheus exposition round-tripping through the minimal line
+parser."""
+
+import io
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service, telemetry
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.telemetry import (
+    JsonlSink, MetricRegistry, PrometheusSink, RoundTimeline,
+    default_registry, flush, make_ring, parse_exposition, record,
+    run_with_telemetry,
+)
+from partisan_tpu.verify import faults
+
+
+def _booted(n=32):
+    cfg = pt.Config(n_nodes=n, inbox_cap=8, shuffle_interval=5)
+    proto = HyParView(cfg)
+    world = pt.init_world(cfg, proto)
+    world = peer_service.cluster(world, proto,
+                                 [(i, 0) for i in range(1, n)])
+    return cfg, proto, world
+
+
+# ------------------------------------------------------------- ring unit
+
+class TestRing:
+    def test_record_flush_roundtrip(self):
+        reg = default_registry()
+        ring = make_ring(reg, window=4)
+        for i in range(3):
+            ring = record(ring, reg, {"round": jnp.int32(i),
+                                      "msgs_delivered": jnp.int32(10 * i)})
+        rows, ring2 = flush(ring, reg)
+        assert [r["round"] for r in rows] == [0.0, 1.0, 2.0]
+        assert [r["msgs_delivered"] for r in rows] == [0.0, 10.0, 20.0]
+        assert int(ring2.cursor) == 0
+        # unnamed metrics record 0, every registry column is present
+        assert set(rows[0]) == set(reg.names)
+
+    def test_disabled_metric_is_masked(self):
+        reg = default_registry().disable("msgs_delivered")
+        ring = record(make_ring(reg, 2), reg,
+                      {"msgs_delivered": jnp.int32(7),
+                       "alive": jnp.int32(5)})
+        rows, _ = flush(ring, reg)
+        assert rows[0]["msgs_delivered"] == 0.0
+        assert rows[0]["alive"] == 5.0
+
+    def test_registry_rejects_unknown_disable(self):
+        with pytest.raises(KeyError):
+            MetricRegistry(disabled={"nope"})
+
+
+# ----------------------------------------------------------- full harness
+
+class TestScanTelemetry:
+    @pytest.fixture(scope="class")
+    def run(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("telemetry")
+        cfg, proto, world = _booted(32)
+        jsonl_path = str(tmp / "telemetry.jsonl")
+        jsonl = JsonlSink(jsonl_path)
+        prom = PrometheusSink()
+        timeline = RoundTimeline()
+        world2, tl = run_with_telemetry(
+            cfg, proto, n_rounds=20, window=8, world=world,
+            sinks=[jsonl, prom], timeline=timeline)
+        jsonl.close()
+        return jsonl_path, prom, tl, world2
+
+    def test_jsonl_rows_parse(self, run):
+        jsonl_path, _, _, _ = run
+        with open(jsonl_path) as f:
+            rows = [json.loads(line) for line in f]
+        round_rows = [r for r in rows if "msgs_delivered" in r]
+        window_rows = [r for r in rows if "rounds_per_sec" in r]
+        # 20 rounds = 2 full windows of 8 + a partial window of 4
+        assert len(round_rows) == 20
+        assert len(window_rows) == 3
+        assert [int(r["round"]) for r in round_rows] == list(range(20))
+        assert sum(r["msgs_delivered"] for r in round_rows) > 0
+        assert all(r["rounds_per_sec"] > 0 for r in window_rows)
+        assert [r["rounds"] for r in window_rows] == [8, 8, 4]
+
+    def test_view_metrics_recorded(self, run):
+        jsonl_path, _, _, world2 = run
+        with open(jsonl_path) as f:
+            rows = [json.loads(line) for line in f]
+        last = [r for r in rows if "isolated" in r][-1]
+        # after 20 rounds of a 32-node join storm the overlay is live:
+        # every node has peers and the isolated count matches the state
+        sizes = np.asarray((np.asarray(world2.state.active) >= 0).sum(1))
+        assert last["isolated"] == float((sizes == 0).sum())
+        assert last["mean_view"] > 0
+        assert last["alive"] == 32.0
+        # convergence is disabled by default: masked to 0
+        assert last["convergence"] == 0.0
+
+    def test_prometheus_roundtrip(self, run):
+        _, prom, _, _ = run
+        text = prom.expose()
+        assert "# HELP partisan_msgs_delivered_total" in text
+        assert "# TYPE partisan_msgs_delivered_total counter" in text
+        assert "# TYPE partisan_rounds_per_sec gauge" in text
+        parsed = parse_exposition(text)
+        fam = parsed["partisan_msgs_delivered_total"]
+        assert fam["type"] == "counter"
+        assert fam["samples"][""] > 0
+        assert parsed["partisan_rounds_per_sec"]["samples"][""] > 0
+        assert parsed["partisan_alive"]["samples"][""] == 32
+        # every sample value survives the round-trip exactly
+        again = parse_exposition(text)
+        assert again == parsed
+
+    def test_timeline_totals(self, run):
+        _, _, tl, _ = run
+        assert tl.total_rounds == 20
+        assert tl.rounds_per_sec > 0
+        assert tl.summary()["windows"] == 3
+
+
+# -------------------------------------------------------- host event bus
+
+class TestEvents:
+    def test_fault_events_reach_global_sink(self):
+        buf = io.StringIO()
+        sink = JsonlSink(buf)
+        telemetry.add_global_sink(sink)
+        try:
+            cfg, proto, world = _booted(8)
+            world = faults.crash(world, [3])
+            world = faults.inject_partition(world, [[0, 1], [2, 4]])
+            world = faults.resolve_partition(world)
+            world = faults.recover(world, [3])
+        finally:
+            telemetry.remove_global_sink(sink)
+        rows = [json.loads(line) for line in buf.getvalue().splitlines()]
+        names = [r["event"] for r in rows]
+        assert names == ["fault_crash", "fault_partition_inject",
+                         "fault_partition_resolve", "fault_recover"]
+        assert rows[0]["nodes"] == [3]
+        assert rows[1]["groups"] == [[0, 1], [2, 4]]
+
+    def test_emit_event_noop_without_sinks(self):
+        # must not raise and must not allocate anything visible
+        telemetry.emit_event("nobody_listening", x=1)
+
+    def test_prometheus_counts_events(self):
+        prom = PrometheusSink()
+        prom.write_row({"event": "fault_crash", "nodes": [1]})
+        prom.write_row({"event": "fault_crash", "nodes": [2]})
+        parsed = parse_exposition(prom.expose())
+        fam = parsed["partisan_events_total"]
+        assert fam["samples"]['event="fault_crash"'] == 2
