@@ -78,9 +78,12 @@ def main() -> None:
     salt = int.from_bytes(os.urandom(4), "little")
     # per-trial rows go through the telemetry JSONL sink so BENCH_*
     # snapshots gain a per-trial artifact; stdout stays the one parsed
-    # JSON summary line (contract unchanged)
-    trial_sink = JsonlSink(
-        os.environ.get("PARTISAN_BENCH_JSONL", "BENCH_trials.jsonl"))
+    # JSON summary line (contract unchanged).  Rows are BUFFERED and
+    # written after the whole trial loop (round 6): the r5 flagship
+    # number read low vs r3/r4 and the bisect had to rule the sink's
+    # between-trial host I/O in or out — now it is structurally out of
+    # every inter-trial window, not just outside the timed regions.
+    trial_rows = []
     for t in range(trials):
         w = rumor_init(n, (7919 * (t + 101) + salt) % n)
         t0 = time.perf_counter()
@@ -88,12 +91,16 @@ def main() -> None:
         infected = float(jnp.mean(out.infected))   # scalar readback = sync
         dt = time.perf_counter() - t0
         rates.append(rounds / dt)
-        trial_sink.write_row({
+        trial_rows.append({
             "trial": t, "seconds": dt, "rounds_per_sec": rounds / dt,
             "rounds": rounds, "n": n, "churn": churn, "fanout": fanout,
             "variant": variant, "infected": infected,
             "device": jax.devices()[0].platform, "t_wall": time.time(),
         })
+    trial_sink = JsonlSink(
+        os.environ.get("PARTISAN_BENCH_JSONL", "BENCH_trials.jsonl"))
+    for row in trial_rows:
+        trial_sink.write_row(row)
     trial_sink.close()
 
     rps = statistics.median(rates)

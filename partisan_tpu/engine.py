@@ -228,58 +228,42 @@ class ProtocolBase:
         return out
 
 
-def make_step(
-    cfg: Config,
-    proto: ProtocolBase,
-    out_cap: Optional[int] = None,
-    interpose_send: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
-    interpose_recv: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
-    randomize_delivery: bool = True,
-    donate: bool = True,
-    capture_wire: bool = False,
-) -> Callable[[World], Tuple[World, Dict[str, jax.Array]]]:
-    """Compile one simulation round for `proto`.
+def make_round_kernels(cfg: Config, proto: ProtocolBase, n_rows: int):
+    """Build the delivery + collect kernels of one round, parameterized
+    by the ROW COUNT they operate on: ``n_rows == cfg.n_nodes`` for the
+    single-program step (:func:`make_step`) and ``cfg.n_nodes // D`` for
+    the shard_map dataplane (parallel/dataplane.py), whose per-device
+    body runs these same kernels over its local row slice — the handlers
+    see global node ids either way (``node_ids`` is a call argument), so
+    the sharded round is the unsharded one restricted to a slice, not a
+    re-implementation.
 
-    interpose_send/recv are the TPU analog of the reference's interposition
-    funs (partisan_pluggable_peer_service_manager.erl:51-58, 640-667): pure
-    functions over the flat message buffer that may invalidate (drop), rewrite
-    fields, or bump `delay` ('$delay'), keyed off the round number.
+    ``cfg`` must already be autotuned (both callers route through
+    :func:`autotune` first).  Returns a namespace with
 
-    ``capture_wire=True`` adds the post-interposition pre-route buffer to
-    the metrics dict (keys ``wire_valid/src/dst/typ/channel/hash``) — the
-    per-round trace dump consumed by verify/trace.py (the
-    pre_interposition-fun recording of partisan_trace_orchestrator.erl).
+      deliver_batch(state, nowp, ib_idx, ib_valid, dkeys, node_ids)
+      collect(delivered, temits, node_ids, rnd)
+          -> (new_msgs_flat, src_row, node_dropped)
+      C, G, K, E, T, n_types
+
+    where ``src_row`` maps each slot of the collected flat buffer to the
+    LOCAL row that emitted it (for row-local aliveness gating without a
+    global gather).
     """
-    cfg = autotune(cfg, proto)
-    N = cfg.n_nodes
+    import types
+
     K = cfg.inbox_cap
     E = proto.emit_cap
     T = proto.tick_emit_cap
     n_types = len(proto.msg_types)
     handlers = proto.handlers()
-    out_cap = out_cap or default_out_cap(cfg, proto)
-    # channel/parallelism plumbing (SURVEY §2.11): partition-keyed lane
-    # dispatch and the monotonic keep-latest reduction
-    pk_field = "partition_key" if "partition_key" in proto.data_spec else None
-
-    def _interp(fn, m, rnd, world):
-        """Interposition funs take (msgs, rnd) or (msgs, rnd, world) — the
-        3-arg form reads runtime data (world.aux) so fault schedules swap
-        without recompiling."""
-        import inspect
-        if len(inspect.signature(fn).parameters) >= 3:
-            return fn(m, rnd, world)
-        return fn(m, rnd)
-    mono_mask = None
-    if cfg.monotonic_channels:
-        mono_mask = jnp.asarray(
-            [c in cfg.monotonic_channels for c in cfg.channels], dtype=bool)
 
     def _sel_where(sel, new, old):
         """Per-node select with broadcast over trailing dims."""
         return jax.tree_util.tree_map(
             lambda b, a: jnp.where(
-                sel.reshape((N,) + (1,) * (b.ndim - 1)), b, a), new, old)
+                sel.reshape((n_rows,) + (1,) * (b.ndim - 1)), b, a),
+            new, old)
 
     # delivery gather-chunk width (see Config.deliver_gather_cap).
     # None (or 0 = explicitly disabled) = gated-dense delivery: per-type
@@ -288,7 +272,7 @@ def make_step(
     # gather delivery for big N.  (G=0 must NOT reach the chunk loop:
     # a zero-width gather makes no progress and the while_loop spins.)
     G = None if not cfg.deliver_gather_cap \
-        else min(cfg.deliver_gather_cap, N)
+        else min(cfg.deliver_gather_cap, n_rows)
 
     # running-offset collect (active when cfg.node_emit_cap is set): per
     # node, a [C]-slot output region written incrementally at a running
@@ -304,37 +288,38 @@ def make_step(
         C = min(C, K * E + T)
 
     def outbuf_write(outbuf, pos, drops, em, width):
-        """Scatter em [N, width] into each node's running region of the
-        flat [N*C + 1] buffer (last slot = dump).  Returns
+        """Scatter em [n_rows, width] into each node's running region of
+        the flat [n_rows*C + 1] buffer (last slot = dump).  Returns
         (outbuf, pos, drops) with overflow counted, never silent."""
         v = em.valid
         within = jnp.cumsum(v, axis=1) - v           # exclusive prefix
         idx = pos[:, None] + within
         ok = v & (idx < C)
         flat_idx = jnp.where(
-            ok, node_col * C + jnp.clip(idx, 0, C - 1), N * C)
+            ok, node_col * C + jnp.clip(idx, 0, C - 1), n_rows * C)
         fi = flat_idx.reshape(-1)
 
         def scat(b, e):
-            return b.at[fi].set(e.reshape((N * width,) + e.shape[2:]))
+            return b.at[fi].set(e.reshape((n_rows * width,) + e.shape[2:]))
 
         outbuf = jax.tree_util.tree_map(scat, outbuf, em)
         # dropped/invalid entries all landed in the dump slot; its valid
         # flag must end False no matter what was written last
-        outbuf = outbuf.replace(valid=outbuf.valid.at[N * C].set(False))
+        outbuf = outbuf.replace(
+            valid=outbuf.valid.at[n_rows * C].set(False))
         drops = drops + jnp.sum(v & ~ok).astype(jnp.int32)
         return outbuf, pos + jnp.sum(v, axis=1).astype(jnp.int32), drops
 
     def outbuf_write_rows(outbuf, pos, drops, idx, em):
         """outbuf_write for a gathered row subset: em is [G, width] with
-        row g belonging to node idx[g] (idx == N = fill, dropped)."""
-        ic = jnp.minimum(idx, N - 1)
-        v = em.valid & (idx < N)[:, None]
+        row g belonging to row idx[g] (idx == n_rows = fill, dropped)."""
+        ic = jnp.minimum(idx, n_rows - 1)
+        v = em.valid & (idx < n_rows)[:, None]
         within = jnp.cumsum(v, axis=1) - v
         p = pos[ic][:, None] + within
         ok = v & (p < C)
         flat_idx = jnp.where(ok, ic[:, None] * C + jnp.clip(p, 0, C - 1),
-                             N * C)
+                             n_rows * C)
         fi = flat_idx.reshape(-1)
         width = em.valid.shape[1]
 
@@ -343,13 +328,14 @@ def make_step(
                 e.reshape((idx.shape[0] * width,) + e.shape[2:]))
 
         outbuf = jax.tree_util.tree_map(scat, outbuf, em)
-        outbuf = outbuf.replace(valid=outbuf.valid.at[N * C].set(False))
+        outbuf = outbuf.replace(
+            valid=outbuf.valid.at[n_rows * C].set(False))
         drops = drops + jnp.sum(v & ~ok).astype(jnp.int32)
         pos = pos.at[idx].add(jnp.sum(v, axis=1).astype(jnp.int32),
                               mode="drop")
         return outbuf, pos, drops
 
-    node_col = jnp.arange(N, dtype=jnp.int32)[:, None]
+    node_col = jnp.arange(n_rows, dtype=jnp.int32)[:, None]
 
     def deliver_batch(state, nowp, ib_idx, ib_valid, dkeys, node_ids):
         """Process inbox slots slot-sequentially (Erlang mailbox order).
@@ -389,13 +375,15 @@ def make_step(
             return mk.replace(valid=ib_valid[:, k])
         if C is not None:
             embuf = jax.tree_util.tree_map(
-                lambda x: jnp.zeros((N * C + 1,) + x.shape[1:], x.dtype),
+                lambda x: jnp.zeros((n_rows * C + 1,) + x.shape[1:],
+                                    x.dtype),
                 msgops.empty(1, proto.data_spec))
-            carry0 = (state, embuf, jnp.zeros((N,), jnp.int32),
+            carry0 = (state, embuf, jnp.zeros((n_rows,), jnp.int32),
                       jnp.int32(0))
         else:
             embuf = jax.tree_util.tree_map(
-                lambda x: jnp.zeros((N, K * E) + x.shape[1:], x.dtype),
+                lambda x: jnp.zeros((n_rows, K * E) + x.shape[1:],
+                                    x.dtype),
                 msgops.empty(1, proto.data_spec))
             carry0 = (state, embuf)
 
@@ -415,7 +403,7 @@ def make_step(
 
         def fresh_em_slot():
             return jax.tree_util.tree_map(
-                lambda x: jnp.zeros((N, E) + x.shape[1:], x.dtype),
+                lambda x: jnp.zeros((n_rows, E) + x.shape[1:], x.dtype),
                 msgops.empty(1, proto.data_spec))
 
         def store_em_slot(carry, em_slot, k):
@@ -451,11 +439,11 @@ def make_step(
             def chunk_body(c):
                 pending, carry = c[0], c[1:]
                 state = carry[0]
-                idx, = jnp.nonzero(pending, size=G, fill_value=N)
-                ic = jnp.minimum(idx, N - 1).astype(jnp.int32)
+                idx, = jnp.nonzero(pending, size=G, fill_value=n_rows)
+                ic = jnp.minimum(idx, n_rows - 1).astype(jnp.int32)
                 take = lambda x: x[ic]
-                # fill rows (idx == N) gather the dump message row
-                fiG = jnp.where(idx < N, fiN[ic], Mdump)
+                # fill rows (idx == n_rows) gather the dump message row
+                fiG = jnp.where(idx < n_rows, fiN[ic], Mdump)
                 mrows = jax.tree_util.tree_map(lambda x: x[fiG], nowp)
                 st2, em2 = jax.vmap(apply_row)(
                     ic, jax.tree_util.tree_map(take, state),
@@ -545,6 +533,99 @@ def make_step(
                                  (jnp.int32(0),) + tuple(carry0))
         return out[1:]
 
+    row_ids = jnp.arange(n_rows, dtype=jnp.int32)
+
+    def collect(delivered, temits, node_ids, rnd):
+        """Flatten this round's emissions (handler + tick) into one flat
+        buffer, stamping src/born.  Returns ``(new, src_row,
+        node_dropped)`` where ``src_row`` is the LOCAL row index behind
+        each slot (so callers can gate on row-local aliveness without a
+        global gather — the sharded dataplane's alive vector only spans
+        its own rows)."""
+        if C is not None:
+            # running-offset collect: tick emissions append to each
+            # node's region (slot-major, demits first — the same
+            # within-node order the flatten path produces, so
+            # per-connection FIFO is unchanged); the flat [n_rows*C]
+            # buffer needs no compaction at all
+            _, outbuf, pos, drops0 = delivered
+            outbuf, pos, node_dropped = outbuf_write(
+                outbuf, pos, drops0, temits, T)
+            new = jax.tree_util.tree_map(lambda x: x[: n_rows * C],
+                                         outbuf)
+            src_row = jnp.repeat(row_ids, C)
+            new = new.replace(
+                src=jnp.repeat(node_ids, C),
+                born=jnp.full((n_rows * C,), rnd, jnp.int32))
+        else:
+            node_dropped = jnp.int32(0)
+
+            def flat(em: Msgs, per: int) -> Msgs:
+                out = jax.tree_util.tree_map(
+                    lambda x: x.reshape((n_rows * per,) + x.shape[2:]),
+                    em)
+                return out.replace(
+                    src=jnp.repeat(node_ids, per),
+                    born=jnp.full((n_rows * per,), rnd, jnp.int32))
+
+            new = msgops.concat(flat(delivered[1], K * E),
+                                flat(temits, T))
+            src_row = jnp.concatenate([jnp.repeat(row_ids, K * E),
+                                       jnp.repeat(row_ids, T)])
+        return new, src_row, node_dropped
+
+    return types.SimpleNamespace(
+        deliver_batch=deliver_batch, collect=collect,
+        C=C, G=G, K=K, E=E, T=T, n_types=n_types)
+
+
+def make_step(
+    cfg: Config,
+    proto: ProtocolBase,
+    out_cap: Optional[int] = None,
+    interpose_send: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
+    interpose_recv: Optional[Callable[[Msgs, jax.Array], Msgs]] = None,
+    randomize_delivery: bool = True,
+    donate: bool = True,
+    capture_wire: bool = False,
+) -> Callable[[World], Tuple[World, Dict[str, jax.Array]]]:
+    """Compile one simulation round for `proto`.
+
+    interpose_send/recv are the TPU analog of the reference's interposition
+    funs (partisan_pluggable_peer_service_manager.erl:51-58, 640-667): pure
+    functions over the flat message buffer that may invalidate (drop), rewrite
+    fields, or bump `delay` ('$delay'), keyed off the round number.
+
+    ``capture_wire=True`` adds the post-interposition pre-route buffer to
+    the metrics dict (keys ``wire_valid/src/dst/typ/channel/hash``) — the
+    per-round trace dump consumed by verify/trace.py (the
+    pre_interposition-fun recording of partisan_trace_orchestrator.erl).
+    """
+    cfg = autotune(cfg, proto)
+    N = cfg.n_nodes
+    K = cfg.inbox_cap
+    T = proto.tick_emit_cap
+    n_types = len(proto.msg_types)
+    out_cap = out_cap or default_out_cap(cfg, proto)
+    kernels = make_round_kernels(cfg, proto, N)
+    deliver_batch, collect = kernels.deliver_batch, kernels.collect
+    # channel/parallelism plumbing (SURVEY §2.11): partition-keyed lane
+    # dispatch and the monotonic keep-latest reduction
+    pk_field = "partition_key" if "partition_key" in proto.data_spec else None
+
+    def _interp(fn, m, rnd, world):
+        """Interposition funs take (msgs, rnd) or (msgs, rnd, world) — the
+        3-arg form reads runtime data (world.aux) so fault schedules swap
+        without recompiling."""
+        import inspect
+        if len(inspect.signature(fn).parameters) >= 3:
+            return fn(m, rnd, world)
+        return fn(m, rnd)
+    mono_mask = None
+    if cfg.monotonic_channels:
+        mono_mask = jnp.asarray(
+            [c in cfg.monotonic_channels for c in cfg.channels], dtype=bool)
+
     def step(world: World) -> Tuple[World, Dict[str, jax.Array]]:
         state, msgs, rnd = world.state, world.msgs, world.rnd
         rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys, rnd)
@@ -625,29 +706,9 @@ def make_step(
         state, temits = jax.vmap(tick, in_axes=(0, 0, 0))(node_ids, state, tkeys)
 
         # -- collect: stamp src ids and merge with held traffic
-        def flat(em: Msgs, per: int) -> Msgs:
-            out = jax.tree_util.tree_map(
-                lambda x: x.reshape((N * per,) + x.shape[2:]), em)
-            src = jnp.repeat(node_ids, per)
-            return out.replace(src=src,
-                               born=jnp.full((N * per,), rnd, jnp.int32))
-
-        if C is not None:
-            # running-offset collect: tick emissions append to each
-            # node's region (slot-major, demits first — the same
-            # within-node order the flatten path produces, so
-            # per-connection FIFO is unchanged); the flat [N*C] buffer
-            # needs no compaction at all
-            _, outbuf, pos, drops0 = delivered
-            outbuf, pos, node_dropped = outbuf_write(
-                outbuf, pos, drops0, temits, T)
-            new = jax.tree_util.tree_map(lambda x: x[: N * C], outbuf)
-            new = new.replace(src=jnp.repeat(node_ids, C),
-                              born=jnp.full((N * C,), rnd, jnp.int32))
-        else:
-            node_dropped = jnp.int32(0)
-            new = msgops.concat(flat(delivered[1], K * E), flat(temits, T))
-        alive_src = world.alive[jnp.clip(new.src, 0, N - 1)]
+        new, src_row, node_dropped = collect(delivered, temits,
+                                             node_ids, rnd)
+        alive_src = world.alive[src_row]
         new = new.replace(valid=new.valid & alive_src)
         # transport delays (ingress_delay + egress_delay, Config): extra
         # rounds in flight, stamped once at emission

@@ -632,18 +632,15 @@ def staggered_scan(bodies, carry, n_blocks: int, k: int):
     """Drive one 2k-round staggered block layout
     [heavy_ps, light x k-1, heavy_p, light x k-1] for n_blocks blocks;
     ``bodies`` are scan-body functions (carry, None) -> (carry, None)
-    for the three programs of :func:`staggered_programs`."""
+    for the three programs of :func:`staggered_programs`.  The block
+    driver itself is the protocol-independent cadence machinery
+    (models/dense_cadence.block_scan) shared with the SCAMP and
+    Plumtree cadences (ISSUE 2)."""
+    from .dense_cadence import block_scan
     hps_body, hp_body, light_body = bodies
-
-    def block(c, _):
-        c, _ = hps_body(c, None)
-        c, _ = jax.lax.scan(light_body, c, None, length=k - 1)
-        c, _ = hp_body(c, None)
-        c, _ = jax.lax.scan(light_body, c, None, length=k - 1)
-        return c, None
-
-    out, _ = jax.lax.scan(block, carry, None, length=n_blocks)
-    return out
+    return block_scan([(hps_body, 1), (light_body, k - 1),
+                       (hp_body, 1), (light_body, k - 1)],
+                      carry, n_blocks)
 
 
 # ------------------------------------------------------------- health
@@ -701,15 +698,19 @@ def bounded_bfs(expand_hops, alive: jax.Array, n: int,
     fused fixpoint loop exists to prevent)."""
     ids = jnp.arange(n, dtype=jnp.int32)
     r = ids == jnp.argmax(alive).astype(jnp.int32)
-    # safety bound: diameter can never exceed n, but a healthy overlay
-    # converges in O(log n) launches — 4096 hops total is far past any
-    # real fixpoint and only guards against a cyclic-expand bug
-    for _ in range(max(1, 4096 // hops)):
+    # safety bound scaled with n (ADVICE r5): a healthy overlay
+    # converges in O(log n) launches, but a legitimately long-diameter
+    # DEGRADED overlay (chain-like residual after heavy churn) can need
+    # up to n-1 hops — a fixed 4096-hop budget would abort an entire
+    # perf sweep from its health readback.  max(4096, n) still only
+    # guards against a cyclic-expand bug, never a real diameter.
+    budget = max(4096, n)
+    for _ in range(max(1, budget // hops)):
         r, changed = expand_hops(r, hops)
         if not bool(changed):
             return r
     raise RuntimeError(
-        f"bounded_bfs: no fixpoint within 4096 hops at n={n} — "
+        f"bounded_bfs: no fixpoint within {budget} hops at n={n} — "
         f"refusing to report connectivity from a truncated walk")
 
 

@@ -67,13 +67,44 @@ def pt_dense_init(cfg: Config) -> PtDense:
 
 def make_pt_dense_round(cfg: Config, root: int = 0,
                         broadcast_interval: int = 0,
-                        graft_timeout: int = 1):
+                        graft_timeout: int = 1,
+                        eager_only: bool = False):
     """One broadcast round over a dense HyParView state.  With
     ``broadcast_interval`` > 0 the root self-bumps its seq every that
     many rounds (the heartbeat workload); 0 = seqs only move when the
-    caller bumps them (single-shot coverage measurement)."""
+    caller bumps them (single-shot coverage measurement).
+
+    ``eager_only=True`` builds the LIGHT round of the plumtree cadence
+    (ISSUE 2): eager push only — one parent-seq gather, no digest scan
+    over the [N, A] neighbor plane and no graft repair.  That is the
+    reference's own timer split: eager payload forwarding is immediate
+    (:282-287) while the lazy i_have digests ride lazy_tick_period and
+    grafts fire from their timers (:341-345, 380-402) — the
+    run_pt_dense_staggered driver runs the full round on the heavy
+    maintenance grid and this one between, so a tree break heals within
+    one heavy window (<= k rounds; ``stale``/``graft_timeout`` then
+    count HEAVY rounds, bounding repair latency at k*graft_timeout
+    delivery rounds — the same detection-latency trade the membership
+    stagger makes)."""
     N = cfg.n_nodes
     ids = jnp.arange(N, dtype=jnp.int32)
+
+    if eager_only:
+        def light(hv: DenseHvState, pt: PtDense,
+                  rnd: jax.Array) -> PtDense:
+            seq = pt.seq
+            if broadcast_interval:
+                bump = (rnd % broadcast_interval) == 0
+                seq = seq.at[root].add(jnp.where(bump, 1, 0))
+            # one [N, 1] ROW gather (the scalar-gather cliff,
+            # BASELINE round-4 notes); a dead parent's seq is frozen,
+            # so delivering from it is a no-op by monotonicity
+            p_seq = jnp.where(
+                pt.parent >= 0,
+                seq[:, None][jnp.clip(pt.parent, 0, N - 1), 0], -1)
+            return PtDense(seq=jnp.maximum(seq, p_seq),
+                           parent=pt.parent, stale=pt.stale)
+        return light
 
     def step(hv: DenseHvState, pt: PtDense, rnd: jax.Array) -> PtDense:
         key = jax.random.fold_in(
@@ -155,39 +186,49 @@ def run_pt_dense(hv: DenseHvState, pt: PtDense, n_rounds: int,
     return hv, pt
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
 def run_pt_dense_staggered(hv: DenseHvState, pt: PtDense, n_blocks: int,
                            cfg: Config, churn: float = 0.0,
                            root: int = 0, k: int = 5,
+                           lazy: bool = True,
                            ) -> Tuple[DenseHvState, PtDense]:
     """Stacked(HyParView, Plumtree) on the phase-staggered membership
     cadence (hyparview_dense.run_dense_staggered's 2k-round block:
-    promotion+shuffle heavy, k-1 light, promotion heavy, k-1 light):
-    the BROADCAST plane runs every round — payload delivery is the 1 s
-    cadence in the reference (lazy_tick_period, partisan.hrl:58) —
-    while membership maintenance runs on its 2k/k timers.  This is
-    exactly the reference's timer layout: plumtree ticks at 1 s over a
-    HyParView whose shuffle/promotion timers fire at 10 s / 5 s.  Runs
-    n_blocks * 2k rounds (same launch-length gate as run_pt_dense —
-    chunk via :func:`run_pt_dense_staggered_chunked` at N > 2^16)."""
+    promotion+shuffle heavy, k-1 light, promotion heavy, k-1 light).
+    EAGER payload delivery runs every round — immediate forwarding in
+    the reference (:282-287) — while with ``lazy=True`` (ISSUE 2, the
+    default) the broadcast plane's own maintenance — the [N, A] digest
+    scan (lazy i_have, lazy_tick_period) and graft repair — rides the
+    HEAVY membership grid, mirroring the reference's lazy/exchange
+    timers over the 10 s / 5 s membership timers; light rounds run the
+    eager-only step (one parent gather).  ``lazy=False`` keeps the
+    round-4 shape (full broadcast round every round).  At k=1 there are
+    no light rounds, so lazy=True ≡ lazy=False bit-for-bit
+    (tests/test_plumtree_dense.py pins it).  Runs n_blocks * 2k rounds
+    (same launch-length gate as run_pt_dense — chunk via
+    :func:`run_pt_dense_staggered_chunked` at N > 2^16)."""
     limit = (1 << 21) if n_blocks * 2 * k <= launch_cap_for(cfg.n_nodes) \
         else (1 << 16)
     refuse_tpu_shape_bug(cfg.n_nodes, "dense plumtree", limit=limit)
     pt_step = make_pt_dense_round(cfg, root=root, broadcast_interval=5)
+    pt_light = make_pt_dense_round(cfg, root=root, broadcast_interval=5,
+                                   eager_only=True) if lazy else pt_step
 
-    def one(hv_step):
+    def one(hv_step, pt_round):
         def body(carry, _):
             hv, ptd = carry
             hv2 = hv_step(hv)
-            ptd2 = pt_step(hv2, ptd, hv.rnd)
+            ptd2 = pt_round(hv2, ptd, hv.rnd)
             return (hv2, ptd2), None
         return body
 
     # the cadence (block layout + exactness precondition) is defined
     # ONCE, in hyparview_dense.staggered_programs/staggered_scan — the
-    # broadcast plane only wraps each membership program with its own
-    # every-round tick
-    bodies = tuple(one(p) for p in staggered_programs(cfg, churn, k))
+    # broadcast plane wraps each membership program with its matching
+    # tick: full digest+graft on the heavies, eager-only between
+    hps, hp, light = staggered_programs(cfg, churn, k)
+    bodies = (one(hps, pt_step), one(hp, pt_step),
+              one(light, pt_light))
     return staggered_scan(bodies, (hv, pt), n_blocks, k)
 
 

@@ -195,13 +195,36 @@ K_SWEEP = 8
 
 def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
                            max_age: int = 64,
-                           skip: Tuple[str, ...] = ()):
-    # ``skip``: static tuple of {churn, admit, inview} phases to omit —
-    # the bisection/ablation surface for the N=2^16 TPU worker fault
-    # (ROADMAP 1d).  Static so every value is its own jit cache entry
-    # (the round-3 env-var gate was invisible to the cache and could
-    # silently reuse a stale program).  Production runs leave it empty.
+                           skip: Tuple[str, ...] = (),
+                           phase_window: int = 1):
+    # ``skip``: static tuple of phases to omit.  {churn, admit, inview}
+    # are the bisection/ablation surface for the N=2^16 TPU worker fault
+    # (ROADMAP 1d); {resub, sweep} are the CADENCE surface (ISSUE 2) —
+    # the staggered runner's light rounds omit the isolation
+    # re-subscribe (with its contact-row gather + members_first sort,
+    # the round's dominant whole-plane sort) and the stale sweep, both
+    # periodic maintenance in the reference (scamp_v2 :130-178 runs
+    # periodic/1 at 10 s against 1 s delivery).  Static so every value
+    # is its own jit cache entry (the round-3 env-var gate was
+    # invisible to the cache and could silently reuse a stale program).
+    # Production every-round runs leave it empty.
+    #
+    # ``phase_window=k`` > 1 is the HEAVY half of the staggered cadence
+    # (run_dense_scamp_staggered): the stale sweep widens to k*K_SWEEP
+    # columns so consecutive heavies (k rounds apart, each starting at
+    # column rnd*K_SWEEP) cover exactly the columns the every-round
+    # program would have — the per-round amortized sweep rate is
+    # preserved, quantized to the heavy grid.  Isolation re-subscribe
+    # needs no widening: `lonely` is a state predicate, so a node
+    # isolated in a light round is still lonely when the next heavy
+    # fires (detection latency <= k rounds, the reference's own
+    # periodic isolation-detection latency).  phase_window=1 (default)
+    # is bit-identical to the pre-cadence program.
     _dbg = frozenset(skip)
+    assert _dbg <= {"churn", "admit", "inview", "resub", "sweep"}, (
+        f"unknown phase(s) in skip: "
+        f"{_dbg - {'churn', 'admit', 'inview', 'resub', 'sweep'}}")
+    assert phase_window >= 1
     N = cfg.n_nodes
     # Loud gate, now at 2^20 (round 5): single launches of <=50 scanned
     # rounds run N=2^20 clean (1000-round soak) and run_dense_scamp
@@ -269,36 +292,47 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         # pass Check-fails outright on a neighboring ablation variant,
         # scripts/repro_scamp_dense_fault.py).  Runs in churn-free
         # programs too, so a settle window finishes the sweep.
-        cat = jnp.concatenate([partial, in_view], axis=1)
-        scat = jnp.concatenate([pstamp, ivstamp], axis=1)
-        W = cat.shape[1]
-        for j in range(K_SWEEP):
-            cj = (st.rnd * K_SWEEP + j) % W
-            col = jnp.take(cat, cj, axis=1)                  # [N]
-            lr = last_reset[jnp.clip(col, 0, N - 1)]         # [N]
-            # exact: delete iff the entry was admitted BEFORE the
-            # peer's last restart (same-round admissions are always
-            # post-clear — churn runs first in the step)
-            stale = (col >= 0) & (jnp.take(scat, cj, axis=1) < lr)
-            cat = cat.at[:, cj].set(jnp.where(stale, -1, col))
-        partial = cat[:, : partial.shape[1]]
-        in_view = cat[:, partial.shape[1]:]
+        if 'sweep' not in _dbg:
+            cat = jnp.concatenate([partial, in_view], axis=1)
+            scat = jnp.concatenate([pstamp, ivstamp], axis=1)
+            W = cat.shape[1]
+            # phase_window widens the rotating window so the k-cadence
+            # heavy round sweeps the k rounds' worth of columns the
+            # every-round program would have (see the param docstring)
+            for j in range(K_SWEEP * phase_window):
+                cj = (st.rnd * K_SWEEP + j) % W
+                col = jnp.take(cat, cj, axis=1)              # [N]
+                lr = last_reset[jnp.clip(col, 0, N - 1)]     # [N]
+                # exact: delete iff the entry was admitted BEFORE the
+                # peer's last restart (same-round admissions are always
+                # post-clear — churn runs first in the step)
+                stale = (col >= 0) & (jnp.take(scat, cj, axis=1) < lr)
+                cat = cat.at[:, cj].set(jnp.where(stale, -1, col))
+            partial = cat[:, : partial.shape[1]]
+            in_view = cat[:, partial.shape[1]:]
 
         # ---- re-subscribe: churned rows (cleared above) and isolated
-        # rows (empty view, no walkers) join through a fresh contact
-        lonely = alive & (jnp.sum(partial >= 0, axis=1) == 0) \
-            & (jnp.sum(pos >= 0, axis=1) == 0)
-        fresh = jax.random.randint(
-            jax.random.fold_in(key, 3), (N,), 0, N, jnp.int32)
-        fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)
-        st3 = _spawn_walks(
-            st.replace(partial=partial, in_view=in_view, walk_pos=pos,
-                       walk_age=age, pstamp=pstamp, ivstamp=ivstamp),
-            fresh, lonely, jax.random.fold_in(key, 4), cfg)
-        partial, in_view = st3.partial, st3.in_view
-        pstamp, ivstamp = st3.pstamp, st3.ivstamp
-        pos, age = st3.walk_pos, st3.walk_age
-        walk_truncated = st3.walk_truncated
+        # rows (empty view, no walkers) join through a fresh contact.
+        # Periodic in the cadence: a light round's lonely rows stay
+        # lonely until the next heavy fires (<= k rounds, the
+        # reference's periodic isolation-detection latency)
+        if 'resub' not in _dbg:
+            lonely = alive & (jnp.sum(partial >= 0, axis=1) == 0) \
+                & (jnp.sum(pos >= 0, axis=1) == 0)
+            fresh = jax.random.randint(
+                jax.random.fold_in(key, 3), (N,), 0, N, jnp.int32)
+            fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)
+            st3 = _spawn_walks(
+                st.replace(partial=partial, in_view=in_view,
+                           walk_pos=pos, walk_age=age, pstamp=pstamp,
+                           ivstamp=ivstamp),
+                fresh, lonely, jax.random.fold_in(key, 4), cfg)
+            partial, in_view = st3.partial, st3.in_view
+            pstamp, ivstamp = st3.pstamp, st3.ivstamp
+            pos, age = st3.walk_pos, st3.walk_age
+            walk_truncated = st3.walk_truncated
+        else:
+            walk_truncated = st.walk_truncated
 
         # ---- one walk hop for every active walker.  The walker plane
         # touches only O(N*C) SCALARS: view sizes are gathered from a
@@ -488,6 +522,66 @@ def run_dense_scamp(st: DenseScampState, n_rounds: int, cfg: Config,
         step_n = min(cap, n_rounds - done)
         st = _run_dense_scamp_launch(st, step_n, cfg, churn, skip)
         done += step_n
+    return st
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def run_dense_scamp_staggered(st: DenseScampState, n_blocks: int,
+                              cfg: Config, churn: float = 0.0,
+                              k: int = 5,
+                              skip: Tuple[str, ...] = ()
+                              ) -> DenseScampState:
+    """Phase-staggered dense SCAMP (ISSUE 2): the hyparview_dense
+    cadence recipe at the reference's own timer layout — walk
+    delivery/keep/admit every round (the 1 s message plane), isolation
+    re-subscribe + stale sweep every k-th round (scamp_v2's periodic/1
+    runs at 10 s, :130-178).  One k-round block is
+
+        [heavy (resub + k-widened sweep + delivery), light x k-1]
+
+    with per-node maintenance cadence preserved (see
+    make_dense_scamp_round's phase_window contract) — at k=1 the block
+    collapses to the every-round program and the trajectory is
+    BIT-IDENTICAL to run_dense_scamp (tests/test_scamp_dense.py pins
+    it).  The trade is the C=8-shaped one (walker_caps docstring):
+    bootstrap knits ~2x slower (resub latency <= k) and views settle
+    thinner (N=256 CPU: mean_view ~2.9 vs 4.1 flat) while weak
+    connectivity converges to the same near-full regime (99%+ reached)
+    — asserted distributionally by the cadence tests.  Runs
+    n_blocks * k rounds; chunk via
+    :func:`run_dense_scamp_staggered_chunked` at N > 2^16."""
+    limit = (1 << 20) if n_blocks * k <= launch_cap_for(cfg.n_nodes) \
+        else (1 << 16)
+    refuse_tpu_shape_bug(cfg.n_nodes, "dense SCAMP staggered",
+                         limit=limit)
+    from .dense_cadence import as_body, block_scan
+    heavy = make_dense_scamp_round(cfg, churn, skip=skip,
+                                   phase_window=k)
+    light = make_dense_scamp_round(
+        cfg, churn, skip=tuple(skip) + ("resub", "sweep"))
+    return block_scan([(as_body(heavy), 1), (as_body(light), k - 1)],
+                      st, n_blocks)
+
+
+def run_dense_scamp_staggered_chunked(st: DenseScampState,
+                                      n_blocks: int, cfg: Config,
+                                      churn: float = 0.0, k: int = 5,
+                                      skip: Tuple[str, ...] = ()
+                                      ) -> DenseScampState:
+    """run_dense_scamp_staggered in launches of whole k-round blocks,
+    at most launch_cap_for(N) rounds per launch (the validated
+    bounded-launch shape; chunking is semantically invisible — the
+    carried state is identical, tests/test_scamp_dense.py)."""
+    cap = launch_cap_for(cfg.n_nodes)
+    assert k <= cap, (
+        f"staggered block of k={k} rounds exceeds the validated launch "
+        f"cap {cap} at N={cfg.n_nodes}; lower k")
+    cap_blocks = max(1, cap // k)
+    done = 0
+    while done < n_blocks:
+        b = min(cap_blocks, n_blocks - done)
+        st = run_dense_scamp_staggered(st, b, cfg, churn, k, skip)
+        done += b
     return st
 
 

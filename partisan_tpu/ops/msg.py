@@ -184,20 +184,38 @@ def monotonic_elide(m: Msgs, n_nodes: int, mono_mask: jax.Array,
 
 def _route(m: Msgs, n_nodes: int, inbox_cap: int,
            key: Optional[jax.Array],
-           n_channels: int, parallelism: int):
+           n_channels: int, parallelism: int,
+           n_total: Optional[int] = None, node_base: int = 0):
     """Shared routing core of build_inbox / build_inbox_idx: stable
     lexsort by destination, then per-connection random, then emission
     round + position (stability) — delivery order randomized ACROSS
     connections but FIFO WITHIN a (src, dst, channel, lane) connection,
     TCP's guarantee.  Returns (order, ok, overflow, flat_idx, dump):
     sorted-position i holds message ``order[i]``; ``flat_idx[i]`` is its
-    [N * cap (+1 dump)] inbox cell."""
+    [N * cap (+1 dump)] inbox cell.
+
+    ``n_total``/``node_base`` are the shard-local form used by the
+    explicit dataplane (parallel/dataplane.py): ``n_nodes`` counts the
+    LOCAL rows, destinations index the inbox as ``dst - node_base``,
+    and the per-connection hash keys on GLOBAL ids over ``n_total``
+    nodes — so a shard-local route of the messages destined to this
+    shard assigns the same inbox cells and intra-inbox order as the
+    global route does (tests/test_mesh.py asserts the bit-parity).
+    Defaults reduce to the single-program behavior."""
     M = m.cap
     deliver = m.valid & (m.delay <= 0)
-    sort_key = jnp.where(deliver, m.dst, n_nodes)  # undeliverable -> end
+    if n_total is None:
+        local = m.dst
+    else:
+        # node_base may be a TRACED scalar (lax.axis_index inside the
+        # dataplane's shard_map body) — gate on the static n_total flag
+        local = m.dst - node_base
+        deliver = deliver & (local >= 0) & (local < n_nodes)
+    sort_key = jnp.where(deliver, local, n_nodes)  # undeliverable -> end
     if key is not None:
         salt = jax.random.bits(key, (), jnp.uint32)
-        grand = _mix(jnp.uint32(_conn_key(m, n_nodes, n_channels,
+        grand = _mix(jnp.uint32(_conn_key(m, n_total or n_nodes,
+                                          n_channels,
                                           parallelism)) ^ salt)
     else:
         grand = jnp.zeros((M,), jnp.uint32)
@@ -254,6 +272,7 @@ def build_inbox_idx(
     m: Msgs, n_nodes: int, inbox_cap: int,
     key: Optional[jax.Array] = None,
     n_channels: int = 1, parallelism: int = 1,
+    n_total: Optional[int] = None, node_base: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Index-form routing: :func:`build_inbox`'s sort, but the inbox holds
     flat-buffer INDICES ``[N, inbox_cap] int32`` (empty slot = ``m.cap``)
@@ -267,9 +286,12 @@ def build_inbox_idx(
     build_inbox this returns no held buffer.  Returns
     ``(idx, valid, overflow)``; delivery order semantics are identical to
     build_inbox by construction — both consume :func:`_route`.
+    ``n_total``/``node_base`` select the shard-local routing form (see
+    :func:`_route`).
     """
     order, ok, overflow, flat_idx, dump = _route(
-        m, n_nodes, inbox_cap, key, n_channels, parallelism)
+        m, n_nodes, inbox_cap, key, n_channels, parallelism,
+        n_total=n_total, node_base=node_base)
     idx = jnp.full((dump + 1,), m.cap, jnp.int32).at[flat_idx].set(
         order.astype(jnp.int32))[:dump].reshape((n_nodes, inbox_cap))
     vld = jnp.zeros((dump + 1,), bool).at[flat_idx].set(

@@ -1,1 +1,5 @@
-from .mesh import make_mesh, node_sharding, place_world, shard_spec
+from .mesh import (assert_collective_budget, collective_stats, make_mesh,
+                   node_sharding, place_world, shard_spec)
+from .dataplane import (init_sharded_world, make_sharded_run_scan,
+                        make_sharded_step, place_sharded_world,
+                        shard_align_msgs, sharded_out_cap)

@@ -74,25 +74,28 @@ def collective_stats(compiled: Any) -> dict:
     (VERDICT r4 #7): on a real slice these are the ICI transfers, so
     their count and byte volume are the per-round communication cost.
 
-    Returns ``{"counts": {op: n}, "all_gather_outputs": [(shape_str,
-    elements, bytes)], "all_gather_total_bytes": int}``.  Byte figures
-    are whole-array (the per-device wire cost is that times
-    (devices-1)/devices for a ring all-gather).
+    Returns ``{"counts": {op: n}, "outputs": {op: [(shape_str,
+    elements, bytes)]}, "total_bytes": {op: int}, "all_gather_outputs":
+    [...], "all_gather_total_bytes": int}`` (the last two are the
+    legacy all-gather views of the same data).  Byte figures are
+    whole-array (the per-device wire cost is that times
+    (devices-1)/devices for a ring all-gather; for an all-to-all it is
+    (devices-1)/devices of the per-device buffer).
 
     Handles the partitioner's variadic/combined form (tuple result
     shapes) and the async split (``all-gather-start``; the matching
     ``-done`` is not double-counted).  For async/tuple forms every
     shape token in the result is accounted, which can include operand
     aliases — a slight OVERcount, i.e. conservative for the cap tests
-    built on top.  Raises if an all-gather was counted but no result
+    built on top.  Raises if any collective was counted but no result
     shape could be parsed (parser drift must fail loudly, not let the
     quality gate pass vacuously)."""
     import re
+    ops = ("all-gather", "collective-permute", "reduce-scatter",
+           "all-reduce", "all-to-all")
     txt = compiled.as_text()
-    counts = {op: 0 for op in (
-        "all-gather", "collective-permute", "reduce-scatter",
-        "all-reduce", "all-to-all")}
-    ag = []
+    counts = {op: 0 for op in ops}
+    outputs = {op: [] for op in ops}
     line_re = re.compile(
         r"= (.*?) (all-gather|collective-permute|reduce-scatter|"
         r"all-reduce|all-to-all)(-start)?\(")
@@ -102,24 +105,55 @@ def collective_stats(compiled: Any) -> dict:
             continue
         res, op = m.group(1), m.group(2)
         counts[op] += 1
-        if op != "all-gather":
-            continue
         for sm in re.finditer(r"(\w+)\[([\d,]*)\]", res):
             dt, dims = sm.group(1), sm.group(2)
             if dt not in _DTYPE_BYTES:
                 continue
             shape = [int(d) for d in dims.split(",")] if dims else []
             elems = int(np.prod(shape)) if shape else 1
-            ag.append((f"{dt}[{dims}]", elems,
-                       elems * _DTYPE_BYTES[dt]))
-    if counts["all-gather"] > 0 and not ag:
-        raise ValueError(
-            "collective_stats: all-gather instructions present but no "
-            "result shapes parsed — HLO text format drifted; fix the "
-            "parser before trusting the comms quality gate")
+            outputs[op].append((f"{dt}[{dims}]", elems,
+                                elems * _DTYPE_BYTES[dt]))
+    for op in ops:
+        if counts[op] > 0 and not outputs[op]:
+            raise ValueError(
+                f"collective_stats: {op} instructions present but no "
+                f"result shapes parsed — HLO text format drifted; fix "
+                f"the parser before trusting the comms quality gate")
+    total = {op: sum(b for _, _, b in outputs[op]) for op in ops}
     return {"counts": counts,
-            "all_gather_outputs": ag,
-            "all_gather_total_bytes": sum(b for _, _, b in ag)}
+            "outputs": outputs,
+            "total_bytes": total,
+            "all_gather_outputs": outputs["all-gather"],
+            "all_gather_total_bytes": total["all-gather"]}
+
+
+def assert_collective_budget(compiled: Any, *, max_collectives: int,
+                             max_bytes: int,
+                             forbid: Sequence[str] = ()) -> dict:
+    """The hard per-round communication budget of the explicit dataplane
+    (ISSUE 2): the compiled round may contain at most
+    ``max_collectives`` cross-device collectives totalling at most
+    ``max_bytes`` of whole-array result bytes, and none of the op kinds
+    in ``forbid`` (e.g. ``("all-gather",)`` — the dataplane exists to
+    replace whole-state gathers).  Raises AssertionError with the full
+    stats on violation; returns the stats so gates can log them.  This
+    converts multi-chip perf from "hope XLA infers it" into an asserted
+    contract — a regression that grows a third collective or re-gathers
+    a state plane fails the comms quality gate outright
+    (tests/test_mesh.py)."""
+    st = collective_stats(compiled)
+    n = sum(st["counts"].values())
+    assert n <= max_collectives, (
+        f"collective budget blown: {n} collectives > {max_collectives} "
+        f"allowed per round", st["counts"])
+    for op in forbid:
+        assert st["counts"].get(op, 0) == 0, (
+            f"forbidden collective {op} present", st["counts"])
+    total = sum(st["total_bytes"].values())
+    assert total <= max_bytes, (
+        f"collective byte ceiling blown: {total} > {max_bytes}",
+        st["total_bytes"])
+    return st
 
 
 def constrain(tree: Any, mesh: Mesh) -> Any:

@@ -38,7 +38,11 @@ Guarantees and their guards:
   * a call that passes ``self`` to a non-method -> ValueError likewise
     (emissions could hide behind it);
   * methods are resolved on ``type(proto)`` so subclass overrides
-    (e.g. BernsteinCTP._participant_tick) are the bodies walked.
+    (e.g. BernsteinCTP._participant_tick) are the bodies walked;
+  * zero-arg ``super().method()`` resolves past the defining class via
+    the MRO and the parent body is walked too (XBotHyParView.tick ->
+    HyParView.tick's shuffle/promotion literals); two-arg super and
+    ``super().typ`` raise rather than under-approximate.
 
 Output matches verify/analysis.py's map shape — ``{type: [caused
 types]}`` plus ``__tick__`` — and plugs directly into
@@ -68,8 +72,8 @@ _LEAF_METHODS = frozenset({
 })
 
 
-def _method_ast(cls: type, name: str):
-    fn = getattr(cls, name, None)
+def _fn_ast(fn):
+    fn = getattr(fn, "__func__", fn)   # unwrap class/static methods
     if fn is None or not callable(fn):
         return None
     try:
@@ -79,14 +83,61 @@ def _method_ast(cls: type, name: str):
     return ast.parse(src)
 
 
+def _defining_class(cls: type, name: str):
+    """First class in cls's MRO whose __dict__ holds ``name`` — the
+    class ``super()`` inside that body resolves RELATIVE TO."""
+    for c in cls.__mro__:
+        if name in c.__dict__:
+            return c
+    return None
+
+
+def _resolve_super(cls: type, defining: type, name: str):
+    """Zero-arg ``super().name`` resolution as the interpreter performs
+    it: the first class AFTER ``defining`` in ``cls``'s MRO that
+    defines ``name``."""
+    mro = cls.__mro__
+    try:
+        i = mro.index(defining)
+    except ValueError:                 # pragma: no cover — defensive
+        return None
+    for c in mro[i + 1:]:
+        if name in c.__dict__:
+            return c
+    return None
+
+
+def _is_super_attr(f) -> bool:
+    """AST shape of ``super().<attr>`` (zero-arg form)."""
+    return (isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Name)
+            and f.value.func.id == "super")
+
+
 def _walk_method(cls: type, name: str, seen: Set[str],
-                 out: Set[str]) -> None:
+                 out: Set[str], owner: type = None) -> None:
     """Accumulate into ``out`` every ``self.typ("<lit>")`` argument in
-    ``name``'s body and, transitively, in every self-method it calls."""
-    if name in seen or name in _LEAF_METHODS:
+    ``name``'s body and, transitively, in every self-method it calls —
+    including methods reached through zero-arg ``super()`` (ADVICE r5
+    high: ``XBotHyParView.tick`` calls ``super().tick``, whose shuffle/
+    promotion literals the walk previously missed SILENTLY, violating
+    the superset-or-loud-ValueError contract).  ``owner`` pins which
+    MRO class supplies the body (the super() chain); None = dynamic
+    resolution on ``cls``.  ``seen`` keys on (defining class, name) so
+    an override and the parent body it extends are both walked."""
+    if name in _LEAF_METHODS:
         return
-    seen.add(name)
-    tree = _method_ast(cls, name)
+    defining = owner if owner is not None else _defining_class(cls, name)
+    if defining is None:
+        # not a class attribute anywhere in the MRO (instance-only data
+        # attr, or plain absent) — nothing to walk
+        return
+    key = (defining.__qualname__, name)
+    if key in seen:
+        return
+    seen.add(key)
+    tree = _fn_ast(defining.__dict__.get(name))
     if tree is None:
         return
     # direct-call positions: an Attribute that is the func of some Call.
@@ -119,6 +170,29 @@ def _walk_method(cls: type, name: str, seen: Set[str],
                         f"self.typ(...) call — the static walk cannot "
                         f"bound its value (line {node.lineno})")
                 out.add(node.args[0].value)
+            elif _is_super_attr(f):
+                # super().method(...) — resolve past the DEFINING class
+                # via the MRO and walk the parent body (ADVICE r5 high:
+                # skipping it silently under-approximated the edge set)
+                if f.value.args:
+                    raise ValueError(
+                        f"{cls.__name__}.{name}: two-arg super() call "
+                        f"(line {node.lineno}) — only zero-arg super "
+                        f"resolution is modeled; the walk cannot bound "
+                        f"an explicit-class dispatch")
+                if f.attr == "typ":
+                    raise ValueError(
+                        f"{cls.__name__}.{name}: super().typ(...) "
+                        f"(line {node.lineno}) — tag literals must go "
+                        f"through self.typ for the literal extraction")
+                parent = _resolve_super(cls, defining, f.attr)
+                if parent is None:
+                    raise ValueError(
+                        f"{cls.__name__}.{name}: super().{f.attr} "
+                        f"(line {node.lineno}) resolves to nothing "
+                        f"past {defining.__name__} in the MRO — "
+                        f"refusing to under-approximate")
+                _walk_method(cls, f.attr, seen, out, owner=parent)
             elif not is_self_call:
                 # emissions can only hide behind a callee that receives
                 # `self`; refuse loudly rather than under-approximate

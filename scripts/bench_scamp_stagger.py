@@ -1,0 +1,101 @@
+"""Flat-vs-staggered dense-SCAMP A/B on the current backend (ISSUE 2).
+
+The official TPU rows ride scripts/perf_suite.py (scamp_dense_stag_*);
+this standalone probe measures the SAME two programs interleaved in one
+process — the cross-variant comparison discipline BASELINE.md
+prescribes — so a CPU-only environment can still record the stagger's
+measured speedup honestly.  Appends two rows to results.csv:
+
+    scamp_dense_{n}_flat_{dev},  scamp_dense_{n}_stag_{dev}
+
+Usage: python scripts/bench_scamp_stagger.py [--n 65536] [--rounds 40]
+       [--k 5] [--out results.csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu.models.scamp_dense import (  # noqa: E402
+    dense_scamp_init, run_dense_scamp, run_dense_scamp_staggered_chunked,
+    scamp_health)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 16)
+    ap.add_argument("--rounds", type=int, default=40,
+                    help="timed rounds per trial (multiple of k)")
+    ap.add_argument("--k", type=int, default=5)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--out", default="results.csv")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    n, k = args.n, args.k
+    rounds = (args.rounds // k) * k
+    cfg = pt.Config(n_nodes=n)
+    dev = jax.devices()[0].platform
+
+    flat = lambda s: run_dense_scamp(s, rounds, cfg, 0.01)
+    stag = lambda s: run_dense_scamp_staggered_chunked(
+        s, rounds // k, cfg, 0.01, k)
+
+    # compile + sync both programs before any timing
+    for run in (flat, stag):
+        out = run(dense_scamp_init(cfg))
+        float(jnp.sum(out.partial))
+        del out
+
+    rows = []
+    for name, run in (("flat", flat), ("stag", stag)):
+        rates, out = [], None
+        # INTERLEAVED seeds per variant; fresh world per trial (the
+        # result-cache trap of the perf-suite notes)
+        for t in range(args.trials):
+            s0 = dense_scamp_init(cfg.replace(seed=29 + 11 * t))
+            out = None
+            t0 = time.perf_counter()
+            out = run(s0)
+            float(jnp.sum(out.partial))          # sync
+            rates.append(rounds / (time.perf_counter() - t0))
+            del s0
+        out = run_dense_scamp(out, 60, cfg)      # settle, then health
+        h = {kk: float(np.asarray(v))
+             for kk, v in scamp_health(out).items()}
+        rps = statistics.median(rates)
+        health = ("connected" if h.get("connected")
+                  else f"reached={h['reached']:.0f}/{h['live']:.0f}")
+        rows.append([f"scamp_dense_{n}_{name}_{dev}", n, rounds,
+                     round(rounds / rps, 4), round(rps, 1),
+                     f"{health},mean_view={h['mean_view']:.1f},"
+                     f"cadence={'ref10/1k%d' % k if name == 'stag' else 'flat'},"
+                     f"churn=0.01"])
+        print(f"{rows[-1][0]:32s} {rps:9.2f} rounds/s  ({health})")
+
+    speedup = rows[1][4] / max(rows[0][4], 1e-9)
+    print(f"stagger speedup at N={n} on {dev}: {speedup:.2f}x")
+    new = not os.path.exists(args.out)
+    with open(args.out, "a", newline="") as f:
+        w = csv.writer(f)
+        if new:
+            w.writerow(["config", "n_nodes", "rounds", "seconds",
+                        "rounds_per_sec", "health"])
+        w.writerows(rows)
+
+
+if __name__ == "__main__":
+    main()

@@ -252,17 +252,14 @@ def main() -> None:
         # mean view size after a settle window
         import statistics as _st
         from partisan_tpu.models.scamp_dense import (
-            dense_scamp_init, run_dense_scamp, scamp_health)
-        # N>=2^16 runs chunked (scamp_dense.launch_cap_for): single
-        # launches beyond ~100 scanned rounds at 2^16 — and beyond ~50
-        # at 2^20 — fault the TPU worker
-        # (scripts/repro_scamp_dense_fault.py pins it, ROADMAP 1d);
-        # the capped launches soak clean (1000+ rounds at both shapes)
-        for n, rnds in ((1 << 12, 2000), (1 << 16, 200), (1 << 20, 200)):
-            if args.quick:
-                rnds = min(rnds, 200)
-            cfg = pt.Config(n_nodes=n)
-            warm = run_dense_scamp(dense_scamp_init(cfg), rnds, cfg, 0.01)
+            dense_scamp_init, run_dense_scamp,
+            run_dense_scamp_staggered_chunked, scamp_health)
+
+        def scamp_bench(name, n, rnds, cfg, run_trial, cadence):
+            """Shared scamp_dense timing discipline (flat + staggered
+            rows): warmup compile+sync, 3 reseeded trials, settle, weak
+            connectivity health."""
+            warm = run_trial(dense_scamp_init(cfg))
             float(jnp.sum(warm.partial))         # compile + real sync
             # the 2^20 state is ~2.8 GB (P=166 view cap x 4 int32
             # planes); holding warm + the previous trial's out + the
@@ -274,7 +271,7 @@ def main() -> None:
                 s0 = dense_scamp_init(cfg.replace(seed=17 + 5 * t))
                 out = None                       # free previous trial
                 t0 = time.perf_counter()
-                out = run_dense_scamp(s0, rnds, cfg, 0.01)
+                out = run_trial(s0)
                 float(jnp.sum(out.partial))      # sync
                 rates.append(rnds / (time.perf_counter() - t0))
                 del s0
@@ -284,12 +281,38 @@ def main() -> None:
             rps = _st.median(rates)
             health = ("connected" if h.get("connected")
                       else f"reached={h['reached']:.0f}/{h['live']:.0f}")
-            rows.append([f"scamp_dense_{n}", n, rnds,
+            rows.append([name, n, rnds,
                          round(rnds / rps, 4), round(rps, 1),
                          f"{health},mean_view={h['mean_view']:.1f},"
-                         f"churn=0.01"])
-            print(f"{'scamp_dense_' + str(n):28s} N={n:<7d} "
+                         f"{cadence}churn=0.01"])
+            print(f"{name:28s} N={n:<7d} "
                   f"{rps:9.1f} rounds/s  ({health})")
+
+        # N>=2^16 runs chunked (scamp_dense.launch_cap_for): single
+        # launches beyond ~100 scanned rounds at 2^16 — and beyond ~50
+        # at 2^20 — fault the TPU worker
+        # (scripts/repro_scamp_dense_fault.py pins it, ROADMAP 1d);
+        # the capped launches soak clean (1000+ rounds at both shapes)
+        for n, rnds in ((1 << 12, 2000), (1 << 16, 200), (1 << 20, 200)):
+            if args.quick:
+                rnds = min(rnds, 200)
+            cfg = pt.Config(n_nodes=n)
+            scamp_bench(
+                f"scamp_dense_{n}", n, rnds, cfg,
+                lambda s0, cfg=cfg, rnds=rnds:
+                    run_dense_scamp(s0, rnds, cfg, 0.01), "")
+            # ISSUE 2: the reference-cadence staggered rows (walk
+            # delivery every round, resub + sweep every k=5th —
+            # scamp_v2's periodic/1 at 10 s vs 1 s delivery); the
+            # k=1-reduction and chunk-equivalence tests pin semantics
+            k = 5
+            blocks = rnds // k
+            scamp_bench(
+                f"scamp_dense_stag_{n}", n, blocks * k, cfg,
+                lambda s0, cfg=cfg, blocks=blocks:
+                    run_dense_scamp_staggered_chunked(
+                        s0, blocks, cfg, 0.01, k),
+                f"cadence=ref10/1k{k},")
 
     if want("pt_dense") and jax.devices()[0].platform == "tpu":
         # VERDICT r2 weak #6: broadcast layer at TPU scale — plumtree
@@ -530,9 +553,11 @@ def main() -> None:
         # different from the warmup: the tunnel's (executable, input)
         # result cache persists across processes, and a fixed timed
         # seed replayed a cached run as a bogus 600k-rounds/s row
-        # (round 5; bench.py's notes describe the same trap)
+        # (round 5; bench.py's notes describe the same trap).  Drawn
+        # from [1, n) so it can NEVER equal the warmup seed 0 and
+        # replay the in-process cache either (ADVICE r5)
         n, rounds = 1_000_000, 1000
-        seed = int.from_bytes(os.urandom(4), "little") % n
+        seed = 1 + int.from_bytes(os.urandom(4), "little") % (n - 1)
         out = rumor_run(rumor_init(n, 0), rounds, n, 2, 1, 0.01)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

@@ -26,6 +26,14 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the multichip/dataplane rows need the 8-device virtual CPU mesh; the
+# flag must be in the environment BEFORE the first backend init
+# (tests/conftest.py applies the same setup)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax  # noqa: E402
 
 # parity cases belong on the CPU backend (the real chip stays free for
@@ -492,6 +500,89 @@ def hyparview_high_active_test():
 def hyparview_high_client_test():
     """hyparview_manager_high_client_test: many clients on few servers."""
     client_server_manager_test()
+
+
+def sharded_dataplane_parity_test():
+    """ISSUE 2 tentpole contract: 20 rounds of HyParView through the
+    explicit shard_map dataplane (parallel/dataplane.py) on the
+    8-device CPU mesh bit-match the unsharded engine step — metrics and
+    state."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel import make_mesh
+    from partisan_tpu.parallel.dataplane import (
+        make_sharded_step, place_sharded_world, sharded_out_cap)
+    n = 64
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    mesh = make_mesh(n_devices=8)
+    pairs = [(i, i - 1) for i in range(1, n)]
+    w = ps.cluster(pt.init_world(cfg, proto), proto, pairs, stagger=16)
+    step = pt.make_step(cfg, proto, donate=False)
+    w2 = ps.cluster(
+        pt.init_world(cfg, proto,
+                      out_cap=sharded_out_cap(cfg, proto, 8)),
+        proto, pairs, stagger=16)
+    w2 = place_sharded_world(w2, cfg, mesh)
+    sstep = make_sharded_step(cfg, proto, mesh, donate=False)
+    for _ in range(20):
+        w, mp = step(w)
+        w2, msh = sstep(w2)
+        assert all(int(msh[k]) == int(v) for k, v in mp.items()), \
+            (mp, msh)
+        assert int(msh["xshard_dropped"]) == 0
+    for lp, lsh in zip(jax.tree_util.tree_leaves(w.state),
+                       jax.tree_util.tree_leaves(w2.state)):
+        assert (np.asarray(lp) == np.asarray(lsh)).all()
+
+
+def collective_budget_test():
+    """ISSUE 2 comms gate: the compiled sharded round carries exactly
+    one all_to_all + one psum — never an all-gather."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel import make_mesh
+    from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                 make_sharded_step)
+    from partisan_tpu.parallel.mesh import assert_collective_budget
+    cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    mesh = make_mesh(n_devices=8)
+    w = init_sharded_world(cfg, proto, mesh)
+    comp = make_sharded_step(cfg, proto, mesh,
+                             donate=False).lower(w).compile()
+    st = assert_collective_budget(comp, max_collectives=2,
+                                  max_bytes=32 * 1024 * 1024,
+                                  forbid=("all-gather",))
+    assert st["counts"]["all-to-all"] == 1
+
+
+def scamp_stagger_equivalence_test():
+    """ISSUE 2 cadence: dense-SCAMP staggered at k=1 IS the every-round
+    program (bit-equal), and chunked k=5 launches match single."""
+    from partisan_tpu.models.scamp_dense import (
+        dense_scamp_init, run_dense_scamp, run_dense_scamp_staggered)
+    cfg = pt.Config(n_nodes=64, seed=4)
+    a = run_dense_scamp(dense_scamp_init(cfg), 20, cfg, 0.02)
+    b = run_dense_scamp_staggered(dense_scamp_init(cfg), 20, cfg,
+                                  0.02, 1)
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        assert (np.asarray(la) == np.asarray(lb)).all()
+
+
+def plumtree_lazy_equivalence_test():
+    """ISSUE 2 cadence: the plumtree lazy cadence at k=1 equals the
+    full-broadcast-every-round program bit-for-bit."""
+    from partisan_tpu.models.hyparview_dense import dense_init, run_dense
+    from partisan_tpu.models.plumtree_dense import (
+        pt_dense_init, run_pt_dense_staggered)
+    cfg = pt.Config(n_nodes=64, seed=3)
+    hv = run_dense(dense_init(cfg), 60, cfg)
+    p0 = pt_dense_init(cfg)
+    a = run_pt_dense_staggered(hv, p0, 4, cfg, 0.01, 0, 1, True)
+    b = run_pt_dense_staggered(hv, p0, 4, cfg, 0.01, 0, 1, False)
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        assert (np.asarray(la) == np.asarray(lb)).all()
 
 
 def performance_test():
@@ -1027,6 +1118,19 @@ def build_matrix():
         lambda: delay_test("egress"))
     add("with_broadcast", "hyparview_manager_high_active_test",
         "hyparview", "engine", broadcast_test)
+
+    # ISSUE 2: the explicit shard_map dataplane + dense-phase cadences
+    # as standing matrix rows (no reference analog — these are the
+    # TPU-native distribution contracts the round-synchronous rebuild
+    # adds on top of the CT matrix)
+    add("multichip/dataplane", "sharded_dataplane_parity_test",
+        "hyparview", "engine", sharded_dataplane_parity_test)
+    add("multichip/dataplane", "collective_budget_test", "hyparview",
+        "engine", collective_budget_test)
+    add("dense_cadence", "scamp_stagger_equivalence_test", "scamp_v2",
+        "engine", scamp_stagger_equivalence_test)
+    add("dense_cadence", "plumtree_lazy_equivalence_test", "hyparview",
+        "engine", plumtree_lazy_equivalence_test)
 
     return M
 

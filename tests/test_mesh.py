@@ -219,6 +219,137 @@ class TestShardedDenseHyParView:
 
 
 @needs_mesh
+class TestShardMapDataplane:
+    """The EXPLICIT dataplane (parallel/dataplane.py, ISSUE 2): a
+    shard_map round whose only cross-device traffic is one bucketed
+    all_to_all + one psum — asserted as a hard budget — and whose
+    states and metrics are bit-identical to the unsharded engine step."""
+
+    def _run_pair(self, n, rounds):
+        from partisan_tpu.parallel.dataplane import (
+            make_sharded_step, place_sharded_world, sharded_out_cap)
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+
+        def boot(out_cap=None):
+            w = pt.init_world(cfg, proto, out_cap=out_cap)
+            return ps.cluster(w, proto,
+                              [(i, i - 1) for i in range(1, n)],
+                              stagger=16)
+
+        w_plain = boot()
+        step = pt.make_step(cfg, proto, donate=False)
+        w_shard = place_sharded_world(
+            boot(out_cap=sharded_out_cap(cfg, proto, 8)), cfg, mesh)
+        sstep = make_sharded_step(cfg, proto, mesh, donate=False)
+        m_plain, m_shard = [], []
+        for _ in range(rounds):
+            w_plain, mp = step(w_plain)
+            w_shard, msh = sstep(w_shard)
+            m_plain.append({k: int(v) for k, v in mp.items()})
+            m_shard.append({k: int(v) for k, v in msh.items()})
+        return cfg, proto, w_plain, w_shard, m_plain, m_shard
+
+    def test_dataplane_bit_equal_to_unsharded_step(self):
+        """60 rounds of HyParView N=256 through the explicit dataplane:
+        every per-round metric and every final state leaf bit-matches
+        the unsharded engine step, the overlay connects, and nothing
+        was dropped to the exchange buckets (the lossless default)."""
+        n, rounds = 256, 60
+        _, _, w_plain, w_shard, m_plain, m_shard = self._run_pair(
+            n, rounds)
+        for mp, msh in zip(m_plain, m_shard):
+            assert all(msh[k] == v for k, v in mp.items()), (mp, msh)
+            assert msh["xshard_dropped"] == 0, msh
+            # an honest comparison needs real buffer pressure to be
+            # absent on BOTH sides (capacity semantics differ per shard)
+            assert msh["out_dropped"] == 0, msh
+        for lp, lsh in zip(jax.tree_util.tree_leaves(w_plain.state),
+                           jax.tree_util.tree_leaves(w_shard.state)):
+            np.testing.assert_array_equal(np.asarray(lp),
+                                          np.asarray(lsh))
+        adj = graph.adjacency_from_views(w_shard.state.active, n)
+        assert bool(graph.is_connected(adj)), "sharded overlay split"
+
+    def test_dataplane_collective_budget(self):
+        """The comms quality gate, now a HARD budget (vs the implicit
+        path's 11 XLA-inferred all-gathers per round): the compiled
+        round carries at most 2 collectives — ONE all_to_all (the
+        packed message exchange) + ONE all-reduce (the stacked metric
+        psum) — zero all-gathers, within the byte ceiling of the
+        exchange buffer itself."""
+        from partisan_tpu.parallel.dataplane import (
+            _field_layout, init_sharded_world, make_sharded_step,
+            sharded_out_cap)
+        from partisan_tpu.parallel.mesh import assert_collective_budget
+        cfg = pt.Config(n_nodes=256, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        w = init_sharded_world(cfg, proto, mesh)
+        step = make_sharded_step(cfg, proto, mesh, donate=False)
+        comp = step.lower(w).compile()
+        _, _, F = _field_layout(proto.data_spec)
+        m_loc = sharded_out_cap(cfg, proto, 8) // 8
+        # ceiling: the per-device exchange buffer (sent + received +
+        # slack for the parser's conservative operand-alias overcount)
+        # + the metrics vector — any third collective or a re-grown
+        # whole-state gather blows straight through it
+        ceiling = 3 * (8 * m_loc * (F + 1) * 4) + 64
+        st = assert_collective_budget(
+            comp, max_collectives=2, max_bytes=ceiling,
+            forbid=("all-gather",))
+        assert st["counts"]["all-to-all"] == 1, st["counts"]
+        assert st["counts"]["all-reduce"] == 1, st["counts"]
+
+    def test_bucket_overflow_counted_never_silent(self):
+        """An undersized bucket_cap drops cross-shard messages — but
+        counted (xshard_dropped), never silently (SURVEY §7.3)."""
+        from partisan_tpu.parallel.dataplane import (
+            make_sharded_step, place_sharded_world, sharded_out_cap)
+        n = 64
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        w = pt.init_world(cfg, proto,
+                          out_cap=sharded_out_cap(cfg, proto, 8))
+        w = ps.cluster(w, proto, [(i, i - 1) for i in range(1, n)])
+        w = place_sharded_world(w, cfg, mesh)
+        # bucket_cap=1: the join storm (8 joins/shard in round 0, most
+        # crossing shards) cannot fit 1 message per (src, dst) shard pair
+        step = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 bucket_cap=1)
+        dropped = 0
+        for _ in range(3):
+            w, m = step(w)
+            dropped += int(m["xshard_dropped"])
+        assert dropped > 0, "expected counted bucket overflow"
+
+    def test_shard_align_msgs_places_and_overflows_loudly(self):
+        from partisan_tpu.ops import msg as msgops
+        from partisan_tpu.parallel.dataplane import shard_align_msgs
+        import jax.numpy as jnp
+        spec = {}
+        m = msgops.empty(16, spec)
+        # 3 messages from srcs in shards 3, 0, 3 (n=64 over 8 shards)
+        m = m.replace(
+            valid=m.valid.at[jnp.asarray([0, 1, 2])].set(True),
+            src=m.src.at[jnp.asarray([0, 1, 2])].set(
+                jnp.asarray([25, 3, 30])))
+        out = shard_align_msgs(m, 64, 8)
+        loc = 2  # 16 slots / 8 shards
+        assert bool(out.valid[0 * loc]) and int(out.src[0]) == 3
+        assert bool(out.valid[3 * loc]) and bool(out.valid[3 * loc + 1])
+        assert {int(out.src[3 * loc]), int(out.src[3 * loc + 1])} \
+            == {25, 30}
+        # 3 messages into a 2-slot shard slice must refuse loudly
+        m3 = m.replace(valid=m.valid.at[3].set(True),
+                       src=m.src.at[3].set(27))
+        with pytest.raises(ValueError, match="overflowed"):
+            shard_align_msgs(m3, 64, 8)
+
+
+@needs_mesh
 class TestShardedRumor:
     def test_packed_rumor_parity_over_mesh(self):
         """The dense rumor fast path sharded over 8 devices for 50

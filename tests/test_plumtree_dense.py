@@ -92,3 +92,64 @@ class TestChunkedLaunches:
         hv2, p2 = run_pt_dense_staggered_chunked(hv, p0, 12, cfg, 0.01)
         assert (np.asarray(hv1.active) == np.asarray(hv2.active)).all()
         assert (np.asarray(p1.seq) == np.asarray(p2.seq)).all()
+
+
+class TestLazyCadence:
+    """The ISSUE-2 eager/lazy/graft cadence: eager push every round,
+    digest + graft on the heavy membership grid (the reference's
+    lazy_tick_period / exchange timers over the 10 s / 5 s membership
+    timers)."""
+
+    def test_k1_lazy_equals_full(self):
+        """At k=1 there are no light rounds, so the lazy cadence IS the
+        full-broadcast-every-round program — bit-identical."""
+        import jax
+        from partisan_tpu.models.plumtree_dense import (
+            run_pt_dense_staggered)
+        cfg = pt.Config(n_nodes=128, seed=3)
+        hv = run_dense(dense_init(cfg), 60, cfg)
+        p0 = pt_dense_init(cfg)
+        a = run_pt_dense_staggered(hv, p0, 6, cfg, 0.01, 0, 1, True)
+        b = run_pt_dense_staggered(hv, p0, 6, cfg, 0.01, 0, 1, False)
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+    def test_lazy_tracks_root_under_churn(self):
+        """k=5 with churn: heartbeats keep flowing through the
+        eager-only light rounds; grafts on the heavy grid keep the
+        overwhelming majority of nodes tracking the root."""
+        from partisan_tpu.models.plumtree_dense import (
+            run_pt_dense_staggered)
+        cfg = pt.Config(n_nodes=256, seed=6)
+        hv = run_dense(dense_init(cfg), 120, cfg)
+        hv2, p2 = run_pt_dense_staggered(hv, pt_dense_init(cfg), 10,
+                                         cfg, 0.01, 0, 5, True)
+        seq = np.asarray(p2.seq)
+        assert seq[0] >= 15                  # heartbeats kept firing
+        lag = seq[0] - seq
+        assert (lag <= 10).mean() >= 0.9, (seq[0],
+                                           np.percentile(lag, 95))
+
+    def test_eager_only_step_is_pure_payload(self):
+        """The light step moves payload along existing parent edges and
+        touches nothing else — parent/stale unchanged, no delivery
+        without a parent."""
+        import jax.numpy as jnp
+        from partisan_tpu.models.plumtree_dense import (
+            make_pt_dense_round)
+        cfg = pt.Config(n_nodes=64)
+        hv = run_dense(dense_init(cfg), 60, cfg)
+        light = make_pt_dense_round(cfg, root=0, eager_only=True)
+        p = pt_dense_init(cfg)
+        # a synthetic 2-deep chain: 0 -> 1 -> 2
+        p = p.replace(seq=p.seq.at[0].set(7),
+                      parent=p.parent.at[1].set(0).at[2].set(1))
+        p1 = light(hv, p, jnp.int32(1))
+        assert int(p1.seq[1]) == 7           # delivered from parent
+        assert int(p1.seq[2]) == 0           # 2 hops need 2 rounds
+        p2 = light(hv, p1, jnp.int32(2))
+        assert int(p2.seq[2]) == 7
+        assert (np.asarray(p2.parent) == np.asarray(p.parent)).all()
+        assert (np.asarray(p2.stale) == np.asarray(p.stale)).all()

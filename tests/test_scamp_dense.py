@@ -289,3 +289,91 @@ class TestChunkedLaunches:
         assert (np.asarray(one.partial) == np.asarray(chunked.partial)).all()
         assert (np.asarray(one.walk_pos) == np.asarray(chunked.walk_pos)).all()
         assert (np.asarray(one.in_view) == np.asarray(chunked.in_view)).all()
+
+
+class TestStaggeredCadence:
+    """The ISSUE-2 dense-phase cadence on SCAMP: delivery every round,
+    resub + stale sweep every k-th (scamp_v2 periodic/1 at 10 s vs 1 s
+    delivery)."""
+
+    def test_k1_reduces_to_every_round_program(self):
+        """The exactness anchor: at k=1 the staggered runner IS the
+        every-round program — bit-identical trajectories, so the
+        cadence machinery adds no semantics of its own."""
+        import jax
+        import numpy as np
+        from partisan_tpu.models.scamp_dense import (
+            dense_scamp_init, run_dense_scamp, run_dense_scamp_staggered)
+        cfg = pt.Config(n_nodes=64, seed=4)
+        a = run_dense_scamp(dense_scamp_init(cfg), 30, cfg, 0.02)
+        b = run_dense_scamp_staggered(dense_scamp_init(cfg), 30, cfg,
+                                      0.02, 1)
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+    def test_staggered_chunked_matches_single(self):
+        """Chunked launches of whole k-round blocks carry state
+        identical to one launch (the bounded-launch shape for big N)."""
+        import jax
+        import numpy as np
+        from partisan_tpu.models.scamp_dense import (
+            dense_scamp_init, run_dense_scamp_staggered,
+            run_dense_scamp_staggered_chunked)
+        cfg = pt.Config(n_nodes=64, seed=7)
+        s0 = dense_scamp_init(cfg)
+        a = run_dense_scamp_staggered(s0, 24, cfg, 0.01, 5)
+        b = run_dense_scamp_staggered_chunked(s0, 24, cfg, 0.01, 5)
+        for la, lb in zip(jax.tree_util.tree_leaves(a),
+                          jax.tree_util.tree_leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+    @pytest.mark.slow
+    def test_staggered_health_matches_flat_regime(self):
+        """Distributional parity at k=5 (N=256): the staggered overlay
+        reaches near-full weak connectivity and its view sizes stay in
+        the flat program's equilibrium band.  The cadence trades like
+        the C=8 walker-slot cut did (walker_caps docstring): bootstrap
+        knits ~2x slower (resub fires every k-th round, so the run gets
+        a 2x round budget) and views settle thinner (measured ~2.9 vs
+        4.1 flat at N=256) while weak connectivity converges to the
+        same near-full regime — maintenance is batched onto the heavy
+        grid, not dropped."""
+        import numpy as np
+        from partisan_tpu.models.scamp_dense import (
+            dense_scamp_init, run_dense_scamp,
+            run_dense_scamp_staggered, scamp_health)
+        cfg = pt.Config(n_nodes=256)
+        flat = run_dense_scamp(dense_scamp_init(cfg), 300, cfg, 0.01)
+        flat = run_dense_scamp(flat, 60, cfg)
+        stag = run_dense_scamp_staggered(
+            dense_scamp_init(cfg.replace(seed=2)), 120,
+            cfg.replace(seed=2), 0.01, 5)
+        stag = run_dense_scamp(stag, 60, cfg.replace(seed=2))
+        hf = {k: float(np.asarray(v))
+              for k, v in scamp_health(flat).items()}
+        hs = {k: float(np.asarray(v))
+              for k, v in scamp_health(stag).items()}
+        assert hs["reached"] >= 0.95 * hs["live"], (hf, hs)
+        assert 0.5 * hf["mean_view"] <= hs["mean_view"] \
+            <= 2.0 * max(hf["mean_view"], 0.1), (hf, hs)
+
+    def test_resub_latency_bounded_by_k(self):
+        """A node churned in a light round re-subscribes at the next
+        heavy: after one full block every cleared live row holds a view
+        again (isolation-detection latency <= k rounds, the reference's
+        own periodic cadence)."""
+        import numpy as np
+        from partisan_tpu.models.scamp_dense import (
+            dense_scamp_init, run_dense_scamp_staggered)
+        cfg = pt.Config(n_nodes=64, seed=11)
+        st = run_dense_scamp_staggered(dense_scamp_init(cfg), 20, cfg,
+                                       0.05, 5)
+        # one churn-free block: every lonely row passes a heavy resub
+        st = run_dense_scamp_staggered(st, 1, cfg, 0.0, 5)
+        lonely = (np.asarray(st.alive)
+                  & (np.asarray(st.partial >= 0).sum(1) == 0)
+                  & (np.asarray(st.walk_pos >= 0).sum(1) == 0))
+        assert not lonely.any(), f"{lonely.sum()} rows still isolated"

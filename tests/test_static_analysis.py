@@ -91,6 +91,40 @@ class TestWalk:
         assert m["a"] == ["b"]
         assert m["__background__"] == ["hb"]
 
+    def test_super_call_walks_parent_body(self):
+        """ADVICE r5 high: super().method() must resolve past the
+        defining class and walk the parent body — skipping it silently
+        under-approximated the edge set (the soundness violation the
+        module's loud-ValueError contract forbids)."""
+        class Sub(_Indirect):
+            def handle_ping(self, cfg, me, row, m, key):
+                return super().handle_ping(cfg, me, row, m, key)
+        c = static_causality(Sub())
+        assert c["ping"] == ["pong"], c
+
+    def test_super_in_tick_covers_parent_literals(self):
+        """The in-tree case: XBotHyParView.tick calls super().tick
+        (HyParView.tick), whose shuffle/neighbor literals must land in
+        __tick__."""
+        from partisan_tpu.models.xbot import XBotHyParView
+        c = static_causality(XBotHyParView(pt.Config(n_nodes=8)))
+        assert "shuffle" in c["__tick__"], c["__tick__"]
+
+    def test_two_arg_super_refused(self):
+        class TwoArg(_Indirect):
+            def handle_ping(self, cfg, me, row, m, key):
+                return super(TwoArg, self).handle_ping(
+                    cfg, me, row, m, key)
+        with pytest.raises(ValueError, match="two-arg super"):
+            static_causality(TwoArg())
+
+    def test_dangling_super_refused(self):
+        class Dangling(_Indirect):
+            def handle_ping(self, cfg, me, row, m, key):
+                return super()._nowhere(m)
+        with pytest.raises(ValueError, match="resolves to nothing"):
+            static_causality(Dangling())
+
 
 def _free_function(proto, m):
     return None
@@ -106,10 +140,14 @@ def _protocols(cfg):
     from partisan_tpu.models.plumtree import Plumtree
     from partisan_tpu.models.scamp import ScampV2
     from partisan_tpu.models.stack import Stacked
+    from partisan_tpu.models.xbot import XBotHyParView
     return [TwoPhaseCommit(cfg), BernsteinCTP(cfg), Skeen3PC(cfg),
             AlsbergDay(cfg), DirectMail(cfg), DirectMailAcked(cfg),
             AntiEntropy(cfg), FullMembership(cfg), HyParView(cfg),
-            Stacked(HyParView(cfg), Plumtree(cfg)), ScampV2(cfg)]
+            Stacked(HyParView(cfg), Plumtree(cfg)), ScampV2(cfg),
+            # the super()-reaching subclass protocol (ADVICE r5): its
+            # tick emissions live in HyParView.tick behind super()
+            XBotHyParView(cfg)]
 
 
 @pytest.mark.standard
