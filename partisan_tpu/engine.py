@@ -588,7 +588,8 @@ def make_step(
     randomize_delivery: bool = True,
     donate: bool = True,
     capture_wire: bool = False,
-) -> Callable[[World], Tuple[World, Dict[str, jax.Array]]]:
+    flight: Optional[Any] = None,
+) -> Callable[..., Tuple]:
     """Compile one simulation round for `proto`.
 
     interpose_send/recv are the TPU analog of the reference's interposition
@@ -600,6 +601,12 @@ def make_step(
     the metrics dict (keys ``wire_valid/src/dst/typ/channel/hash``) — the
     per-round trace dump consumed by verify/trace.py (the
     pre_interposition-fun recording of partisan_trace_orchestrator.erl).
+    That path transfers the whole buffer to the host EVERY round; passing
+    a :class:`telemetry.flight.FlightSpec` as ``flight`` instead records
+    the same capture into a device-side ring carried through the scan
+    (ONE transfer per window): the returned step then takes and returns
+    a :class:`telemetry.flight.FlightRing` —
+    ``step(world, fring) -> (world, fring, metrics)``.
     """
     cfg = autotune(cfg, proto)
     N = cfg.n_nodes
@@ -625,8 +632,12 @@ def make_step(
     if cfg.monotonic_channels:
         mono_mask = jnp.asarray(
             [c in cfg.monotonic_channels for c in cfg.channels], dtype=bool)
+    if flight is not None:
+        # lazy: telemetry.runner imports engine, so engine must not
+        # import telemetry at module load
+        from .telemetry.flight import flight_record
 
-    def step(world: World) -> Tuple[World, Dict[str, jax.Array]]:
+    def step(world: World, fring=None):
         state, msgs, rnd = world.state, world.msgs, world.rnd
         rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys, rnd)
         node_ids = jnp.arange(N, dtype=jnp.int32)
@@ -748,8 +759,16 @@ def make_step(
                 wire_typ=now.typ, wire_channel=now.channel,
                 wire_hash=msgops.wire_hash(now))
         new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
+        if flight is not None:
+            # same capture point as capture_wire (the routed buffer,
+            # post fault plane / interposition / lane dispatch), but
+            # into the in-scan ring — no per-round host transfer
+            fring = flight_record(fring, flight, now, rnd)
+            return new_world, fring, metrics
         return new_world, metrics
 
+    if flight is not None:
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 

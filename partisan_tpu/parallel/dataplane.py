@@ -37,9 +37,16 @@ rows, so worlds built by them must pass :func:`shard_align_msgs` before
 Deliberate non-goals (use the implicit path / unsharded step instead):
 ``interpose_recv`` ('$delay' re-holds would strand a message on its
 dst's shard, breaking the invariant for later src-side masks) and
-``capture_wire`` (the trace plane is a verification feature; traces are
-recorded unsharded).  ``interpose_send`` is supported — it runs on the
-shard-local collect output, which is exactly the global buffer slice.
+``capture_wire`` (the per-round host dump would sync the mesh every
+round).  The trace plane is instead the ``flight`` parameter (ISSUE 3):
+a :class:`telemetry.flight.FlightSpec` makes each shard record its
+post-exchange wire slice into a per-shard device ring carried through
+the step — shard-local arithmetic only, ZERO extra collectives, so the
+asserted 2-collective budget holds with the recorder on; the host
+flushes one transfer per window and the per-round entry MULTISET equals
+the unsharded trace (tests/test_flight.py).  ``interpose_send`` is
+supported — it runs on the shard-local collect output, which is exactly
+the global buffer slice.
 
 With ``parallelism > 1`` the random (un-keyed) lane draw hashes LOCAL
 buffer positions where the unsharded step hashes global ones: lane
@@ -227,7 +234,8 @@ def make_sharded_step(
     randomize_delivery: bool = True,
     donate: bool = True,
     bucket_cap: Optional[int] = None,
-) -> Callable[[World], Tuple[World, Dict[str, jax.Array]]]:
+    flight=None,
+) -> Callable[..., Tuple]:
     """Compile one explicitly-sharded simulation round.
 
     Per-round cross-shard traffic: ONE all_to_all of
@@ -239,7 +247,17 @@ def make_sharded_step(
     other shard per round; the default (the full per-shard buffer
     slice) is lossless.  Tighter caps trade exchange bytes for counted
     ``xshard_dropped`` overflow — same contract as every other fixed
-    shape in the simulator (SURVEY §7.3)."""
+    shape in the simulator (SURVEY §7.3).
+
+    ``flight`` (a :class:`telemetry.flight.FlightSpec`) turns on the
+    in-scan message flight recorder: each shard records its
+    post-exchange wire slice (``spec.cap`` slots/round/shard) into a
+    per-shard ring — the step then takes and returns a
+    :class:`telemetry.flight.FlightRing` built by
+    ``make_flight_ring(spec, n_shards=D)`` + ``place_flight_ring``:
+    ``step(world, fring) -> (world, fring, metrics)``.  Recording adds
+    no collectives (the budget above is unchanged); flush on the host,
+    outside the round."""
     cfg = autotune(cfg, proto)
     N = cfg.n_nodes
     K = cfg.inbox_cap
@@ -267,6 +285,10 @@ def make_sharded_step(
             return fn(m, rnd, world)   # sees the SHARD-LOCAL world slice
         return fn(m, rnd)
 
+    if flight is not None:
+        from ..telemetry.flight import (flight_partition_specs,
+                                        flight_record)
+
     def exchange(now: Msgs, src_part: jax.Array):
         """Bucket the local ready messages by destination shard and
         swap buckets with ONE packed all_to_all.  Returns the received
@@ -292,7 +314,7 @@ def make_sharded_step(
         got, (gpart,) = _unpack(recv, proto.data_spec, n_extra=1)
         return got, gpart, xdrop
 
-    def step_body(world: World):
+    def step_body(world: World, fring=None):
         state, msgs, rnd = world.state, world.msgs, world.rnd
         me = jax.lax.axis_index(NODE_AXIS)
         node_base = (me * n_loc).astype(jnp.int32)
@@ -337,6 +359,13 @@ def make_sharded_step(
                           & (world.partition[dst_row] == gpart))
         survived = jnp.sum(now.valid).astype(jnp.int32)
         fault_dropped = ready - survived - xdrop
+
+        # -- flight recorder (ISSUE 3): this shard's post-exchange wire
+        #    slice into its local ring row — the same capture point as
+        #    the unsharded step's (post fault plane / lanes / exchange,
+        #    pre-route); shard-local arithmetic, zero collectives
+        if flight is not None:
+            fring = flight_record(fring, flight, now, rnd)
 
         # -- route on the shard-local slice: local inbox cells, GLOBAL
         #    connection hashes (bit-identical cell + order assignment)
@@ -395,6 +424,8 @@ def make_sharded_step(
         metrics = {"round": rnd}
         metrics.update({k: totals[i] for i, k in enumerate(_SUM_KEYS)})
         new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
+        if flight is not None:
+            return new_world, fring, metrics
         return new_world, metrics
 
     def spec_of(x):
@@ -402,6 +433,21 @@ def make_sharded_step(
 
     metric_specs = {"round": P()}
     metric_specs.update({k: P() for k in _SUM_KEYS})
+
+    if flight is not None:
+        fr_specs = flight_partition_specs(NODE_AXIS)
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(0, 1) if donate else ())
+        def sharded_flight_step(world: World, fring):
+            in_specs = jax.tree_util.tree_map(spec_of, world)
+            return shard_map(step_body, mesh=mesh,
+                             in_specs=(in_specs, fr_specs),
+                             out_specs=(in_specs, fr_specs,
+                                        metric_specs),
+                             check_rep=False)(world, fring)
+
+        return sharded_flight_step
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def sharded_step(world: World):

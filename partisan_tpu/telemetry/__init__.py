@@ -19,36 +19,67 @@ full speed and streamed to the host at a chosen cadence:
 Host events (fault injections, orchestration polls) flow through the
 module-level :func:`emit_event`, which fans out to sinks registered with
 :func:`add_global_sink` — a no-op when none are (the hot-path guard, like
-``logging.trace``).  See README.md "Observability" for the full model.
+``logging.trace``).  Every event row is stamped with a monotonic ``seq``
+number and, when a windowed run has published one via
+:func:`note_round`, the current simulation ``round`` — the correlation
+keys the Perfetto export (:mod:`.perfetto`) uses to place host events
+on the same timeline as flight-recorder wire entries.  See README.md
+"Observability" for the full model.
 """
 
 from __future__ import annotations
 
+import itertools
 import time
-from typing import List
+from typing import List, Optional
 
 from .registry import (COUNTER, GAUGE, DEFAULT_SPECS, HOST_SPECS,
                        MetricRegistry, MetricSpec, default_registry)
 from .ring import TelemetryRing, flush, make_ring, record
+from .flight import (FlightRing, FlightSpec, flight_entries, flight_flush,
+                     flight_mask, flight_record, make_flight_ring,
+                     place_flight_ring)
 from .runner import (ENGINE_KEYMAP, collect_round_metrics,
                      make_window_runner, run_with_telemetry)
 from .sinks import JsonlSink, PrometheusSink, TelemetrySink, parse_exposition
 from .timeline import RoundTimeline, profile_trace
+from .perfetto import chrome_trace, write_chrome_trace
 
 __all__ = [
     "COUNTER", "GAUGE", "DEFAULT_SPECS", "HOST_SPECS",
     "MetricRegistry", "MetricSpec", "default_registry",
     "TelemetryRing", "flush", "make_ring", "record",
+    "FlightRing", "FlightSpec", "flight_entries", "flight_flush",
+    "flight_mask", "flight_record", "make_flight_ring",
+    "place_flight_ring",
     "ENGINE_KEYMAP", "collect_round_metrics", "make_window_runner",
     "run_with_telemetry",
     "JsonlSink", "PrometheusSink", "TelemetrySink", "parse_exposition",
     "RoundTimeline", "profile_trace",
+    "chrome_trace", "write_chrome_trace",
     "add_global_sink", "remove_global_sink", "global_sinks", "emit_event",
+    "note_round", "current_round",
 ]
 
 # ------------------------------------------------------- host event bus
 
 _GLOBAL_SINKS: List[TelemetrySink] = []
+_EVENT_SEQ = itertools.count()
+_CURRENT_ROUND: Optional[int] = None
+
+
+def note_round(rnd: int) -> None:
+    """Publish the simulation round the device has reached (called by
+    the windowed runners at each flush) so host events emitted between
+    flushes carry a ``round`` stamp correlating them with the
+    flight-recorder timeline."""
+    global _CURRENT_ROUND
+    _CURRENT_ROUND = int(rnd)
+
+
+def current_round() -> Optional[int]:
+    """The last :func:`note_round` value (None before any run)."""
+    return _CURRENT_ROUND
 
 
 def add_global_sink(sink: TelemetrySink) -> TelemetrySink:
@@ -74,9 +105,18 @@ def emit_event(event: str, /, **fields) -> None:
     Free when no sink is registered (the ``logging.trace`` guard
     pattern) — instrumented call sites never pay for disabled
     observability.  The event name is positional-only so any field
-    name (even ``event``-adjacent ones like ``name``) stays usable."""
+    name (even ``event``-adjacent ones like ``name``) stays usable.
+
+    Every row carries a monotonic ``seq`` stamp (total order over host
+    events regardless of sink interleaving) and, when a windowed run
+    has published one (:func:`note_round`), the current ``round`` —
+    the keys :mod:`.perfetto` correlates host events with
+    flight-recorder wire entries on."""
     if not _GLOBAL_SINKS:
         return
-    row = {"event": str(event), "t_wall": time.time(), **fields}
+    row = {"event": str(event), "seq": next(_EVENT_SEQ),
+           "t_wall": time.time(), **fields}
+    if _CURRENT_ROUND is not None and "round" not in fields:
+        row["round"] = _CURRENT_ROUND
     for s in list(_GLOBAL_SINKS):
         s.write_row(row)

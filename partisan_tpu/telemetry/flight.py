@@ -1,0 +1,246 @@
+"""The device-side message flight recorder (ISSUE 3 tentpole) — the
+in-scan rebuild of ``partisan_trace_orchestrator.erl`` /
+``partisan_trace_file.erl``'s wire capture.
+
+The reference's trace orchestrator installs pre-interposition funs on
+every node and records ``{Node, Type, Origin, Msg}`` tuples as the run
+executes.  Our legacy analog (:class:`partisan_tpu.verify.trace.
+TraceRecorder`) drives ``engine.make_step(capture_wire=True)`` from a
+Python loop — one device->host transfer of the whole wire buffer per
+ROUND, unsharded only.  This module moves the capture into the scan:
+
+  * :class:`FlightRing` — a fixed-shape ``[window, cap, 6]`` int32
+    buffer carried in the scan state; each round the engine writes one
+    ``[cap, 6]`` row of ``(round, src, dst, typ, channel, hash)`` slots
+    (``dynamic_update_slice`` at the cursor, like the metrics ring) and
+    the host flushes the whole window in ONE transfer.
+  * :class:`FlightSpec` — host-side recorder config baked into the
+    jitted program as compile-time constants: the capture cap, the
+    message-type mask (the ``membership_strategy_tracing`` filter of
+    trace_orchestrator :508-560) and a node-sampling filter
+    (``node_mod``/``node_phase``: keep a message iff src or dst lands
+    in the sampled residue class — the tracing-at-scale dial).
+  * head-cap + ``overflow``: a round emitting more matching messages
+    than ``cap`` keeps the first ``cap`` (buffer order, the same order
+    the legacy recorder's ``np.flatnonzero`` walk produced) and COUNTS
+    the excess — never silent (SURVEY §7.3).
+
+Capture order inside a round row is flat-buffer order, which makes the
+unsharded recorder's entry stream IDENTICAL (not just multiset-equal)
+to the legacy per-round path.  Under the sharded dataplane each shard
+records its own ``[window, cap, 6]`` slice (the ring's cap axis is
+sharded over the mesh), so rows come out dst-shard-major and parity
+with the unsharded trace is per-round MULTISET equality
+(tests/test_flight.py).  Recording is shard-local arithmetic only —
+it adds ZERO collectives to the dataplane round, so the asserted
+2-collective budget holds with the recorder on.
+
+Decoded rows become :class:`partisan_tpu.verify.trace.TraceEntry`
+streams, so everything downstream of the legacy recorder — the model
+checker, ``faults.drop_schedule`` replay, the golden crosswalk and
+``write_trace``/``read_trace`` persistence — consumes recorder output
+unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops import msg as msgops
+from ..ops.msg import Msgs
+
+# columns of one flight slot, in order
+COLUMNS = ("rnd", "src", "dst", "typ", "channel", "hash")
+N_COLS = len(COLUMNS)
+
+
+@struct.dataclass
+class FlightRing:
+    """Device state of the recorder, carried through the scan.
+
+    ``buf[w, s]`` holds slot ``s`` of window-row ``w``; empty slots have
+    ``rnd == -1`` (real rounds are always >= 0).  ``overflow`` is a
+    ``[n_shards]`` vector so the sharded dataplane counts per shard
+    without a collective; the unsharded ring uses ``[1]``.
+    """
+    buf: jax.Array       # [window, cap, 6] int32
+    cursor: jax.Array    # scalar int32 — rows recorded since last flush
+    overflow: jax.Array  # [n_shards] int32 — head-capped slots, cumulative
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightSpec:
+    """Host-side recorder config — every field is a compile-time
+    constant of the jitted step (the registry enable-mask pattern:
+    reconfiguring the filter recompiles, running it costs a fused
+    elementwise mask).
+
+    ``cap`` is the per-round slot budget — PER SHARD under the
+    dataplane (each shard records the messages delivered to its own
+    rows).  ``typs=None`` records every type; otherwise only the listed
+    wire tags (trace_orchestrator's protocol filter).  ``node_mod > 1``
+    samples the node population: a message is kept iff
+    ``src % node_mod == node_phase or dst % node_mod == node_phase``.
+    """
+    window: int
+    cap: int
+    typs: Optional[Tuple[int, ...]] = None
+    node_mod: int = 1
+    node_phase: int = 0
+
+    def __post_init__(self):
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.cap < 1:
+            raise ValueError(f"cap must be >= 1, got {self.cap}")
+        if self.node_mod < 1:
+            raise ValueError(f"node_mod must be >= 1, got {self.node_mod}")
+        if not (0 <= self.node_phase < self.node_mod):
+            raise ValueError(
+                f"node_phase {self.node_phase} outside [0, {self.node_mod})")
+
+
+def make_flight_ring(spec: FlightSpec, n_shards: int = 1) -> FlightRing:
+    """An empty ring.  ``n_shards > 1`` builds the dataplane's ring:
+    the cap axis concatenates every shard's ``spec.cap`` slots (shard
+    k's slice is ``[:, k*cap:(k+1)*cap]``) and ``overflow`` holds one
+    counter per shard — place with :func:`place_flight_ring` before a
+    sharded run."""
+    return FlightRing(
+        buf=jnp.full((spec.window, n_shards * spec.cap, N_COLS), -1,
+                     jnp.int32),
+        cursor=jnp.int32(0),
+        overflow=jnp.zeros((n_shards,), jnp.int32),
+    )
+
+
+def flight_partition_specs(NODE_AXIS: str) -> FlightRing:
+    """shard_map in/out specs for the ring: the cap axis shards over
+    the mesh (each device records its own slots), the cursor replicates
+    (every shard advances it identically), overflow is one counter per
+    shard."""
+    from jax.sharding import PartitionSpec as P
+    return FlightRing(buf=P(None, NODE_AXIS), cursor=P(),
+                      overflow=P(NODE_AXIS))
+
+
+def place_flight_ring(ring: FlightRing, mesh) -> FlightRing:
+    """device_put the ring with its dataplane shardings."""
+    from jax.sharding import NamedSharding
+    from ..parallel.mesh import NODE_AXIS
+    specs = flight_partition_specs(NODE_AXIS)
+    return FlightRing(
+        buf=jax.device_put(ring.buf, NamedSharding(mesh, specs.buf)),
+        cursor=jax.device_put(ring.cursor,
+                              NamedSharding(mesh, specs.cursor)),
+        overflow=jax.device_put(ring.overflow,
+                                NamedSharding(mesh, specs.overflow)),
+    )
+
+
+def flight_mask(spec: FlightSpec, m: Msgs) -> jax.Array:
+    """[M] bool — which wire slots the recorder keeps this round.  The
+    typ-mask and node-sampling predicates are baked from host constants
+    (``where``-style masks, no branches), so the filter is jit-safe
+    inside scan and a permissive spec folds to ``m.valid``."""
+    keep = m.valid
+    if spec.typs is not None:
+        tt = jnp.asarray(tuple(spec.typs), jnp.int32)
+        keep = keep & jnp.any(m.typ[:, None] == tt[None, :], axis=1)
+    if spec.node_mod > 1:
+        phase = jnp.int32(spec.node_phase)
+        mod = jnp.int32(spec.node_mod)
+        keep = keep & ((jnp.maximum(m.src, 0) % mod == phase)
+                       | (jnp.maximum(m.dst, 0) % mod == phase))
+    return keep
+
+
+def flight_record(ring: FlightRing, spec: FlightSpec, m: Msgs,
+                  rnd: jax.Array) -> FlightRing:
+    """Write one round's wire buffer into the ring (device, inside the
+    scan / shard_map body).  Compaction is GATHER-shaped, not scatter:
+    each of the ``cap`` row slots binary-searches the keep-mask's
+    running count for its source index (``searchsorted`` — O(cap log
+    M) after one O(M) cumsum), so the kept slots land at the front of
+    the row in flat-buffer order (the legacy recorder's order) and the
+    payload hash is computed on the ``cap`` gathered slots only — the
+    round cost scales with what the recorder KEEPS, not with the
+    buffer it filters (the <=5% recorder-on bench bar).  Slots past
+    ``cap`` increment ``overflow``.
+
+    Under the dataplane this runs on each shard's local ring slice
+    (``buf [window, cap, 6]``, ``overflow [1]``) — pure shard-local
+    arithmetic, zero collectives.
+    """
+    window, cap = ring.buf.shape[0], ring.buf.shape[1]
+    keep = flight_mask(spec, m)
+    csum = jnp.cumsum(keep.astype(jnp.int32))     # [M] inclusive
+    total = csum[-1]
+    n_kept = jnp.minimum(total, cap)
+    slots = jnp.arange(cap, dtype=jnp.int32)
+    ok = slots < n_kept
+    # slot s <- first buffer index whose running keep-count is s+1
+    gi = jnp.where(ok, jnp.searchsorted(csum, slots + 1)
+                   .astype(jnp.int32), 0)
+    sub = jax.tree_util.tree_map(lambda x: x[gi], m)   # [cap, ...] rows
+    h = jax.lax.bitcast_convert_type(
+        msgops.wire_hash(sub), jnp.int32)         # value-preserving
+    cols = jnp.stack([
+        jnp.broadcast_to(jnp.asarray(rnd, jnp.int32), (cap,)),
+        sub.src, sub.dst, sub.typ, sub.channel, h], axis=1)  # [cap, 6]
+    row = jnp.where(ok[:, None], cols, -1)
+    slot = jnp.mod(ring.cursor, window)           # wrap = keep-latest
+    buf = jax.lax.dynamic_update_slice(
+        ring.buf, row[None], (slot, jnp.int32(0), jnp.int32(0)))
+    ovf = ring.overflow + (total - n_kept)
+    return ring.replace(buf=buf, cursor=ring.cursor + 1, overflow=ovf)
+
+
+def flight_flush(ring: FlightRing
+                 ) -> Tuple[np.ndarray, int, FlightRing]:
+    """ONE device->host transfer of the whole window.  Returns
+    ``(rows, overflow, reset_ring)`` where ``rows`` is the host
+    ``[n_recorded, cap, 6]`` array (oldest round first; wrap degrades
+    to keep-latest like the metrics ring) and ``overflow`` is the
+    total head-capped slot count since the last flush (summed over
+    shards).  Host-side only — never call under jit."""
+    buf = np.asarray(jax.device_get(ring.buf))
+    n = int(ring.cursor)
+    window = buf.shape[0]
+    if n > window:  # wrapped: only the latest `window` rows survive
+        start = n % window
+        buf = np.concatenate([buf[start:], buf[:start]])
+        n = window
+    overflow = int(np.asarray(jax.device_get(ring.overflow)).sum())
+    # rows are fully rewritten at record time, so only the counters
+    # need resetting — no device-side buffer clear
+    reset = ring.replace(cursor=jnp.int32(0),
+                         overflow=jnp.zeros_like(ring.overflow))
+    return buf[:n], overflow, reset
+
+
+def flight_entries(rows: np.ndarray) -> List["TraceEntry"]:
+    """Decode flushed rows into the legacy recorder's TraceEntry stream
+    (``rnd == -1`` slots are padding; hash column bitcasts back to the
+    uint32 the legacy path recorded).  Everything downstream —
+    write_trace, drop_schedule keys, the model checker, the golden
+    crosswalk — consumes this unchanged."""
+    # lazy import: verify/__init__ imports faults -> telemetry; a
+    # module-level import here would cycle during package init
+    from ..verify.trace import TraceEntry
+    out: List[TraceEntry] = []
+    rows = np.asarray(rows)
+    if rows.size == 0:
+        return out
+    flat = rows.reshape((-1, N_COLS))
+    valid = flat[:, 0] >= 0
+    for r, s, d, t, c, h in flat[valid]:
+        out.append(TraceEntry(int(r), int(s), int(d), int(t), int(c),
+                              int(np.uint32(h))))
+    return out

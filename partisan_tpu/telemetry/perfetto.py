@@ -1,0 +1,156 @@
+"""Chrome-trace / Perfetto JSON export of a recorded run.
+
+Joins the three observability planes on one timeline so a run opens in
+``ui.perfetto.dev`` / ``chrome://tracing`` as a single picture:
+
+  * **wire tracks** — flight-recorder :class:`verify.trace.TraceEntry`
+    streams as complete ("X") slices, one process (track group) per
+    node SHARD and one thread lane per source node: the visual analog
+    of the reference's per-node trace files
+    (``partisan_trace_file.erl`` writes one dets file per run; here the
+    shard layout mirrors the dataplane's device placement);
+  * **counter tracks** — per-round metric rows from the telemetry ring
+    (``msgs_delivered``, ``inflight``, ...) plus the
+    ``mesh.collective_stats`` bytes/collective gauges of a compiled
+    sharded round, as Chrome counter ("C") events;
+  * **host events** — ``telemetry.emit_event`` rows (fault injections,
+    orchestration polls), placed by their ``round`` stamp (the
+    :func:`telemetry.note_round` correlation) and ordered by their
+    monotonic ``seq``.
+
+The simulator has no wall-clock inside the scan, so the time axis is
+**rounds**: ``ts = round * us_per_round`` (default 1000 us per round
+— one round renders as one millisecond).  The output is the plain
+Chrome trace-event JSON object format (``{"traceEvents": [...]}``),
+schema-checked in tests/test_flight.py.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
+
+__all__ = ["chrome_trace", "write_chrome_trace"]
+
+# reserved pids: shards occupy [0, n_shards); the two host-side tracks
+# follow them
+_METRICS_TRACK = "metrics"
+_HOST_TRACK = "host events"
+
+
+def _meta(pid: int, name: str) -> Dict[str, Any]:
+    return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def chrome_trace(
+    entries: Iterable[Any] = (), *,
+    n_nodes: Optional[int] = None,
+    n_shards: int = 1,
+    typ_names: Optional[Sequence[str]] = None,
+    metric_rows: Iterable[Mapping[str, Any]] = (),
+    host_events: Iterable[Mapping[str, Any]] = (),
+    collective_stats: Optional[Mapping[str, Any]] = None,
+    us_per_round: int = 1000,
+) -> Dict[str, Any]:
+    """Build the Chrome trace-event dict.
+
+    ``entries`` — TraceEntry stream (flight recorder or legacy).
+    ``n_nodes``/``n_shards`` — the dataplane layout: node i renders on
+    process ``i // (n_nodes // n_shards)``; without ``n_nodes`` every
+    node lands on shard 0.  ``typ_names`` (e.g. ``proto.msg_types``)
+    labels slices; unknown tags fall back to ``typ<k>``.
+    ``metric_rows`` — ring rows (dicts with ``round``).  ``host_events``
+    — event-bus rows (dicts with ``event``/``seq``/``round``).
+    ``collective_stats`` — a ``mesh.collective_stats`` result; rendered
+    as per-op ``collective_bytes`` / ``collectives_per_round`` counter
+    tracks (one sample — the compiled round's contract, constant over
+    the run).
+    """
+    upr = int(us_per_round)
+    n_loc = None
+    if n_nodes is not None and n_shards >= 1 and n_nodes % n_shards == 0:
+        n_loc = n_nodes // n_shards
+
+    def shard_of(node: int) -> int:
+        if n_loc:
+            return min(max(node, 0) // n_loc, n_shards - 1)
+        return 0
+
+    def typ_name(t: int) -> str:
+        if typ_names is not None and 0 <= t < len(typ_names):
+            return str(typ_names[t])
+        return f"typ{t}"
+
+    metrics_pid = n_shards
+    host_pid = n_shards + 1
+    events: List[Dict[str, Any]] = [
+        _meta(metrics_pid, _METRICS_TRACK), _meta(host_pid, _HOST_TRACK)]
+    seen_shards = set()
+
+    for e in entries:
+        pid = shard_of(e.src)
+        if pid not in seen_shards:
+            seen_shards.add(pid)
+            events.append(_meta(pid, f"node shard {pid}"))
+        events.append({
+            "name": typ_name(e.typ), "cat": "wire", "ph": "X",
+            "ts": e.rnd * upr, "dur": upr, "pid": pid, "tid": e.src,
+            "args": {"round": e.rnd, "src": e.src, "dst": e.dst,
+                     "typ": e.typ, "channel": e.channel,
+                     "hash": e.hash,
+                     "dst_shard": shard_of(e.dst)},
+        })
+
+    for row in metric_rows:
+        rnd = row.get("round")
+        if rnd is None:
+            continue
+        ts = int(float(rnd)) * upr
+        for k, v in row.items():
+            if k == "round" or not isinstance(v, (int, float)):
+                continue
+            events.append({"name": k, "ph": "C", "ts": ts,
+                           "pid": metrics_pid, "tid": 0,
+                           "args": {k: v}})
+
+    if collective_stats is not None:
+        counts = dict(collective_stats.get("counts", {}))
+        total = dict(collective_stats.get("total_bytes", {}))
+        events.append({"name": "collectives_per_round", "ph": "C",
+                       "ts": 0, "pid": metrics_pid, "tid": 0,
+                       "args": {op: int(n) for op, n in counts.items()
+                                if n}})
+        events.append({"name": "collective_bytes", "ph": "C",
+                       "ts": 0, "pid": metrics_pid, "tid": 0,
+                       "args": {op: int(b) for op, b in total.items()
+                                if b}})
+
+    for i, row in enumerate(host_events):
+        name = row.get("event")
+        if name is None:
+            continue
+        rnd = row.get("round")
+        seq = row.get("seq", i)
+        # round-stamped events land on the round timeline; unstamped
+        # ones order by seq just past the origin
+        ts = int(float(rnd)) * upr if rnd is not None else int(seq)
+        args = {k: v for k, v in row.items()
+                if isinstance(v, (int, float, str, bool))}
+        events.append({"name": str(name), "cat": "host", "ph": "i",
+                       "s": "g", "ts": ts, "pid": host_pid, "tid": 0,
+                       "args": args})
+
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"us_per_round": upr, "n_shards": n_shards,
+                          **({"n_nodes": n_nodes}
+                             if n_nodes is not None else {})}}
+
+
+def write_chrome_trace(path: str, *args, **kw) -> Dict[str, Any]:
+    """:func:`chrome_trace` + ``json.dump`` — the artifact opens
+    directly in ui.perfetto.dev / chrome://tracing."""
+    doc = chrome_trace(*args, **kw)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc

@@ -83,10 +83,35 @@ def make_window_runner(
     cfg: Config, proto: ProtocolBase, registry: MetricRegistry,
     window: int, *,
     step: Optional[Callable] = None,
+    flight: Optional[Any] = None,
     **step_kw: Any,
-) -> Callable[[World, TelemetryRing], Tuple[World, TelemetryRing]]:
-    """Compile ``window`` rounds + ring recording into one jitted scan."""
-    step = step or make_step(cfg, proto, donate=False, **step_kw)
+) -> Callable:
+    """Compile ``window`` rounds + ring recording into one jitted scan.
+
+    ``flight`` (a :class:`.flight.FlightSpec`) additionally carries the
+    message flight-recorder ring through the same scan — the runner
+    then takes and returns a :class:`.flight.FlightRing` alongside the
+    metrics ring: ``run_window(world, ring, fring)``.  With
+    ``flight=None`` the compiled program is byte-identical to the
+    pre-recorder harness (the recorder-off cost is zero by
+    construction, not by measurement)."""
+    step = step or make_step(cfg, proto, donate=False, flight=flight,
+                             **step_kw)
+
+    if flight is not None:
+        @jax.jit
+        def run_window_flight(world: World, ring: TelemetryRing, fring):
+            def body(carry, _):
+                w, r, fr = carry
+                w2, fr2, m = step(w, fr)
+                vals = collect_round_metrics(proto, w2, m, registry)
+                return (w2, record(r, registry, vals), fr2), None
+
+            (w2, r2, fr2), _ = jax.lax.scan(
+                body, (world, ring, fring), None, length=window)
+            return w2, r2, fr2
+
+        return run_window_flight
 
     @jax.jit
     def run_window(world: World, ring: TelemetryRing):
@@ -112,6 +137,8 @@ def run_with_telemetry(
     profile_dir: Optional[str] = None,
     profile_window: int = 0,
     step_kw: Optional[Dict[str, Any]] = None,
+    flight: Optional[Any] = None,
+    on_flight: Optional[Callable] = None,
 ) -> Tuple[World, RoundTimeline]:
     """Run ``n_rounds`` with in-scan telemetry, flushing every ``window``.
 
@@ -121,33 +148,61 @@ def run_with_telemetry(
     ``rounds_per_sec``).  A trailing partial window compiles a second,
     shorter scan.  ``profile_dir`` wraps window ``profile_window`` in a
     ``jax.profiler`` trace.
+
+    ``flight`` (a :class:`.flight.FlightSpec`; its ``window`` must
+    match) co-carries the message flight recorder through the same
+    scans — still one (metrics) + one (flight) transfer per window —
+    and hands each window's decoded ``TraceEntry`` list to
+    ``on_flight(entries)``.
     """
     registry = registry or default_registry()
     world = world if world is not None else init_world(cfg, proto)
     timeline = timeline or RoundTimeline()
     ring = make_ring(registry, window)
+    fring = None
+    if flight is not None:
+        from .flight import (flight_entries, flight_flush,
+                             make_flight_ring)
+        if flight.window != window:
+            raise ValueError(
+                f"flight.window {flight.window} != runner window "
+                f"{window}: the rings flush together")
+        fring = make_flight_ring(flight)
     # one compiled step shared by the full- and partial-window scans
-    step = make_step(cfg, proto, donate=False, **(step_kw or {}))
-    runner = make_window_runner(cfg, proto, registry, window, step=step)
+    step = make_step(cfg, proto, donate=False, flight=flight,
+                     **(step_kw or {}))
+    runner = make_window_runner(cfg, proto, registry, window, step=step,
+                                flight=flight)
     n_full, rem = divmod(n_rounds, window)
     chunks = [(runner, window)] * n_full
     if rem:
         chunks.append((
-            make_window_runner(cfg, proto, registry, rem, step=step), rem))
+            make_window_runner(cfg, proto, registry, rem, step=step,
+                               flight=flight), rem))
 
+    from . import note_round
     for wi, (run_window, length) in enumerate(chunks):
         ctx = (profile_trace(profile_dir)
                if profile_dir is not None and wi == profile_window
                else contextlib.nullcontext())
         t0 = time.perf_counter()
         with ctx:
-            world, ring = run_window(world, ring)
+            if flight is not None:
+                world, ring, fring = run_window(world, ring, fring)
+            else:
+                world, ring = run_window(world, ring)
             rows, ring = flush(ring, registry)  # blocks: the sync point
+            frows = None
+            if flight is not None:  # the flight transfer is TIMED too
+                frows, _overflow, fring = flight_flush(fring)
         dt = time.perf_counter() - t0
+        note_round(int(world.rnd))
         wrow = timeline.observe(length, dt)
         for row in rows:
             for s in sinks:
                 s.write_row(row)
         for s in sinks:
             s.write_row(wrow)
+        if frows is not None and on_flight is not None:
+            on_flight(flight_entries(frows))
     return world, timeline

@@ -51,6 +51,19 @@ class TraceRecorder:
     >>> rec = TraceRecorder(cfg, proto)
     >>> world = rec.run(world, n_rounds=30)
     >>> rec.entries          # ordered list[TraceEntry]
+
+    Two capture paths fill the same ``entries`` stream:
+
+    * :meth:`run` — the legacy per-round path (``capture_wire=True``):
+      one device->host transfer of the whole wire buffer EVERY round.
+      Keep for per-round host callbacks (``on_round``).
+    * :meth:`run_windowed` — the flight-recorder fast path (ISSUE 3):
+      ``window`` rounds compile into one ``lax.scan`` carrying a
+      device-side :class:`telemetry.flight.FlightRing`; ONE transfer
+      per window.  Entry-for-entry identical to :meth:`run`
+      (tests/test_flight.py pins the bit-match), so everything
+      downstream — the model checker, ``faults.drop_schedule`` keys,
+      the golden crosswalk, :func:`write_trace` — is unchanged.
     """
 
     def __init__(self, cfg: Config, proto: ProtocolBase,
@@ -58,12 +71,16 @@ class TraceRecorder:
                  randomize_delivery: bool = True):
         self.cfg = cfg
         self.proto = proto
+        self._step_kw = dict(interpose_send=interpose_send,
+                             interpose_recv=interpose_recv,
+                             randomize_delivery=randomize_delivery)
         self.step = make_step(cfg, proto, donate=False,
-                              interpose_send=interpose_send,
-                              interpose_recv=interpose_recv,
-                              randomize_delivery=randomize_delivery,
-                              capture_wire=True)
+                              capture_wire=True, **self._step_kw)
         self.entries: List[TraceEntry] = []
+        # windowed-path state: compiled scans per (window, cap) and the
+        # cumulative head-capped slot count (0 at the lossless default)
+        self._flight_runners: Dict = {}
+        self.flight_overflow: int = 0
 
     def run(self, world: World, n_rounds: int,
             on_round: Optional[Callable[[World, Dict], None]] = None
@@ -84,6 +101,61 @@ class TraceRecorder:
                         int(ch[i]), int(h[i])))
             if on_round is not None:
                 on_round(world, metrics)
+        return world
+
+    # --------------------------------------------------- windowed fast path
+
+    def _flight_runner(self, window: int, cap: int):
+        """One compiled (scan-of-step, ring) pair per (window, cap)."""
+        import functools
+        import jax
+        from ..telemetry.flight import FlightSpec
+        key = (window, cap)
+        hit = self._flight_runners.get(key)
+        if hit is not None:
+            return hit
+        spec = FlightSpec(window=window, cap=cap)
+        fstep = make_step(self.cfg, self.proto, donate=False,
+                          flight=spec, **self._step_kw)
+
+        @functools.partial(jax.jit, static_argnames=("length",))
+        def run_window(world, ring, length):
+            def body(carry, _):
+                w, r = carry
+                w2, r2, _m = fstep(w, r)
+                return (w2, r2), None
+            (w2, r2), _ = jax.lax.scan(body, (world, ring), None,
+                                       length=length)
+            return w2, r2
+
+        self._flight_runners[key] = (spec, run_window)
+        return spec, run_window
+
+    def run_windowed(self, world: World, n_rounds: int,
+                     window: int = 32,
+                     cap: Optional[int] = None) -> World:
+        """Record ``n_rounds`` through the in-scan flight recorder: one
+        jitted ``window``-round scan + ONE ring transfer per window (a
+        trailing partial window reuses the same compiled scan via the
+        static ``length`` arg).  ``cap`` defaults to the world's buffer
+        capacity — lossless; a tighter cap head-caps each round's
+        capture with the excess counted in ``flight_overflow``, never
+        silent."""
+        from .. import telemetry
+        from ..telemetry.flight import (flight_entries, flight_flush,
+                                        make_flight_ring)
+        cap = cap or world.msgs.cap
+        spec, run_window = self._flight_runner(window, cap)
+        ring = make_flight_ring(spec)
+        done = 0
+        while done < n_rounds:
+            length = min(window, n_rounds - done)
+            world, ring = run_window(world, ring, length)
+            rows, overflow, ring = flight_flush(ring)  # the sync point
+            self.entries.extend(flight_entries(rows))
+            self.flight_overflow += overflow
+            done += length
+            telemetry.note_round(int(world.rnd))
         return world
 
     # ------------------------------------------------------------- filtering

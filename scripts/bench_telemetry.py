@@ -4,11 +4,20 @@ must cost <= 5% rounds/sec versus telemetry disabled, while producing
 non-trivial ``msgs_delivered`` / ``out_dropped`` / ``isolated`` /
 ``rounds_per_sec`` in both the JSONL and Prometheus outputs.
 
-Both arms run the SAME windowed-scan shape with one host sync per
+All arms run the SAME windowed-scan shape with one host sync per
 window; the only difference is the ring + collectors.  Results land in
 ``BENCH_telemetry.jsonl`` (per-round + per-window rows) and
 ``BENCH_telemetry.prom`` (exposition snapshot); stdout prints one JSON
-summary line.
+summary line (existing keys unchanged).
+
+ISSUE 3 adds the flight-recorder column: a third arm co-carries the
+message flight ring (``--flight-cap`` slots/round, head-capped +
+counted) through the same scans and reports ``flight_overhead_pct``
+against the telemetry arm (the <= 5% recorder-ON bar).  The recorder-OFF
+bar (<= 1%) is structural: with ``flight=None`` the runner compiles a
+byte-identical program to the pre-recorder harness, so the telemetry
+arm IS the recorder-off arm — its ``overhead_pct`` vs plain is reported
+unchanged.
 
 Run:  JAX_PLATFORMS=cpu python scripts/bench_telemetry.py [--n 4096]
 """
@@ -34,6 +43,9 @@ def main() -> None:
     ap.add_argument("--window", type=int, default=64)
     ap.add_argument("--windows", type=int, default=3,
                     help="timed windows per arm (after 1 warmup window)")
+    ap.add_argument("--flight-cap", type=int, default=4096,
+                    help="flight-recorder slots per round (head-capped "
+                         "+ counted beyond)")
     args = ap.parse_args()
     n, window = args.n, args.window
 
@@ -92,6 +104,34 @@ def main() -> None:
         wt, ring, dt = telem_run(wt, ring, timed=True)
         telem_secs.append(dt)
 
+    # -- flight arm (ISSUE 3): telemetry + the message flight recorder
+    #    co-carried through the same windowed scan; one extra
+    #    [window, cap, 6] transfer per window (timed), head-cap counted
+    fspec = telemetry.FlightSpec(window=window, cap=args.flight_cap)
+    flight_window = telemetry.make_window_runner(
+        cfg, proto, registry, window, flight=fspec)
+    fring = telemetry.make_flight_ring(fspec)
+    flight_entries_total = 0
+    flight_overflow_total = 0
+
+    def flight_run(world, ring, fring, timed):
+        nonlocal flight_entries_total, flight_overflow_total
+        t0 = time.perf_counter()
+        world, ring, fring = flight_window(world, ring, fring)
+        _rows, ring = telemetry.flush(ring, registry)
+        frows, ovf, fring = telemetry.flight_flush(fring)
+        dt = time.perf_counter() - t0
+        flight_entries_total += int((frows[..., 0] >= 0).sum())
+        flight_overflow_total += ovf
+        return world, ring, fring, (dt if timed else None)
+
+    fring2 = telemetry.make_ring(registry, window)
+    wf, fring2, fring, _ = flight_run(world0, fring2, fring, timed=False)
+    flight_secs = []
+    for _ in range(args.windows):
+        wf, fring2, fring, dt = flight_run(wf, fring2, fring, timed=True)
+        flight_secs.append(dt)
+
     # -- plain arm: identical schedule from the same initial world
     wp = plain_window(world0)
     int(wp.rnd)                                   # sync (warmup/compile)
@@ -107,13 +147,20 @@ def main() -> None:
 
     plain_rps = window / statistics.median(plain_secs)
     telem_rps = window / statistics.median(telem_secs)
+    flight_rps = window / statistics.median(flight_secs)
     overhead = (plain_rps - telem_rps) / plain_rps * 100.0
+    flight_overhead = (telem_rps - flight_rps) / telem_rps * 100.0
     summary = {
         "metric": f"telemetry overhead @ HyParView N={n}, window={window}",
         "n": n, "window": window, "timed_windows": args.windows,
         "plain_rounds_per_sec": round(plain_rps, 2),
         "telemetry_rounds_per_sec": round(telem_rps, 2),
         "overhead_pct": round(overhead, 2),
+        "flight_rounds_per_sec": round(flight_rps, 2),
+        "flight_overhead_pct": round(flight_overhead, 2),
+        "flight_cap": args.flight_cap,
+        "flight_entries": flight_entries_total,
+        "flight_overflow": flight_overflow_total,
         "msgs_delivered_total": sum(r["msgs_delivered"] for r in all_rows),
         "out_dropped_total": sum(r["out_dropped"] for r in all_rows),
         "isolated_max": max(r["isolated"] for r in all_rows),

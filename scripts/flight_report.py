@@ -1,0 +1,112 @@
+"""Flight-trace summarizer: top talkers, per-type counts, inter-shard
+traffic matrix.
+
+Consumes a wire trace in the JSONL format both recorder paths persist
+(``verify.trace.write_trace`` — one ``{"rnd", "src", "dst", "typ",
+"channel", "hash"}`` object per line, the dets-trace-file analog) and
+prints ONE JSON summary line, plus an optional human-readable table on
+stderr with ``--pretty``:
+
+  * ``top_talkers`` / ``top_listeners`` — the N sources/destinations by
+    message count (the hotspot view: a join-storm contact or a
+    plumtree root shows up immediately);
+  * ``per_typ`` — message count by wire tag (pass ``--typ-names`` to
+    label them, e.g. the protocol's ``msg_types`` joined by commas);
+  * ``intershard`` — the [D, D] src-shard x dst-shard traffic matrix
+    for ``--shards D`` (rows = sender shard): the dataplane's
+    all_to_all load picture — off-diagonal mass is cross-chip traffic,
+    the diagonal stays on-device.
+
+Run:  python scripts/flight_report.py TRACE.jsonl [--shards 8]
+          [--nodes N] [--top 10] [--typ-names a,b,c] [--pretty]
+"""
+
+import argparse
+import collections
+import json
+import sys
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from partisan_tpu.verify.trace import read_trace  # noqa: E402
+
+
+def summarize(entries, n_shards=1, n_nodes=None, top=10, typ_names=None):
+    if n_nodes is None:
+        n_nodes = 1 + max((max(e.src, e.dst) for e in entries),
+                          default=0)
+    n_loc = max(1, -(-n_nodes // n_shards))
+
+    def shard_of(node):
+        return min(max(node, 0) // n_loc, n_shards - 1)
+
+    talkers = collections.Counter(e.src for e in entries)
+    listeners = collections.Counter(e.dst for e in entries)
+    per_typ = collections.Counter(e.typ for e in entries)
+    rounds = sorted({e.rnd for e in entries})
+    mat = [[0] * n_shards for _ in range(n_shards)]
+    for e in entries:
+        mat[shard_of(e.src)][shard_of(e.dst)] += 1
+    cross = sum(mat[i][j] for i in range(n_shards)
+                for j in range(n_shards) if i != j)
+
+    def typ_label(t):
+        if typ_names is not None and 0 <= t < len(typ_names):
+            return typ_names[t]
+        return str(t)
+
+    return {
+        "entries": len(entries),
+        "rounds": len(rounds),
+        "round_span": [rounds[0], rounds[-1]] if rounds else [],
+        "msgs_per_round": round(len(entries) / len(rounds), 2)
+        if rounds else 0.0,
+        "top_talkers": talkers.most_common(top),
+        "top_listeners": listeners.most_common(top),
+        "per_typ": {typ_label(t): c
+                    for t, c in sorted(per_typ.items())},
+        "shards": n_shards,
+        "intershard": mat,
+        "cross_shard_frac": round(cross / len(entries), 4)
+        if entries else 0.0,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace", help="wire-trace JSONL (write_trace format)")
+    ap.add_argument("--shards", type=int, default=1)
+    ap.add_argument("--nodes", type=int, default=None,
+                    help="node count (default: inferred from max id)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--typ-names", default=None,
+                    help="comma-separated wire-tag names")
+    ap.add_argument("--pretty", action="store_true",
+                    help="human-readable table on stderr")
+    args = ap.parse_args()
+
+    entries = read_trace(args.trace)
+    typ_names = args.typ_names.split(",") if args.typ_names else None
+    s = summarize(entries, n_shards=args.shards, n_nodes=args.nodes,
+                  top=args.top, typ_names=typ_names)
+    print(json.dumps(s))
+
+    if args.pretty:
+        p = lambda *a: print(*a, file=sys.stderr)
+        p(f"{s['entries']} messages over {s['rounds']} rounds "
+          f"(span {s['round_span']}, {s['msgs_per_round']}/round)")
+        p("top talkers:   "
+          + ", ".join(f"{n}({c})" for n, c in s["top_talkers"]))
+        p("top listeners: "
+          + ", ".join(f"{n}({c})" for n, c in s["top_listeners"]))
+        p("per type:      "
+          + ", ".join(f"{t}={c}" for t, c in s["per_typ"].items()))
+        if args.shards > 1:
+            p(f"inter-shard matrix (cross-shard "
+              f"{100 * s['cross_shard_frac']:.1f}%):")
+            for row in s["intershard"]:
+                p("  " + " ".join(f"{c:7d}" for c in row))
+
+
+if __name__ == "__main__":
+    main()

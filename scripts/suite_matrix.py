@@ -585,6 +585,71 @@ def plumtree_lazy_equivalence_test():
         assert (np.asarray(la) == np.asarray(lb)).all()
 
 
+def flight_recorder_parity_test():
+    """ISSUE 3 tentpole contract: the windowed in-scan flight recorder
+    (one device transfer per window) produces the entry-for-entry
+    identical TraceEntry stream to the legacy per-round
+    ``capture_wire=True`` path, losslessly."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.verify import TraceRecorder
+    n = 64
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    pairs = [(i, i - 1) for i in range(1, n)]
+    w = ps.cluster(pt.init_world(cfg, proto), proto, pairs, stagger=16)
+    legacy = TraceRecorder(cfg, proto)
+    legacy.run(w, 20)
+    w2 = ps.cluster(pt.init_world(cfg, proto), proto, pairs, stagger=16)
+    fast = TraceRecorder(cfg, proto)
+    fast.run_windowed(w2, 20, window=10)
+    assert fast.flight_overflow == 0
+    assert legacy.entries and fast.entries == legacy.entries
+
+
+def dataplane_flight_telemetry_test():
+    """ISSUE 3 dataplane coverage: per-shard flight rings through the
+    shard_map round multiset-match the unsharded trace, and the
+    asserted 2-collective budget holds with the recorder ON."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel import make_mesh
+    from partisan_tpu.parallel.dataplane import (
+        make_sharded_step, place_sharded_world, sharded_out_cap)
+    from partisan_tpu.parallel.mesh import assert_collective_budget
+    from partisan_tpu.telemetry.flight import (
+        FlightSpec, flight_entries, flight_flush, make_flight_ring,
+        place_flight_ring)
+    from partisan_tpu.verify import TraceRecorder
+    n, rounds = 64, 10
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    pairs = [(i, i - 1) for i in range(1, n)]
+    rec = TraceRecorder(cfg, proto)
+    rec.run_windowed(
+        ps.cluster(pt.init_world(cfg, proto), proto, pairs, stagger=16),
+        rounds, window=rounds)
+    mesh = make_mesh(n_devices=8)
+    out_cap = sharded_out_cap(cfg, proto, 8)
+    w = ps.cluster(pt.init_world(cfg, proto, out_cap=out_cap), proto,
+                   pairs, stagger=16)
+    w = place_sharded_world(w, cfg, mesh)
+    spec = FlightSpec(window=rounds, cap=out_cap)
+    step = make_sharded_step(cfg, proto, mesh, donate=False,
+                             flight=spec)
+    ring = place_flight_ring(make_flight_ring(spec, n_shards=8), mesh)
+    comp = step.lower(w, ring).compile()
+    st = assert_collective_budget(comp, max_collectives=2,
+                                  max_bytes=32 * 1024 * 1024,
+                                  forbid=("all-gather",))
+    assert st["counts"]["all-to-all"] == 1
+    for _ in range(rounds):
+        w, ring, _m = step(w, ring)
+    rows, overflow, _ = flight_flush(ring)
+    got = flight_entries(rows)
+    assert overflow == 0
+    key = lambda e: (e.rnd, e.src, e.dst, e.typ, e.channel, e.hash)
+    assert sorted(map(key, got)) == sorted(map(key, rec.entries))
+
+
 def performance_test():
     """performance_test (:1029): the echo harness completes its streams
     (the full swept numbers live in scripts/perf_suite.py ->
@@ -1131,6 +1196,14 @@ def build_matrix():
         "engine", scamp_stagger_equivalence_test)
     add("dense_cadence", "plumtree_lazy_equivalence_test", "hyparview",
         "engine", plumtree_lazy_equivalence_test)
+
+    # ISSUE 3: the in-scan message flight recorder — trace parity on
+    # both execution paths and dataplane telemetry coverage (the
+    # partisan_trace_orchestrator contract at scan speed)
+    add("observability/flight", "flight_recorder_parity_test",
+        "hyparview", "engine", flight_recorder_parity_test)
+    add("observability/flight", "dataplane_flight_telemetry_test",
+        "hyparview", "engine", dataplane_flight_telemetry_test)
 
     return M
 

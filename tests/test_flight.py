@@ -1,0 +1,392 @@
+"""Flight-recorder tests (ISSUE 3): the in-scan device-side wire capture
+must be indistinguishable from the legacy per-round ``capture_wire``
+path — same TraceEntry stream on the unsharded step, same per-round
+multiset through the sharded dataplane — with head-cap overflow counted,
+the dataplane's collective budget intact, and the decoded stream feeding
+``drop_schedule`` replay, the model checker and the Perfetto export
+unchanged."""
+
+import json
+
+import jax
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service as ps, telemetry
+from partisan_tpu.models.demers import DirectMail
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.telemetry.flight import (
+    FlightSpec, flight_entries, flight_flush, make_flight_ring,
+    place_flight_ring)
+from partisan_tpu.telemetry.perfetto import chrome_trace
+from partisan_tpu.verify import TraceRecorder, faults
+from partisan_tpu.verify.trace import write_trace
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def _key(e):
+    return (e.rnd, e.src, e.dst, e.typ, e.channel, e.hash)
+
+
+def _booted_hv(n, out_cap=None):
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    world = pt.init_world(cfg, proto, out_cap=out_cap)
+    world = ps.cluster(world, proto, [(i, i - 1) for i in range(1, n)],
+                       stagger=16)
+    return cfg, proto, world
+
+
+# ------------------------------------------------- unsharded bit-parity
+
+@pytest.mark.standard
+class TestFlightParity:
+    """The ISSUE-3 acceptance drive: 30-round HyParView N=256."""
+
+    N, ROUNDS, WINDOW = 256, 30, 10
+
+    @pytest.fixture(scope="class")
+    def legacy(self):
+        cfg, proto, world = _booted_hv(self.N)
+        rec = TraceRecorder(cfg, proto)
+        rec.run(world, self.ROUNDS)
+        return cfg, proto, rec.entries
+
+    def test_windowed_fast_path_bit_matches_legacy(self, legacy):
+        """run_windowed (one transfer per window) produces the
+        ENTRY-FOR-ENTRY identical stream to the per-round legacy path
+        — order included, not just the multiset: the ring's prefix-sum
+        compaction preserves flat-buffer order, which is exactly the
+        order the legacy recorder's flatnonzero walk read."""
+        cfg, proto, entries = legacy
+        _, _, world = _booted_hv(self.N)
+        rec = TraceRecorder(cfg, proto)
+        rec.run_windowed(world, self.ROUNDS, window=self.WINDOW)
+        assert rec.flight_overflow == 0
+        assert rec.entries == entries
+        assert len(entries) > 0
+
+    @needs_mesh
+    def test_sharded_dataplane_trace_matches_unsharded(self, legacy):
+        """The dataplane's per-shard rings capture the SAME wire
+        traffic: per-round TraceEntry multisets equal the unsharded
+        trace (order is dst-shard-major on the sharded side), nothing
+        head-capped, and the ring actually spans the mesh."""
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (
+            make_sharded_step, place_sharded_world, sharded_out_cap)
+        cfg, proto, entries = legacy
+        mesh = make_mesh(n_devices=8)
+        out_cap = sharded_out_cap(cfg, proto, 8)
+        _, _, world = _booted_hv(self.N, out_cap=out_cap)
+        world = place_sharded_world(world, cfg, mesh)
+        spec = FlightSpec(window=self.ROUNDS, cap=out_cap // 8 * 8)
+        step = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 flight=spec)
+        ring = place_flight_ring(make_flight_ring(spec, n_shards=8),
+                                 mesh)
+        assert len(ring.buf.sharding.device_set) == 8
+        for _ in range(self.ROUNDS):
+            world, ring, _m = step(world, ring)
+        rows, overflow, ring = flight_flush(ring)
+        got = flight_entries(rows)
+        assert overflow == 0
+        assert len(got) == len(entries)
+        by_round = lambda es: {
+            r: sorted(_key(e) for e in es if e.rnd == r)
+            for r in {e.rnd for e in es}}
+        assert by_round(got) == by_round(entries)
+
+
+# --------------------------------------------------- head-cap + filters
+
+class TestFlightCapAndFilters:
+    def _mail_world(self, n=8):
+        cfg = pt.Config(n_nodes=n, inbox_cap=8)
+        proto = DirectMail(cfg)
+        world = pt.init_world(cfg, proto)
+        world = ps.send_ctl(world, proto, 0, "ctl_broadcast", rumor=1)
+        return cfg, proto, world
+
+    def test_overflow_counter_fires_when_cap_exceeded(self):
+        """cap=2 against a round that broadcasts to 7 destinations:
+        the first 2 slots are kept in buffer order, the excess is
+        COUNTED in the ring's overflow — never silent."""
+        cfg, proto, world = self._mail_world()
+        full = TraceRecorder(cfg, proto)
+        full.run_windowed(world, 4, window=4)
+        assert full.flight_overflow == 0
+
+        cfg2, proto2, world2 = self._mail_world()
+        capped = TraceRecorder(cfg2, proto2)
+        capped.run_windowed(world2, 4, window=4, cap=2)
+        assert capped.flight_overflow > 0
+        assert (capped.flight_overflow
+                == len(full.entries) - len(capped.entries))
+        # the kept prefix is the head of the full stream, per round
+        for r in {e.rnd for e in full.entries}:
+            f = [e for e in full.entries if e.rnd == r]
+            c = [e for e in capped.entries if e.rnd == r]
+            assert c == f[:len(c)] and len(c) <= 2
+
+    def test_typ_mask_filters_and_counts_nothing(self):
+        """The membership_strategy_tracing analog: a typ-mask keeps
+        only the listed wire tags; filtered-out traffic is excluded by
+        policy, not overflow."""
+        cfg, proto, world = self._mail_world()
+        rec = TraceRecorder(cfg, proto)
+        rec.run_windowed(world, 4, window=4)
+        mail_t = proto.typ("mail")
+        mails = [e for e in rec.entries if e.typ == mail_t]
+        assert mails and len(mails) < len(rec.entries)
+
+        spec = FlightSpec(window=4, cap=world.msgs.cap,
+                          typs=(mail_t,))
+        _, _, world2 = self._mail_world()
+        step = pt.make_step(cfg, proto, donate=False, flight=spec)
+        ring = make_flight_ring(spec)
+        for _ in range(4):
+            world2, ring, _m = step(world2, ring)
+        rows, overflow, _ = flight_flush(ring)
+        got = flight_entries(rows)
+        assert overflow == 0
+        assert got == mails
+
+    def test_node_sampling_keeps_residue_class(self):
+        """node_mod/node_phase sample the population: every kept entry
+        touches the sampled class, every dropped one doesn't."""
+        cfg, proto, world = self._mail_world()
+        rec = TraceRecorder(cfg, proto)
+        rec.run_windowed(world, 4, window=4)
+
+        spec = FlightSpec(window=4, cap=world.msgs.cap, node_mod=4,
+                          node_phase=1)
+        _, _, world2 = self._mail_world()
+        step = pt.make_step(cfg, proto, donate=False, flight=spec)
+        ring = make_flight_ring(spec)
+        for _ in range(4):
+            world2, ring, _m = step(world2, ring)
+        got = flight_entries(flight_flush(ring)[0])
+        want = [e for e in rec.entries
+                if e.src % 4 == 1 or e.dst % 4 == 1]
+        assert got == want and 0 < len(got) < len(rec.entries)
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            FlightSpec(window=0, cap=4)
+        with pytest.raises(ValueError):
+            FlightSpec(window=4, cap=0)
+        with pytest.raises(ValueError):
+            FlightSpec(window=4, cap=4, node_mod=2, node_phase=2)
+
+
+# ------------------------------------------- downstream consumers
+
+class TestFlightFeedsVerification:
+    def test_recorder_output_drives_drop_schedule_replay(self):
+        """A drop schedule built from windowed-recorder keys replays
+        exactly like one built from legacy keys: the targeted entry
+        disappears from the re-recorded wire, everything else of that
+        round survives (the filibuster execute_schedule contract on
+        recorder output)."""
+        cfg = pt.Config(n_nodes=6, inbox_cap=8)
+        proto = DirectMail(cfg)
+        rec = TraceRecorder(cfg, proto)
+        world = pt.init_world(cfg, proto)
+        world = ps.send_ctl(world, proto, 0, "ctl_broadcast", rumor=1)
+        rec.run_windowed(world, 5, window=5)
+        victim = next(e for e in rec.entries
+                      if e.typ == proto.typ("mail"))
+
+        rec2 = TraceRecorder(cfg, proto,
+                             interpose_recv=faults.drop_schedule(
+                                 [victim.key]))
+        world2 = pt.init_world(cfg, proto)
+        world2 = ps.send_ctl(world2, proto, 0, "ctl_broadcast", rumor=1)
+        rec2.run_windowed(world2, 5, window=5)
+        # NOTE the recv-side hook runs BEFORE the capture point, so the
+        # dropped message vanishes from the replay's own trace
+        assert _key(victim) not in {_key(e) for e in rec2.entries}
+        assert len(rec2.entries) == len(rec.entries) - 1
+
+    def test_recorder_keys_match_model_checker_golden(self):
+        """The checker's golden wire keys are exactly the recorder's
+        (round, src, dst, typ) stream — recorder output feeds the
+        enumeration unchanged."""
+        from partisan_tpu.verify.model_checker import ModelChecker
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = DirectMail(cfg)
+
+        def setup(world):
+            return ps.send_ctl(world, proto, 0, "ctl_broadcast",
+                               rumor=1)
+
+        mc = ModelChecker(cfg, proto, setup, lambda w: True, n_rounds=5)
+        golden = mc.execute(())
+
+        rec = TraceRecorder(cfg, proto)
+        world = setup(pt.init_world(cfg, proto))
+        rec.run_windowed(world, 5, window=5)
+        assert [e.key for e in rec.entries] == golden.wire_keys
+
+
+# ------------------------------------------------------- perfetto + report
+
+class TestPerfettoExport:
+    @pytest.fixture()
+    def recorded(self):
+        cfg = pt.Config(n_nodes=8, inbox_cap=8)
+        proto = DirectMail(cfg)
+        rec = TraceRecorder(cfg, proto)
+        world = pt.init_world(cfg, proto)
+        world = ps.send_ctl(world, proto, 0, "ctl_broadcast", rumor=1)
+        rec.run_windowed(world, 4, window=4)
+        return proto, rec.entries
+
+    def test_export_is_valid_chrome_trace_json(self, recorded, tmp_path):
+        proto, entries = recorded
+        metric_rows = [{"round": 0, "msgs_delivered": 3.0},
+                       {"round": 1, "msgs_delivered": 7.0}]
+        host_events = [{"event": "fault_crash", "seq": 0, "round": 1,
+                        "t_wall": 0.0},
+                       {"event": "poll", "seq": 1}]
+        fake_stats = {"counts": {"all-to-all": 1, "all-reduce": 1},
+                      "total_bytes": {"all-to-all": 4096,
+                                      "all-reduce": 40}}
+        doc = chrome_trace(
+            entries, n_nodes=8, n_shards=4,
+            typ_names=proto.msg_types, metric_rows=metric_rows,
+            host_events=host_events, collective_stats=fake_stats)
+        # schema check: round-trips as JSON, and every event carries
+        # the Chrome trace-event required fields with sane values
+        back = json.loads(json.dumps(doc))
+        assert isinstance(back["traceEvents"], list)
+        assert back["traceEvents"]
+        phs = set()
+        for ev in back["traceEvents"]:
+            assert {"name", "ph", "pid", "tid"} <= set(ev)
+            assert ev["ph"] in {"X", "C", "i", "M"}
+            phs.add(ev["ph"])
+            if ev["ph"] != "M":
+                assert isinstance(ev["ts"], int) and ev["ts"] >= 0
+            if ev["ph"] == "X":
+                assert ev["dur"] > 0
+                assert ev["cat"] == "wire"
+                assert 0 <= ev["pid"] < 4          # one track per shard
+                assert ev["args"]["src"] == ev["tid"]
+        assert phs == {"X", "C", "i", "M"}
+        # wire slices carry the protocol's type names
+        names = {e["name"] for e in back["traceEvents"]
+                 if e["ph"] == "X"}
+        assert names <= set(proto.msg_types)
+        # file write round-trips too
+        from partisan_tpu.telemetry.perfetto import write_chrome_trace
+        p = tmp_path / "trace.json"
+        write_chrome_trace(str(p), entries, n_nodes=8, n_shards=4)
+        assert json.loads(p.read_text())["traceEvents"]
+
+    def test_flight_report_summary(self, recorded, tmp_path):
+        proto, entries = recorded
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts"))
+        from flight_report import summarize
+        s = summarize(entries, n_shards=4, n_nodes=8,
+                      typ_names=list(proto.msg_types))
+        assert s["entries"] == len(entries)
+        assert sum(s["per_typ"].values()) == len(entries)
+        assert sum(sum(r) for r in s["intershard"]) == len(entries)
+        assert set(s["per_typ"]) <= set(proto.msg_types)
+        # node 0 broadcast: it tops the talker list
+        assert s["top_talkers"][0][0] == 0
+        # persisted trace -> report round-trip (the CLI path)
+        p = tmp_path / "t.jsonl"
+        write_trace(str(p), entries)
+        from partisan_tpu.verify.trace import read_trace
+        assert summarize(read_trace(str(p)), n_shards=4,
+                         n_nodes=8)["entries"] == len(entries)
+
+
+# ------------------------------------------- budget + runner integration
+
+@needs_mesh
+@pytest.mark.standard
+class TestFlightDataplaneBudget:
+    def test_collective_budget_holds_with_recorder_on(self):
+        """Recording is shard-local: the compiled sharded round with
+        the flight recorder enabled still carries exactly ONE
+        all_to_all + ONE all-reduce, no all-gather, within the byte
+        ceiling — the flush lives outside the round."""
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (
+            _field_layout, init_sharded_world, make_sharded_step,
+            sharded_out_cap)
+        from partisan_tpu.parallel.mesh import assert_collective_budget
+        cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        w = init_sharded_world(cfg, proto, mesh)
+        m_loc = sharded_out_cap(cfg, proto, 8) // 8
+        spec = FlightSpec(window=8, cap=8 * m_loc)
+        step = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 flight=spec)
+        ring = place_flight_ring(make_flight_ring(spec, n_shards=8),
+                                 mesh)
+        comp = step.lower(w, ring).compile()
+        _, _, F = _field_layout(proto.data_spec)
+        ceiling = 3 * (8 * m_loc * (F + 1) * 4) + 64
+        st = assert_collective_budget(comp, max_collectives=2,
+                                      max_bytes=ceiling,
+                                      forbid=("all-gather",))
+        assert st["counts"]["all-to-all"] == 1
+        assert st["counts"]["all-reduce"] == 1
+
+
+class TestRunnerIntegration:
+    def test_run_with_telemetry_carries_flight(self):
+        """The windowed telemetry harness co-carries the flight ring:
+        per-window entry batches arrive through on_flight, rounds line
+        up with the metrics rows, and note_round stamps subsequent
+        host events with the reached round."""
+        n = 16
+        cfg = pt.Config(n_nodes=n, inbox_cap=8, shuffle_interval=5)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto,
+                           [(i, 0) for i in range(1, n)])
+        batches = []
+        spec = FlightSpec(window=8, cap=world.msgs.cap)
+        world2, tl = telemetry.run_with_telemetry(
+            cfg, proto, n_rounds=16, window=8, world=world,
+            flight=spec, on_flight=batches.append)
+        assert len(batches) == 2
+        ents = [e for b in batches for e in b]
+        assert ents
+        assert {e.rnd for e in batches[0]} <= set(range(8))
+        assert {e.rnd for e in batches[1]} <= set(range(8, 16))
+        # the event bus now knows where the device is
+        assert telemetry.current_round() == 16
+        import io
+        buf = io.StringIO()
+        sink = telemetry.JsonlSink(buf)
+        telemetry.add_global_sink(sink)
+        try:
+            telemetry.emit_event("probe")
+            telemetry.emit_event("probe2")
+        finally:
+            telemetry.remove_global_sink(sink)
+        rows = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert all(r["round"] == 16 for r in rows)
+        assert rows[1]["seq"] == rows[0]["seq"] + 1  # monotonic
+
+    def test_window_mismatch_rejected(self):
+        cfg = pt.Config(n_nodes=8, inbox_cap=8)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="flush together"):
+            telemetry.run_with_telemetry(
+                cfg, proto, n_rounds=8, window=8,
+                flight=FlightSpec(window=4, cap=64))

@@ -151,9 +151,24 @@ class TestDenseScamp:
 
     def test_isolation_resubscribe(self):
         """A node whose view AND walkers are wiped re-subscribes and
-        rejoins the overlay."""
+        rejoins the overlay.
+
+        Root cause of the long-standing failure (pre-existing on the
+        pristine seed): the old premise ran the bootstrap at churn=0,
+        and at seed 4 the random-contact bootstrap graph settles into
+        THREE components that can never merge — isolation re-subscribe
+        only fires for LONELY rows (empty view, no walkers), so
+        multi-node islands persist forever without churn.  That is a
+        bootstrap artifact, not an isolation-path bug: the fix is the
+        churn-bootstrap + settle the other settled tests use (churn
+        resubscriptions are exactly the component-merging force), with
+        the connected premise asserted BEFORE the wipe so the test
+        measures the isolation path and nothing else."""
         cfg = pt.Config(n_nodes=64, seed=4)
-        st = run_dense_scamp(dense_scamp_init(cfg), 200, cfg, 0.0)
+        st = run_dense_scamp(dense_scamp_init(cfg), 200, cfg, 0.02)
+        st = run_dense_scamp(st, 60, cfg, 0.0)  # drain in-flight walks
+        h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
+        assert h["connected"], ("premise: bootstrap must connect", h)
         # wipe node 7 completely (views + walks): only the isolation
         # path can bring it back
         st = st.replace(

@@ -127,6 +127,36 @@ class TestScanTelemetry:
         assert tl.rounds_per_sec > 0
         assert tl.summary()["windows"] == 3
 
+    def test_typed_exposition_roundtrips_kinds(self, run):
+        """Every exported family's # TYPE line distinguishes counters
+        from gauges per the registry's kinds, counters alone carry the
+        _total suffix, every family has a non-empty # HELP, and the
+        parsed kinds survive a full parse round-trip."""
+        from partisan_tpu.telemetry.registry import all_kinds
+        _, prom, _, _ = run
+        parsed = parse_exposition(prom.expose())
+        kinds = all_kinds(default_registry())
+        seen = 0
+        for name, kind in kinds.items():
+            fam = (f"partisan_{name}_total" if kind == "counter"
+                   else f"partisan_{name}")
+            if fam not in parsed:
+                continue  # families appear once a row mentioned them
+            seen += 1
+            assert parsed[fam]["type"] == kind, (fam, parsed[fam])
+            assert parsed[fam]["help"], fam
+            # the other spelling must NOT exist: the suffix IS the kind
+            other = (f"partisan_{name}" if kind == "counter"
+                     else f"partisan_{name}_total")
+            assert other not in parsed, other
+        assert seen >= 10  # the default registry's families showed up
+        # _total families are counters and ONLY counters, exactly
+        for fam, body in parsed.items():
+            if fam.endswith("_total"):
+                assert body["type"] == "counter", fam
+            else:
+                assert body["type"] == "gauge", fam
+
 
 # -------------------------------------------------------- host event bus
 
