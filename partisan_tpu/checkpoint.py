@@ -6,9 +6,18 @@ counter (hyparview :1175-1227), the full-membership OR-set
 snapshot (causality :261-263).  The TPU rebuild's checkpoint is *total and
 cheap* by comparison: one device->host transfer of the whole World pytree
 (views, clocks, epochs, in-flight messages, PRNG keys, fault masks), saved
-as an ``.npz`` + a JSON manifest of the Config.  Resume = load + re-shard
-(``parallel.place_world``) — a restarted cluster continues bit-identically,
-which the reference cannot do.
+as an ``.npz`` + a JSON manifest of the Config.  Resume = load + re-shard —
+a restarted cluster continues bit-identically, which the reference cannot
+do.
+
+Shard-awareness (ISSUE 4 satellite): ``save`` device-gets a world whose
+leaves live sharded across the mesh (``jax.device_get`` assembles the
+addressable shards into full host arrays), ``load`` validates every leaf
+against the template — named shape/dtype mismatches raise a clear error
+pointing at the likely config/protocol drift instead of a downstream
+reshape crash — and :func:`load_sharded` restores straight through
+``parallel.dataplane.place_sharded_world`` so a long chaos soak
+(scripts/chaos_soak.py) crash-resumes onto the mesh mid-campaign.
 
 Orbax is available in the image for production multi-host checkpointing;
 this module deliberately sticks to numpy files so checkpoints stay
@@ -32,6 +41,18 @@ _MANIFEST = "manifest.json"
 _ARRAYS = "world.npz"
 
 
+def _leaf_names(world: World) -> list:
+    """Human-readable leaf paths (``state.active``, ``msgs.valid`` ...)
+    for error messages; falls back to indices if path flattening is
+    unavailable for a custom pytree."""
+    try:
+        paths, _ = jax.tree_util.tree_flatten_with_path(world)
+        return [jax.tree_util.keystr(p) for p, _x in paths]
+    except Exception:  # noqa: BLE001 — names are a diagnostic nicety
+        return [f"leaf_{i}"
+                for i in range(len(jax.tree_util.tree_leaves(world)))]
+
+
 def _flatten(world: World) -> Tuple[Dict[str, np.ndarray], Any]:
     leaves, treedef = jax.tree_util.tree_flatten(world)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
@@ -39,8 +60,16 @@ def _flatten(world: World) -> Tuple[Dict[str, np.ndarray], Any]:
 
 
 def save(path: str, cfg: Config, world: World,
-         extra: Optional[Dict[str, Any]] = None) -> None:
-    """Write a complete checkpoint directory (atomic via rename)."""
+         extra: Optional[Dict[str, Any]] = None,
+         proto: Optional[Any] = None) -> None:
+    """Write a complete checkpoint directory (atomic via rename).
+
+    Works unchanged for worlds placed on a mesh (``place_world`` /
+    ``place_sharded_world``): ``jax.device_get`` gathers each leaf's
+    addressable shards into one host array.  ``proto`` (the protocol
+    instance or its class name) is recorded in the manifest so ``load``
+    can refuse a cross-protocol restore by name instead of by shape
+    accident."""
     tmp = path + ".tmp"
     os.makedirs(tmp, exist_ok=True)
     arrays, _ = _flatten(jax.device_get(world))
@@ -48,6 +77,9 @@ def save(path: str, cfg: Config, world: World,
     manifest = {
         "config": dataclasses.asdict(cfg),
         "round": int(world.rnd),
+        "proto": (proto if isinstance(proto, (str, type(None)))
+                  else type(proto).__name__),
+        "leaves": _leaf_names(world),
         "extra": extra or {},
     }
     with open(os.path.join(tmp, _MANIFEST), "w") as f:
@@ -58,21 +90,84 @@ def save(path: str, cfg: Config, world: World,
     os.replace(tmp, path)
 
 
-def load(path: str, template: World) -> Tuple[World, Dict[str, Any]]:
+def load(path: str, template: World, cfg: Optional[Config] = None,
+         proto: Optional[Any] = None) -> Tuple[World, Dict[str, Any]]:
     """Restore a checkpoint into the structure of ``template`` (build it
-    with ``init_world(cfg, proto)`` for the same Config/protocol).  Returns
-    (world, manifest)."""
+    with ``init_world(cfg, proto)`` for the same Config/protocol).
+    Returns (world, manifest).
+
+    Validation (clear errors, not reshape crashes):
+
+      * ``cfg`` given -> its ``n_nodes`` must match the manifest's (the
+        most common mismatch: resuming a soak with the wrong N);
+      * ``proto`` given (instance or class name) -> must match the
+        recorded protocol name when the manifest has one;
+      * every leaf's shape AND dtype must match the template's, reported
+        by leaf path name with the likely cause.
+    """
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
+    if cfg is not None:
+        saved_n = manifest.get("config", {}).get("n_nodes")
+        if saved_n is not None and int(saved_n) != cfg.n_nodes:
+            raise ValueError(
+                f"checkpoint was saved at n_nodes={saved_n}, loading "
+                f"config has n_nodes={cfg.n_nodes} — rebuild the "
+                f"template with the checkpoint's config "
+                f"(checkpoint.load_config({path!r}))")
+    if proto is not None:
+        want = proto if isinstance(proto, str) else type(proto).__name__
+        saved_proto = manifest.get("proto")
+        if saved_proto is not None and saved_proto != want:
+            raise ValueError(
+                f"checkpoint holds {saved_proto} state, template "
+                f"protocol is {want} — cross-protocol restore refused")
     data = np.load(os.path.join(path, _ARRAYS))
     leaves, treedef = jax.tree_util.tree_flatten(template)
     if len(leaves) != len(data.files):
         raise ValueError(
             f"checkpoint has {len(data.files)} leaves, template has "
             f"{len(leaves)} — protocol/config mismatch")
-    restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
+    names = manifest.get("leaves") or _leaf_names(template)
+    restored = []
+    for i, tmpl in enumerate(leaves):
+        arr = data[f"leaf_{i}"]
+        tshape = tuple(getattr(tmpl, "shape", ()))
+        tdtype = np.dtype(getattr(tmpl, "dtype", arr.dtype))
+        name = names[i] if i < len(names) else f"leaf_{i}"
+        if tuple(arr.shape) != tshape or np.dtype(arr.dtype) != tdtype:
+            raise ValueError(
+                f"checkpoint leaf {name}: saved {arr.shape} "
+                f"{np.dtype(arr.dtype).name} vs template {tshape} "
+                f"{tdtype.name} — n_nodes / protocol / buffer-capacity "
+                f"mismatch between save and restore configs")
+        restored.append(arr)
     world = jax.tree_util.tree_unflatten(treedef, restored)
     return world, manifest
+
+
+def load_sharded(path: str, cfg: Config, proto: Any, mesh,
+                 out_cap: Optional[int] = None
+                 ) -> Tuple[World, Dict[str, Any]]:
+    """Restore a checkpoint straight onto the explicit dataplane: builds
+    the template with the mesh-rounded buffer capacity
+    (``sharded_out_cap``), validates, then re-packs the message buffer
+    to the shard-residency invariant and device_puts every leaf with
+    its node sharding (``place_sharded_world``).  The crash-resume path
+    of long chaos soaks — the restored world continues bit-identically
+    under ``make_sharded_step``.
+
+    Note: the checkpoint must have been saved from a world built with
+    the SAME rounded capacity (``init_sharded_world`` or
+    ``init_world(out_cap=sharded_out_cap(...))``); a plain unsharded
+    capacity shows up as a clear ``msgs`` leaf-shape error."""
+    from .engine import init_world
+    from .parallel.dataplane import place_sharded_world, sharded_out_cap
+    D = int(mesh.devices.size)
+    template = init_world(
+        cfg, proto, out_cap=sharded_out_cap(cfg, proto, D, out_cap))
+    world, manifest = load(path, template, cfg=cfg, proto=proto)
+    return place_sharded_world(world, cfg, mesh), manifest
 
 
 def load_config(path: str) -> Config:

@@ -85,6 +85,23 @@ class Config:
     channels: Tuple[str, ...] = ("undefined",)  # ?CHANNELS (partisan.hrl:19)
     monotonic_channels: Tuple[str, ...] = ()    # {monotonic, C} channels keep-latest
     retransmit_interval: int = 1       # retransmit timer 1 s (pluggable :1299-1301)
+    retransmit_backoff_factor: int = 1
+    # ^ interval multiplier per retransmission ATTEMPT (the self-healing
+    #   leg, ISSUE 4): attempt k waits interval * factor^k rounds.  The
+    #   reference re-sends everything outstanding on a FIXED 1 s timer
+    #   (pluggable :905-942); 1 (default) reproduces that bit-for-bit,
+    #   2 halves retransmit pressure per surviving loss under sustained
+    #   faults (tests/test_chaos.py asserts the reduction at 20% loss).
+    retransmit_backoff_max: int = 0    # interval ceiling in rounds (0 = none)
+    retransmit_jitter: int = 0
+    # ^ deterministic per-(node, slot, attempt) jitter in [0, jitter]
+    #   extra rounds, desynchronizing cluster-wide retransmit storms
+    #   after a heal; hash-derived, so runs stay replayable.  0 = off.
+    retransmit_max_attempts: int = 0
+    # ^ give-up threshold: a slot retransmitted this many times is
+    #   DEAD-LETTERED — freed and counted (dead_lettered, surfaced via
+    #   health_counters/telemetry) instead of retried forever.  0 (the
+    #   reference's shape: retry until acked) = never give up.
     connection_retry_interval: int = 1  # reconnect tick 1 s (pluggable :1304-1306)
     relay_ttl: int = 5                 # ?RELAY_TTL (partisan.hrl:9)
     keepalive_interval: int = 2        # rounds between active-view keepalives

@@ -589,6 +589,7 @@ def make_step(
     donate: bool = True,
     capture_wire: bool = False,
     flight: Optional[Any] = None,
+    chaos: Optional[Any] = None,
 ) -> Callable[..., Tuple]:
     """Compile one simulation round for `proto`.
 
@@ -596,6 +597,17 @@ def make_step(
     funs (partisan_pluggable_peer_service_manager.erl:51-58, 640-667): pure
     functions over the flat message buffer that may invalidate (drop), rewrite
     fields, or bump `delay` ('$delay'), keyed off the round number.
+
+    ``chaos`` (a :class:`verify.chaos.ChaosSchedule`) compiles a whole
+    fault CAMPAIGN into the round: crash/recover/partition/heal events
+    rewrite the ``alive``/``partition`` planes at the top of the round
+    and matching drop/delay/duplicate events edit the ready buffer right
+    after the held split — all in-scan arithmetic over a static event
+    table, no host involvement per round.  The step metrics gain
+    ``chaos_dropped``/``chaos_delayed``/``chaos_duplicated`` counters.
+    The sharded dataplane accepts the same schedule
+    (``parallel/dataplane.make_sharded_step(chaos=)``) and applies it
+    shard-locally, bit-identically to this path.
 
     ``capture_wire=True`` adds the post-interposition pre-route buffer to
     the metrics dict (keys ``wire_valid/src/dst/typ/channel/hash``) — the
@@ -636,11 +648,22 @@ def make_step(
         # lazy: telemetry.runner imports engine, so engine must not
         # import telemetry at module load
         from .telemetry.flight import flight_record
+    if chaos is not None:
+        # lazy for the same reason: verify imports engine
+        from .verify.chaos import apply_chaos_msgs, apply_chaos_nodes
 
     def step(world: World, fring=None):
-        state, msgs, rnd = world.state, world.msgs, world.rnd
-        rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys, rnd)
+        rnd = world.rnd
         node_ids = jnp.arange(N, dtype=jnp.int32)
+        if chaos is not None:
+            # node plane first: a node crashed at round r neither sends
+            # nor receives IN round r, and the updated planes persist in
+            # the carried world
+            alive2, part2 = apply_chaos_nodes(
+                chaos, rnd, world.alive, world.partition, node_ids)
+            world = world.replace(alive=alive2, partition=part2)
+        state, msgs = world.state, world.msgs
+        rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys, rnd)
 
         # -- split delayed messages out first so interposition and fault
         #    masks apply exactly once, at delivery time (not per held round)
@@ -649,6 +672,16 @@ def make_step(
                             delay=jnp.maximum(msgs.delay - 1, 0))
         now = msgs.replace(valid=msgs.valid & (msgs.delay <= 0))
         ready = jnp.sum(now.valid).astype(jnp.int32)
+
+        # -- chaos message plane (drop / delay / duplicate events): the
+        #    same pre-fault-plane capture point the sharded dataplane
+        #    uses (src-shard residency), so both paths stay bit-equal
+        chaos_counts = None
+        if chaos is not None:
+            now, chaos_held, chaos_counts = apply_chaos_msgs(
+                chaos, rnd, now)
+            if chaos_held is not None:
+                held = msgops.concat(held, chaos_held)
 
         # -- fault plane: crashed nodes neither send nor receive; messages
         #    crossing a partition boundary are dropped (hyparview partition
@@ -672,10 +705,13 @@ def make_step(
             now = now.replace(valid=now.valid & (now.delay <= 0))
             re_held_ct = jnp.sum(re_held.valid).astype(jnp.int32)
         # fault-plane drop count: crash masks + partitions + omission
-        # interposition (re-held delays are not drops) — the telemetry
-        # tap for "how much traffic did the fault plane eat this round"
+        # interposition + chaos drops (re-held delays are not drops) —
+        # the telemetry tap for "how much traffic did the fault plane
+        # eat this round"
         fault_dropped = (ready - jnp.sum(now.valid).astype(jnp.int32)
                          - re_held_ct)
+        if chaos_counts is not None:
+            fault_dropped = fault_dropped - chaos_counts["chaos_delayed"]
 
         # -- connection lanes: partition-key hash or random spread over the
         #    k parallel connections (dispatch_pid, partisan_util.erl:142-201)
@@ -753,6 +789,8 @@ def make_step(
                                     | (inbox_typ >= n_types))
                                  ).astype(jnp.int32),
         }
+        if chaos_counts is not None:
+            metrics.update(chaos_counts)
         if capture_wire:
             metrics.update(
                 wire_valid=now.valid, wire_src=now.src, wire_dst=now.dst,

@@ -41,7 +41,7 @@ from flax import struct
 
 from ..config import Config
 from ..ops.msg import Msgs
-from ..qos.ack import retransmit_due
+from ..qos.ack import backoff_kw, retransmit_backoff
 from ..ops import padded_set as ps
 from ..ops import ring
 from .. import prng
@@ -64,8 +64,11 @@ class DataRow:
     out_age: jax.Array     # [N, R] rounds since (re)transmission
     out_chan: jax.Array    # [N, R] original channel — retransmits reuse
     out_pk: jax.Array      # [N, R] original partition key (lane affinity)
+    out_attempt: jax.Array  # [N, R] retransmissions fired (backoff plane)
     next_seq: jax.Array    # [N] monotone clock source (1-based; 0 = no ack)
     send_dropped: jax.Array  # [N] acked sends lost to a full ring (counted)
+    dead_lettered: jax.Array  # [N] slots abandoned at the backoff give-up
+                              # threshold (counted, never silent)
     relay_expired: jax.Array  # [N] relays dropped at TTL 0 / no next hop
                               # (the reference logs-and-drops, hyparview
                               # :1154-1157; here counted, never silent)
@@ -147,8 +150,10 @@ class DataPlane(UpperProtocol):
             out_age=jnp.zeros((n, R), jnp.int32),
             out_chan=jnp.zeros((n, R), jnp.int32),
             out_pk=jnp.full((n, R), -1, jnp.int32),
+            out_attempt=jnp.zeros((n, R), jnp.int32),
             next_seq=jnp.ones((n,), jnp.int32),
             send_dropped=jnp.zeros((n,), jnp.int32),
+            dead_lettered=jnp.zeros((n,), jnp.int32),
             relay_expired=jnp.zeros((n,), jnp.int32),
             relay_seq=jnp.ones((n,), jnp.int32),
             seen_src=jnp.full((n, 8), -1, jnp.int32),
@@ -179,6 +184,7 @@ class DataPlane(UpperProtocol):
             out_age=wr(up.out_age, 0),
             out_chan=wr(up.out_chan, m.channel),
             out_pk=wr(up.out_pk, m.data["partition_key"]),
+            out_attempt=wr(up.out_attempt, 0),
             next_seq=up.next_seq + want_ack.astype(jnp.int32),
             send_dropped=up.send_dropped
             + (want_ack & ~ok).astype(jnp.int32),
@@ -306,9 +312,13 @@ class DataPlane(UpperProtocol):
         RTT; without the floor every acked send would be delivered
         duplicate-per-round until its ack lands."""
         up: DataRow = row.upper
-        age, due = retransmit_due(up.out_valid, up.out_age,
-                                  max(cfg.retransmit_interval, 3))
-        row = self.up(row, up.replace(out_age=age))
+        valid, age, attempt, due, dead = retransmit_backoff(
+            up.out_valid, up.out_age, up.out_attempt, me,
+            **backoff_kw(cfg, base=max(cfg.retransmit_interval, 3)))
+        up = up.replace(out_valid=valid, out_age=age,
+                        out_attempt=attempt,
+                        dead_lettered=up.dead_lettered + dead)
+        row = self.up(row, up)
         if not cfg.broadcast:
             em = self.emit(jnp.where(due, up.out_dst, -1), self.typ("fwd"),
                            cap=self.tick_emit_cap, channel=up.out_chan,
@@ -337,6 +347,7 @@ class DataPlane(UpperProtocol):
 
     def health_counters(self, state: DataRow):
         return {"fwd_send_dropped": jnp.sum(state.send_dropped),
+                "fwd_dead_lettered": jnp.sum(state.dead_lettered),
                 "relay_expired": jnp.sum(state.relay_expired)}
 
     # ---------------------------------------------------------- host surface

@@ -198,7 +198,8 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                      faults: bool = False,
                      interpose=None,
                      phase_window: int = 1,
-                     shuffle_window: Optional[int] = None):
+                     shuffle_window: Optional[int] = None,
+                     resub_policy=None):
     """Compile one dense round: ``state -> state``.  Deterministic from
     (cfg.seed, state.rnd) like the engine's rounds.
 
@@ -236,7 +237,14 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
     Dropping a promotion proposal is the reference's lost
     neighbor_request; dropping a shuffle is a lost shuffle/shuffle_reply
     pair.  The benchmark program (faults=False) omits the partition
-    gathers and hook calls entirely."""
+    gathers and hook calls entirely.
+
+    ``resub_policy`` — a fun ``(lonely: [N] bool, rnd) -> [N] bool
+    keep-mask`` gating the isolation re-subscribe (the chaos-aware hook,
+    ISSUE 4: ``verify.chaos.quiesce_resub(sched)`` suppresses re-join
+    storms for a margin around each scheduled crash/partition event).
+    None (default) keeps every lonely row — the pre-hook program,
+    bit-identical."""
     assert skip <= {"repair", "promotion", "shuffle", "merge"}, (
         f"unknown phase(s) in skip: "
         f"{skip - {'repair', 'promotion', 'shuffle', 'merge'}}")
@@ -379,6 +387,8 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
         # join contact retry, scamp_v2 :130-178, pluggable :944-969)
         lonely = alive & (jnp.sum(active >= 0, axis=1) == 0) \
             & (jnp.sum(passive >= 0, axis=1) == 0)
+        if resub_policy is not None:
+            lonely = lonely & resub_policy(lonely, state.rnd)
         fresh = jax.random.randint(
             jax.random.fold_in(key, 40), (N,), 0, N, jnp.int32)
         fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)

@@ -196,7 +196,8 @@ K_SWEEP = 8
 def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
                            max_age: int = 64,
                            skip: Tuple[str, ...] = (),
-                           phase_window: int = 1):
+                           phase_window: int = 1,
+                           resub_policy=None):
     # ``skip``: static tuple of phases to omit.  {churn, admit, inview}
     # are the bisection/ablation surface for the N=2^16 TPU worker fault
     # (ROADMAP 1d); {resub, sweep} are the CADENCE surface (ISSUE 2) —
@@ -319,6 +320,12 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         if 'resub' not in _dbg:
             lonely = alive & (jnp.sum(partial >= 0, axis=1) == 0) \
                 & (jnp.sum(pos >= 0, axis=1) == 0)
+            # chaos-aware hook (ISSUE 4): a (lonely, rnd) -> keep-mask
+            # policy, e.g. verify.chaos.quiesce_resub — suppress re-join
+            # storms around scheduled crash/partition events.  None =
+            # the pre-hook program, bit-identical.
+            if resub_policy is not None:
+                lonely = lonely & resub_policy(lonely, st.rnd)
             fresh = jax.random.randint(
                 jax.random.fold_in(key, 3), (N,), 0, N, jnp.int32)
             fresh = jnp.where(fresh == ids, (fresh + 1) % N, fresh)

@@ -36,9 +36,11 @@ rows, so worlds built by them must pass :func:`shard_align_msgs` before
 
 Deliberate non-goals (use the implicit path / unsharded step instead):
 ``interpose_recv`` ('$delay' re-holds would strand a message on its
-dst's shard, breaking the invariant for later src-side masks) and
-``capture_wire`` (the per-round host dump would sync the mesh every
-round).  The trace plane is instead the ``flight`` parameter (ISSUE 3):
+dst's shard, breaking the invariant for later src-side masks — passing
+it raises a ValueError at build time pointing at the supported
+alternative, a ``verify.chaos.ChaosSchedule`` drop/delay event applied
+pre-exchange; ISSUE 4) and ``capture_wire`` (the per-round host dump
+would sync the mesh every round).  The trace plane is instead the ``flight`` parameter (ISSUE 3):
 a :class:`telemetry.flight.FlightSpec` makes each shard record its
 post-exchange wire slice into a per-shard device ring carried through
 the step — shard-local arithmetic only, ZERO extra collectives, so the
@@ -84,6 +86,10 @@ _CORE = ("src", "dst", "typ", "channel", "lane", "delay", "born")
 _SUM_KEYS = ("delivered", "sent", "inbox_overflow", "out_dropped",
              "routed", "fault_dropped", "inflight", "alive",
              "unhandled", "xshard_dropped")
+
+# chaos-plane counters appended to the stacked psum when a ChaosSchedule
+# is compiled in (still ONE psum — the stack grows three rows)
+_CHAOS_KEYS = ("chaos_dropped", "chaos_delayed", "chaos_duplicated")
 
 
 def _field_layout(data_spec):
@@ -231,10 +237,12 @@ def make_sharded_step(
     mesh: Mesh,
     out_cap: Optional[int] = None,
     interpose_send: Optional[Callable] = None,
+    interpose_recv: Optional[Callable] = None,
     randomize_delivery: bool = True,
     donate: bool = True,
     bucket_cap: Optional[int] = None,
     flight=None,
+    chaos=None,
 ) -> Callable[..., Tuple]:
     """Compile one explicitly-sharded simulation round.
 
@@ -257,7 +265,37 @@ def make_sharded_step(
     ``make_flight_ring(spec, n_shards=D)`` + ``place_flight_ring``:
     ``step(world, fring) -> (world, fring, metrics)``.  Recording adds
     no collectives (the budget above is unchanged); flush on the host,
-    outside the round."""
+    outside the round.
+
+    ``chaos`` (a :class:`verify.chaos.ChaosSchedule`) compiles the fault
+    campaign into the round, bit-identically to
+    ``engine.make_step(chaos=)``: the node plane folds each shard's OWN
+    alive/partition rows against the static event table, and the message
+    plane edits the ready buffer PRE-exchange — while every message
+    still sits on its src's shard, so chaos-delayed re-holds and
+    duplicate copies join the shard-local held traffic without breaking
+    the residency invariant.  Both planes are shard-local arithmetic:
+    the 2-collective budget holds chaos-on (the metric psum stack grows
+    three ``chaos_*`` rows, still ONE psum).
+
+    ``interpose_recv`` is rejected here (a clear ``ValueError`` at build
+    time): the recv hook runs AFTER routing on the unsharded path, which
+    under the dataplane is post-exchange — a hook that bumps ``delay``
+    ('$delay') would re-hold the message on its DESTINATION's shard,
+    breaking the src-residency invariant the src-side fault masks and
+    the next round's exchange depend on (the message would silently
+    never re-deliver).  Express recv-side drops and delays as chaos
+    ``KIND_DROP``/``KIND_DELAY`` events instead — they run pre-exchange
+    on both paths — or use the unsharded ``engine.make_step``."""
+    if interpose_recv is not None:
+        raise ValueError(
+            "make_sharded_step does not support interpose_recv: a "
+            "'$delay' re-hold fired after the exchange would strand the "
+            "message on its destination's shard (silent loss — it could "
+            "never re-deliver through the src-side held split).  Use a "
+            "verify.chaos.ChaosSchedule drop/delay event instead "
+            "(applied pre-exchange, bit-identical on both paths), or "
+            "the unsharded engine.make_step.")
     cfg = autotune(cfg, proto)
     N = cfg.n_nodes
     K = cfg.inbox_cap
@@ -288,6 +326,8 @@ def make_sharded_step(
     if flight is not None:
         from ..telemetry.flight import (flight_partition_specs,
                                         flight_record)
+    if chaos is not None:
+        from ..verify.chaos import apply_chaos_msgs, apply_chaos_nodes
 
     def exchange(now: Msgs, src_part: jax.Array):
         """Bucket the local ready messages by destination shard and
@@ -315,10 +355,18 @@ def make_sharded_step(
         return got, gpart, xdrop
 
     def step_body(world: World, fring=None):
-        state, msgs, rnd = world.state, world.msgs, world.rnd
+        rnd = world.rnd
         me = jax.lax.axis_index(NODE_AXIS)
         node_base = (me * n_loc).astype(jnp.int32)
         node_ids = node_base + jnp.arange(n_loc, dtype=jnp.int32)
+        if chaos is not None:
+            # chaos node plane over this shard's OWN rows (global ids):
+            # the same fold the unsharded step runs, restricted to a
+            # slice — zero collectives, carried in the sharded world
+            alive2, part2 = apply_chaos_nodes(
+                chaos, rnd, world.alive, world.partition, node_ids)
+            world = world.replace(alive=alive2, partition=part2)
+        state, msgs = world.state, world.msgs
         rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys,
                                                             rnd)
 
@@ -329,6 +377,18 @@ def make_sharded_step(
                             delay=jnp.maximum(msgs.delay - 1, 0))
         now = msgs.replace(valid=msgs.valid & (msgs.delay <= 0))
         ready = jnp.sum(now.valid).astype(jnp.int32)
+
+        # -- chaos message plane, PRE-exchange: every message is still
+        #    on its src's shard here, so re-holds and duplicate copies
+        #    join the shard-local held traffic (residency invariant
+        #    kept) and the arithmetic matches the unsharded step's
+        #    capture point bit for bit
+        chaos_counts = None
+        if chaos is not None:
+            now, chaos_held, chaos_counts = apply_chaos_msgs(
+                chaos, rnd, now)
+            if chaos_held is not None:
+                held = msgops.concat(held, chaos_held)
 
         # -- src-side fault plane: sender aliveness reads only local
         #    rows (the shard invariant); the sender's partition id is
@@ -359,6 +419,9 @@ def make_sharded_step(
                           & (world.partition[dst_row] == gpart))
         survived = jnp.sum(now.valid).astype(jnp.int32)
         fault_dropped = ready - survived - xdrop
+        if chaos_counts is not None:
+            # re-held (chaos-delayed) messages are deferred, not dropped
+            fault_dropped = fault_dropped - chaos_counts["chaos_delayed"]
 
         # -- flight recorder (ISSUE 3): this shard's post-exchange wire
         #    slice into its local ring row — the same capture point as
@@ -406,7 +469,7 @@ def make_sharded_step(
         dropped = dropped + node_dropped
 
         inbox_typ = nowp.typ[jnp.where(ib_valid, ib_idx, nowp.cap - 1)]
-        partials = jnp.stack([
+        rows = [
             jnp.sum(ib_valid).astype(jnp.int32),            # delivered
             out.count(),                                    # sent
             overflow,                                       # inbox_overflow
@@ -419,20 +482,25 @@ def make_sharded_step(
                                 | (inbox_typ >= n_types))
                     ).astype(jnp.int32),                    # unhandled
             xdrop,                                          # xshard_dropped
-        ])
+        ]
+        if chaos_counts is not None:
+            rows += [chaos_counts[k] for k in _CHAOS_KEYS]
+        partials = jnp.stack(rows)
         totals = jax.lax.psum(partials, NODE_AXIS)          # ONE psum
         metrics = {"round": rnd}
-        metrics.update({k: totals[i] for i, k in enumerate(_SUM_KEYS)})
+        metrics.update({k: totals[i] for i, k in enumerate(sum_keys)})
         new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
         if flight is not None:
             return new_world, fring, metrics
         return new_world, metrics
 
+    sum_keys = _SUM_KEYS + (_CHAOS_KEYS if chaos is not None else ())
+
     def spec_of(x):
         return P(NODE_AXIS) if getattr(x, "ndim", 0) >= 1 else P()
 
     metric_specs = {"round": P()}
-    metric_specs.update({k: P() for k in _SUM_KEYS})
+    metric_specs.update({k: P() for k in sum_keys})
 
     if flight is not None:
         fr_specs = flight_partition_specs(NODE_AXIS)

@@ -17,15 +17,17 @@ test/partisan_SUITE.erl:573).
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 from ..config import Config
 from ..engine import ProtocolBase
 from ..ops import ring
+from ..ops.bitset import mix32 as _mix
 from ..ops.msg import Msgs
 
 
@@ -36,10 +38,13 @@ class AckRow:
     out_payload: jax.Array  # [R]
     out_seq: jax.Array      # [R] origin-scoped message id
     out_age: jax.Array      # [R] rounds since (re)transmission
+    out_attempt: jax.Array  # [R] retransmissions fired so far (backoff)
     next_seq: jax.Array     # scalar — monotone id source
     seen: jax.Array         # [S] delivery counters per origin (test surface)
     send_dropped: jax.Array  # scalar — ctl_sends lost to a full ring
                              # (overflow surfaced, never silent)
+    dead_lettered: jax.Array  # scalar — slots abandoned at the backoff
+                              # give-up threshold (surfaced, never silent)
 
 
 def init_rows(n_nodes: int, ring_cap: int = 8) -> AckRow:
@@ -50,9 +55,11 @@ def init_rows(n_nodes: int, ring_cap: int = 8) -> AckRow:
         out_payload=jnp.zeros((n, ring_cap), jnp.int32),
         out_seq=jnp.zeros((n, ring_cap), jnp.int32),
         out_age=jnp.zeros((n, ring_cap), jnp.int32),
+        out_attempt=jnp.zeros((n, ring_cap), jnp.int32),
         next_seq=jnp.ones((n,), jnp.int32),
         seen=jnp.zeros((n, n_nodes), jnp.int32),
         send_dropped=jnp.zeros((n,), jnp.int32),
+        dead_lettered=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -69,6 +76,7 @@ def store(row: AckRow, dst, payload) -> Tuple[AckRow, jax.Array, jax.Array]:
         out_payload=wr(row.out_payload, payload),
         out_seq=wr(row.out_seq, seq),
         out_age=wr(row.out_age, 0),
+        out_attempt=wr(row.out_attempt, 0),
         next_seq=seq + 1,
     )
     return row, seq, ok
@@ -86,13 +94,79 @@ def outstanding(row: AckRow) -> jax.Array:
 
 def retransmit_due(valid: jax.Array, age: jax.Array,
                    interval: int) -> Tuple[jax.Array, jax.Array]:
-    """The shared retransmit-timer step (pluggable :905-942): ages valid
-    slots, fires those at the interval, resets fired ages.  Returns
-    (new_age, due).  Used by AckedDelivery and CausalAcked so the timer
-    logic exists exactly once."""
+    """The fixed-interval retransmit-timer step (pluggable :905-942):
+    ages valid slots, fires those at the interval, resets fired ages.
+    Returns (new_age, due).  Kept as the minimal primitive;
+    :func:`retransmit_backoff` is the full self-healing timer (ISSUE 4)
+    that every acked layer now routes through — with backoff disabled
+    it reduces to exactly this function."""
     age = jnp.where(valid, age + 1, 0)
     due = valid & (age >= interval)
     return jnp.where(due, 0, age), due
+
+
+def retransmit_backoff(valid: jax.Array, age: jax.Array,
+                       attempt: jax.Array, me, *, base: int,
+                       factor: int = 1, max_interval: int = 0,
+                       jitter: int = 0, max_attempts: int = 0
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                  jax.Array, jax.Array]:
+    """The self-healing retransmit timer (ISSUE 4): exponential backoff
+    with deterministic jitter and a give-up/dead-letter exit, replacing
+    the fixed ageing of :func:`retransmit_due` in every acked layer.
+
+    Per slot, attempt k fires after ``base * factor^k`` rounds (capped
+    at ``max_interval`` when > 0) plus a deterministic jitter draw in
+    ``[0, jitter]`` hashed from ``(me, slot, attempt)`` — replayable,
+    but cluster-wide retransmit storms desynchronize.  A slot that has
+    already fired ``max_attempts`` retransmissions (> 0) is
+    DEAD-LETTERED when it next comes due: freed and counted, never
+    retried silently forever against a peer that is gone.
+
+    Runs per node under the engine's vmap: ``valid/age/attempt`` are the
+    node's ``[R]`` ring slices, ``me`` its scalar id.  Returns
+    ``(valid', age', attempt', due, dead_count)``.
+
+    Disabled knobs (``factor=1, jitter=0, max_attempts=0`` — the Config
+    defaults) make this BIT-EQUAL to ``retransmit_due(valid, age,
+    base)`` with ``valid`` untouched (tests/test_chaos.py pins the
+    equivalence), so every protocol that switched to this timer is
+    bit-compatible with its pre-backoff self by default.
+    """
+    age = jnp.where(valid, age + 1, 0)
+    if factor > 1:
+        # int32-safe exponent clamp; the cap (if any) is applied after
+        expo = jnp.clip(attempt, 0, 20)
+        interval = jnp.int32(base) * jnp.power(jnp.int32(factor), expo)
+        if max_interval > 0:
+            interval = jnp.minimum(interval, jnp.int32(max_interval))
+    else:
+        interval = jnp.full_like(age, jnp.int32(base))
+    if jitter > 0:
+        slot_ids = jnp.arange(valid.shape[0], dtype=jnp.uint32)
+        h = _mix(jnp.uint32(me) * jnp.uint32(0x9E3779B9)
+                 ^ (slot_ids << 8) ^ attempt.astype(jnp.uint32))
+        interval = interval + (h % jnp.uint32(jitter + 1)
+                               ).astype(jnp.int32)
+    due = valid & (age >= interval)
+    if max_attempts > 0:
+        dead = due & (attempt >= max_attempts)
+        due = due & ~dead
+    else:
+        dead = jnp.zeros_like(due)
+    valid = valid & ~dead
+    age = jnp.where(due | dead, 0, age)
+    attempt = jnp.where(valid, attempt + due.astype(jnp.int32), 0)
+    return valid, age, attempt, due, jnp.sum(dead).astype(jnp.int32)
+
+
+def backoff_kw(cfg: Config, base: Optional[int] = None) -> dict:
+    """The Config tier of the backoff knobs (one place, every layer)."""
+    return dict(base=cfg.retransmit_interval if base is None else base,
+                factor=cfg.retransmit_backoff_factor,
+                max_interval=cfg.retransmit_backoff_max,
+                jitter=cfg.retransmit_jitter,
+                max_attempts=cfg.retransmit_max_attempts)
 
 
 class AckedDelivery(ProtocolBase):
@@ -138,12 +212,51 @@ class AckedDelivery(ProtocolBase):
         return ack(row, m.data["seq"]), self.no_emit()
 
     def tick(self, cfg, me, row: AckRow, rnd, key):
-        """Retransmit timer: re-emit every outstanding slot whose age hits
-        the interval; age resets on retransmission."""
-        age, due = retransmit_due(row.out_valid, row.out_age,
-                                  cfg.retransmit_interval)
-        row = row.replace(out_age=age)
+        """Retransmit timer: re-emit every outstanding slot whose age
+        reaches its (backoff) interval; a slot past the give-up
+        threshold is dead-lettered and counted."""
+        valid, age, attempt, due, dead = retransmit_backoff(
+            row.out_valid, row.out_age, row.out_attempt, me,
+            **backoff_kw(cfg))
+        row = row.replace(out_valid=valid, out_age=age,
+                          out_attempt=attempt,
+                          dead_lettered=row.dead_lettered + dead)
         em = self.emit(jnp.where(due, row.out_dst, -1),
                        self.typ("app"), cap=self.tick_emit_cap,
                        payload=row.out_payload, seq=row.out_seq)
         return row, em
+
+    def health_counters(self, state: AckRow) -> Dict[str, jax.Array]:
+        """The ack-ring degradation taps (ISSUE 4 satellite): overflow
+        and dead-letter totals, surfaced through metrics.world_health
+        and the telemetry ring (verify.health.QOS_SPECS)."""
+        return {"ack_outstanding": jnp.sum(state.out_valid),
+                "ack_send_dropped": jnp.sum(state.send_dropped),
+                "ack_dead_lettered": jnp.sum(state.dead_lettered)}
+
+
+# ------------------------------------------------------------- host taps
+
+def emit_ring_events(state, label: str = "ack") -> Dict[str, int]:
+    """Host-side telemetry tap (ISSUE 4 satellite): fold the ring's
+    degradation counters and emit one event per NONZERO total to the
+    global sinks — ``<label>_send_ring_overflow`` for sends lost to a
+    full outstanding ring (the ``store`` overflow that previously only
+    bumped ``send_dropped``) and ``<label>_dead_letter`` for slots
+    abandoned at the backoff give-up threshold.  Works on any row state
+    carrying ``send_dropped`` / ``dead_lettered`` (AckRow,
+    CausalAckedRow, CausalAckedSparseRow, DataPlane's DataRow), so
+    soaks can assert on the event stream regardless of layer.  Returns
+    the totals either way (zero-cost contract: no sinks, no events)."""
+    from .. import telemetry
+    out: Dict[str, int] = {}
+    for event, field in (("send_ring_overflow", "send_dropped"),
+                         ("dead_letter", "dead_lettered")):
+        arr = getattr(state, field, None)
+        if arr is None:
+            continue
+        total = int(np.asarray(jax.device_get(jnp.sum(arr))))
+        out[event] = total
+        if total:
+            telemetry.emit_event(f"{label}_{event}", total=total)
+    return out

@@ -202,9 +202,16 @@ class CausalAckedRow:
     out_clock: jax.Array   # [R, A]
     out_seq: jax.Array     # [R]
     out_age: jax.Array     # [R]
+    out_attempt: jax.Array  # [R] retransmissions fired (backoff plane)
     next_seq_to: jax.Array  # [A] per-destination stream seq source (so
                             # seqs per (me -> dst) stream are contiguous)
     send_dropped: jax.Array  # scalar — full-ring losses, surfaced
+    dead_lettered: jax.Array  # scalar — slots abandoned at the backoff
+                              # give-up threshold.  NOTE: dead-lettering
+                              # a SEQUENCED slot abandons the whole
+                              # (me -> dst) stream suffix (drain delivers
+                              # in seq order) — the counter is the alarm;
+                              # default max_attempts=0 never gives up.
 
 
 class CausalDelivery(ProtocolBase):
@@ -297,8 +304,10 @@ class CausalAcked(CausalDelivery):
             out_clock=jnp.zeros((n, r, a), jnp.int32),
             out_seq=jnp.zeros((n, r), jnp.int32),
             out_age=jnp.zeros((n, r), jnp.int32),
+            out_attempt=jnp.zeros((n, r), jnp.int32),
             next_seq_to=jnp.ones((n, a), jnp.int32),
             send_dropped=jnp.zeros((n,), jnp.int32),
+            dead_lettered=jnp.zeros((n,), jnp.int32),
         )
 
     def handle_ctl_csend(self, cfg, me, row: CausalAckedRow, m: Msgs, key):
@@ -324,6 +333,7 @@ class CausalAcked(CausalDelivery):
             out_clock=wr(row.out_clock, clock),
             out_seq=wr(row.out_seq, seq),
             out_age=wr(row.out_age, 0),
+            out_attempt=wr(row.out_attempt, 0),
             next_seq_to=row.next_seq_to.at[d].add(ok.astype(jnp.int32)),
             send_dropped=row.send_dropped + (~ok).astype(jnp.int32),
         )
@@ -356,13 +366,22 @@ class CausalAcked(CausalDelivery):
     def tick(self, cfg, me, row: CausalAckedRow, rnd, key):
         crow, _ = drain(row.causal, me)
         row = row.replace(causal=crow)
-        # reemit the stored wire copies of unacked messages
-        age, due = ack_mod.retransmit_due(row.out_valid, row.out_age,
-                                          cfg.retransmit_interval)
-        row = row.replace(out_age=age)
+        # reemit the stored wire copies of unacked messages (backoff
+        # timer; defaults bit-equal the fixed interval — ack.py)
+        valid, age, attempt, due, dead = ack_mod.retransmit_backoff(
+            row.out_valid, row.out_age, row.out_attempt, me,
+            **ack_mod.backoff_kw(cfg))
+        row = row.replace(out_valid=valid, out_age=age,
+                          out_attempt=attempt,
+                          dead_lettered=row.dead_lettered + dead)
         em = self.emit(jnp.where(due, row.out_dst, -1),
                        self.typ("causal"), cap=self.tick_emit_cap,
                        payload=row.out_payload, dep=row.out_dep,
                        has_dep=row.out_has_dep.astype(jnp.int32),
                        clock=row.out_clock, seq=row.out_seq)
         return row, em
+
+    def health_counters(self, state: CausalAckedRow):
+        return {"ack_outstanding": jnp.sum(state.out_valid),
+                "ack_send_dropped": jnp.sum(state.send_dropped),
+                "ack_dead_lettered": jnp.sum(state.dead_lettered)}

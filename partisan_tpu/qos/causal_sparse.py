@@ -340,7 +340,11 @@ class CausalAckedSparseRow:
     out_clk_cnt: jax.Array  # [R, K]
     out_seq: jax.Array      # [R]
     out_age: jax.Array      # [R]
+    out_attempt: jax.Array  # [R] retransmissions fired (backoff plane)
     send_dropped: jax.Array  # scalar — full-ring losses, surfaced
+    dead_lettered: jax.Array  # scalar — backoff give-up slots (counted;
+                              # abandoning a sequenced slot abandons the
+                              # stream suffix — see qos/causal.py note)
 
 
 class CausalAckedSparse(CausalDeliverySparse):
@@ -383,7 +387,9 @@ class CausalAckedSparse(CausalDeliverySparse):
             out_clk_cnt=jnp.zeros((n, r, k), jnp.int32),
             out_seq=jnp.zeros((n, r), jnp.int32),
             out_age=jnp.zeros((n, r), jnp.int32),
+            out_attempt=jnp.zeros((n, r), jnp.int32),
             send_dropped=jnp.zeros((n,), jnp.int32),
+            dead_lettered=jnp.zeros((n,), jnp.int32),
         )
 
     def handle_ctl_csend(self, cfg, me, row: CausalAckedSparseRow,
@@ -418,6 +424,7 @@ class CausalAckedSparse(CausalDeliverySparse):
             out_clk_cnt=wr(row.out_clk_cnt, clk_cnt),
             out_seq=wr(row.out_seq, seq),
             out_age=wr(row.out_age, 0),
+            out_attempt=wr(row.out_attempt, 0),
             send_dropped=row.send_dropped + (~ok).astype(jnp.int32),
         )
         em = self.emit(jnp.where(ok, dst, -1)[None], self.typ("causal"),
@@ -454,10 +461,14 @@ class CausalAckedSparse(CausalDeliverySparse):
     def tick(self, cfg, me, row: CausalAckedSparseRow, rnd, key):
         crow, _ = drain(row.causal, me)
         row = row.replace(causal=crow)
-        # reemit the stored wire copies of unacked messages
-        age, due = ack_mod.retransmit_due(row.out_valid, row.out_age,
-                                          cfg.retransmit_interval)
-        row = row.replace(out_age=age)
+        # reemit the stored wire copies of unacked messages (backoff
+        # timer; defaults bit-equal the fixed interval — ack.py)
+        valid, age, attempt, due, dead = ack_mod.retransmit_backoff(
+            row.out_valid, row.out_age, row.out_attempt, me,
+            **ack_mod.backoff_kw(cfg))
+        row = row.replace(out_valid=valid, out_age=age,
+                          out_attempt=attempt,
+                          dead_lettered=row.dead_lettered + dead)
         em = self.emit(jnp.where(due, row.out_dst, -1),
                        self.typ("causal"), cap=self.tick_emit_cap,
                        payload=row.out_payload,
@@ -466,3 +477,8 @@ class CausalAckedSparse(CausalDeliverySparse):
                        clk_act=row.out_clk_act, clk_cnt=row.out_clk_cnt,
                        seq=row.out_seq)
         return row, em
+
+    def health_counters(self, state: CausalAckedSparseRow):
+        return {"ack_outstanding": jnp.sum(state.out_valid),
+                "ack_send_dropped": jnp.sum(state.send_dropped),
+                "ack_dead_lettered": jnp.sum(state.dead_lettered)}

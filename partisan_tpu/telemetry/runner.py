@@ -39,6 +39,11 @@ ENGINE_KEYMAP: Dict[str, str] = {
     "unhandled": "unhandled",
     "inflight": "inflight",
     "alive": "alive",
+    # chaos-plane counters (present when the step compiled a
+    # ChaosSchedule; verify/chaos.py)
+    "chaos_dropped": "chaos_dropped",
+    "chaos_delayed": "chaos_delayed",
+    "chaos_duplicated": "chaos_duplicated",
 }
 
 
@@ -76,6 +81,18 @@ def collect_round_metrics(proto: ProtocolBase, world: World,
     if "convergence" in registry and hasattr(proto, "member_mask"):
         masks = jax.vmap(proto.member_mask)(world.state)
         vals["convergence"] = metrics_mod.convergence(masks, world.alive)
+    if views is not None and "health_reach_frac" in registry:
+        # the ISSUE-4 health plane (connectivity proxy + view fill);
+        # lazy import — verify's package init imports telemetry
+        from ..verify import health as health_mod
+        vals.update(health_mod.collect_health_views(
+            views, world.alive, partition=world.partition))
+    # protocol-owned degradation counters (qos ack-ring overflow,
+    # dead-letter, relay expiry ...) tap into the ring whenever the
+    # registry carries their names (verify.health.QOS_SPECS)
+    for k, v in proto.health_counters(world.state).items():
+        if k in registry:
+            vals[k] = v
     return vals
 
 

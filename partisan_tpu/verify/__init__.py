@@ -8,3 +8,6 @@ test/filibuster_SUITE.erl."""
 from .interposition import Interposition  # noqa: F401
 from . import faults  # noqa: F401
 from .trace import TraceRecorder, TraceEntry  # noqa: F401
+from . import chaos  # noqa: F401  (ISSUE 4: compiled fault schedules)
+from . import health  # noqa: F401  (ISSUE 4: in-scan health plane)
+from .chaos import ChaosSchedule  # noqa: F401

@@ -1,0 +1,354 @@
+"""The compiled chaos plane (ISSUE 4 tentpole) — fault SCHEDULES as data,
+applied by in-scan arithmetic on BOTH execution paths.
+
+``verify/faults.py`` rebuilt the reference's fault machinery
+(test/prop_partisan_crash_fault_model.erl crash/omission interposition,
+the hyparview partition flood :1731-1797) as host-driven mutations: the
+harness stops the scan, edits ``world.alive``/``world.partition`` or
+installs an interposition fun, and resumes.  That shape cannot run at
+scan speed, and the sharded dataplane (parallel/dataplane.py) cannot
+host per-round Python at all.  This module compiles the whole campaign
+instead:
+
+  * :class:`ChaosSchedule` — a STATIC ``[n_events, 5]`` int32 table of
+    ``(round, kind, a, b, c)`` events, baked into the jitted step as a
+    compile-time constant (the registry enable-mask pattern: swapping
+    schedules recompiles, running one costs fused elementwise masks).
+  * :func:`apply_chaos_nodes` — the node plane: crash / recover /
+    partition / heal events rewrite the ``alive``/``partition`` vectors
+    at the top of the round.  Events apply in table order (later rows
+    win ties), so a schedule is replayable and order-unambiguous.
+  * :func:`apply_chaos_msgs` — the message plane: drop-matching /
+    delay-matching / duplicate events edit the ready buffer right after
+    the held split — BEFORE the alive/partition masks, which is the one
+    point both execution paths see the message on its src's shard (the
+    dataplane residency invariant).  Delayed messages re-hold exactly
+    like the engine's '$delay' recv split; duplicates append a copy to
+    the held buffer with their own delivery delay.  Every edit is
+    counted (``chaos_dropped`` / ``chaos_delayed`` /
+    ``chaos_duplicated`` step metrics), never silent (SURVEY §7.3).
+
+Both ``engine.make_step(chaos=)`` and
+``parallel/dataplane.make_sharded_step(chaos=)`` consume the same
+schedule: the planes are pure row/slot-local arithmetic (the node plane
+reads only this shard's rows via their GLOBAL ids; the message plane
+reads only message fields), so the sharded round adds ZERO collectives
+— the asserted 2-collective budget holds chaos-on — and the two paths
+stay bit-identical in states and metrics (tests/test_dataplane.py
+TestChaosFaultParity).
+
+This is the reference's fault-injection surface
+(``partisan_trace_orchestrator.erl`` held-sender schedules, the
+filibuster omission schedules, crash_fault_model interposition) with
+the orchestrator compiled away: a campaign is rows in a table, and
+``scripts/chaos_soak.py`` sweeps seed x fault-mix matrices of them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.msg import Msgs
+from ..ops import msg as msgops
+
+# event kinds, column 1 of the table
+KIND_CRASH = 0      # nodes [a, b] crash-stop                   (c unused)
+KIND_RECOVER = 1    # nodes [a, b] come back                    (c unused)
+KIND_PARTITION = 2  # nodes [a, b] take partition id c (>= 1)
+KIND_HEAL = 3       # nodes [a, b] back to partition 0; a < 0 = everyone
+KIND_DROP = 4       # msgs src=a dst=b (-1 wildcard) dropped for c rounds
+KIND_DELAY = 5      # msgs src=a dst=b delayed +c rounds (this round only)
+KIND_DUP = 6        # msgs src=a dst=b duplicated, copy lands +c rounds
+
+KIND_NAMES = ("crash", "recover", "partition", "heal", "drop", "delay",
+              "duplicate")
+_NODE_KINDS = (KIND_CRASH, KIND_RECOVER, KIND_PARTITION, KIND_HEAL)
+_MSG_KINDS = (KIND_DROP, KIND_DELAY, KIND_DUP)
+N_COLS = 5
+
+
+def _rng(nodes) -> Tuple[int, int]:
+    """Normalize a node spec: int -> (n, n), (lo, hi) -> inclusive range."""
+    if isinstance(nodes, (tuple, list)):
+        lo, hi = int(nodes[0]), int(nodes[1])
+    else:
+        lo = hi = int(nodes)
+    if 0 <= lo <= hi:
+        return lo, hi
+    raise ValueError(f"bad node range {nodes!r}: need 0 <= lo <= hi")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSchedule:
+    """An immutable, hashable event table.  Build fluently::
+
+        sched = (ChaosSchedule()
+                 .crash(10, (3, 6))          # nodes 3..6 die at round 10
+                 .partition(15, (0, 31), 1)  # two halves at round 15
+                 .partition(15, (32, 63), 2)
+                 .drop(18, src=-1, dst=7, rounds=4)
+                 .delay(20, src=3, extra=2)
+                 .duplicate(22, copy_delay=1)
+                 .heal(30)                   # partitions resolve
+                 .recover(32, (3, 6)))       # crashed nodes return
+
+    Each builder returns a NEW schedule (frozen dataclass over a tuple),
+    so a schedule is a valid jit closure constant and dict key.
+    """
+
+    events: Tuple[Tuple[int, int, int, int, int], ...] = ()
+
+    # ------------------------------------------------------------ builders
+
+    def _add(self, rnd: int, kind: int, a: int, b: int,
+             c: int) -> "ChaosSchedule":
+        if rnd < 0:
+            raise ValueError(f"event round must be >= 0, got {rnd}")
+        return ChaosSchedule(self.events
+                             + ((int(rnd), int(kind), int(a), int(b),
+                                 int(c)),))
+
+    def crash(self, rnd: int, nodes) -> "ChaosSchedule":
+        lo, hi = _rng(nodes)
+        return self._add(rnd, KIND_CRASH, lo, hi, 0)
+
+    def recover(self, rnd: int, nodes) -> "ChaosSchedule":
+        lo, hi = _rng(nodes)
+        return self._add(rnd, KIND_RECOVER, lo, hi, 0)
+
+    def partition(self, rnd: int, nodes, gid: int) -> "ChaosSchedule":
+        if gid < 1:
+            raise ValueError(f"partition id must be >= 1, got {gid}")
+        lo, hi = _rng(nodes)
+        return self._add(rnd, KIND_PARTITION, lo, hi, gid)
+
+    def heal(self, rnd: int, nodes=None) -> "ChaosSchedule":
+        if nodes is None:
+            return self._add(rnd, KIND_HEAL, -1, -1, 0)
+        lo, hi = _rng(nodes)
+        return self._add(rnd, KIND_HEAL, lo, hi, 0)
+
+    def drop(self, rnd: int, src: int = -1, dst: int = -1,
+             rounds: int = 1) -> "ChaosSchedule":
+        if rounds < 1:
+            raise ValueError(f"drop window must be >= 1 rounds, got {rounds}")
+        return self._add(rnd, KIND_DROP, src, dst, rounds)
+
+    def delay(self, rnd: int, src: int = -1, dst: int = -1,
+              extra: int = 1) -> "ChaosSchedule":
+        if extra < 1:
+            raise ValueError(f"delay must be >= 1 rounds, got {extra}")
+        return self._add(rnd, KIND_DELAY, src, dst, extra)
+
+    def duplicate(self, rnd: int, src: int = -1, dst: int = -1,
+                  copy_delay: int = 1) -> "ChaosSchedule":
+        if copy_delay < 1:
+            raise ValueError(
+                f"duplicate copy_delay must be >= 1, got {copy_delay}")
+        return self._add(rnd, KIND_DUP, src, dst, copy_delay)
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def n_events(self) -> int:
+        return len(self.events)
+
+    def table(self) -> np.ndarray:
+        """The [n_events, 5] int32 host table (empty -> [0, 5])."""
+        if not self.events:
+            return np.zeros((0, N_COLS), np.int32)
+        return np.asarray(self.events, np.int32)
+
+    def _kinds(self, kinds) -> Tuple[Tuple[int, ...], ...]:
+        return tuple(e for e in self.events if e[1] in kinds)
+
+    @property
+    def has_node_events(self) -> bool:
+        return bool(self._kinds(_NODE_KINDS))
+
+    @property
+    def has_drop(self) -> bool:
+        return bool(self._kinds((KIND_DROP,)))
+
+    @property
+    def has_delay(self) -> bool:
+        return bool(self._kinds((KIND_DELAY,)))
+
+    @property
+    def has_dup(self) -> bool:
+        return bool(self._kinds((KIND_DUP,)))
+
+    @property
+    def has_msg_events(self) -> bool:
+        return self.has_drop or self.has_delay or self.has_dup
+
+    def last_heal_round(self) -> int:
+        """The round after which no injected disruption remains standing:
+        the max over heal/recover event rounds and drop-window ends (the
+        soak's convergence-after-heal anchor).  -1 when the schedule
+        never disrupts (or never heals what it broke — a schedule that
+        crashes without recovering reports the crash round so the soak
+        measures from the last state change)."""
+        ends = [-1]
+        for rnd, kind, _a, _b, c in self.events:
+            if kind in (KIND_HEAL, KIND_RECOVER, KIND_CRASH,
+                        KIND_PARTITION):
+                ends.append(rnd)
+            elif kind == KIND_DROP:
+                ends.append(rnd + max(c, 1) - 1)
+            else:
+                ends.append(rnd)
+        return max(ends)
+
+    def disruptive_rounds(self) -> np.ndarray:
+        """Rounds at which a crash or partition event fires — the
+        quiesce window anchors of :func:`quiesce_resub`."""
+        rr = [e[0] for e in self.events
+              if e[1] in (KIND_CRASH, KIND_PARTITION)]
+        return np.asarray(sorted(set(rr)), np.int32)
+
+
+# --------------------------------------------------------------- node plane
+
+def apply_chaos_nodes(sched: ChaosSchedule, rnd: jax.Array,
+                      alive: jax.Array, partition: jax.Array,
+                      node_ids: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Fold this round's crash/recover/partition/heal events into the
+    fault-plane vectors.  ``node_ids`` carries GLOBAL ids, so under the
+    sharded dataplane each shard folds the same table over its own row
+    slice — pure local arithmetic, zero collectives, bit-identical to
+    the global fold restricted to those rows.
+
+    The event loop unrolls over the static table (schedules are small);
+    events apply in table order, so a later row overrides an earlier one
+    in the same round (e.g. partition-then-heal is a no-op round).
+    """
+    for ev_rnd, kind, a, b, c in sched._kinds(_NODE_KINDS):
+        fire = rnd == ev_rnd
+        if a < 0:
+            in_rng = jnp.ones_like(node_ids, dtype=bool)
+        else:
+            in_rng = (node_ids >= a) & (node_ids <= b)
+        hit = fire & in_rng
+        if kind == KIND_CRASH:
+            alive = alive & ~hit
+        elif kind == KIND_RECOVER:
+            alive = alive | hit
+        elif kind == KIND_PARTITION:
+            partition = jnp.where(hit, jnp.int32(c), partition)
+        else:  # KIND_HEAL
+            partition = jnp.where(hit, jnp.int32(0), partition)
+    return alive, partition
+
+
+# ------------------------------------------------------------ message plane
+
+def _match(m: Msgs, src: int, dst: int) -> jax.Array:
+    hit = m.valid
+    if src >= 0:
+        hit = hit & (m.src == src)
+    if dst >= 0:
+        hit = hit & (m.dst == dst)
+    return hit
+
+
+def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
+    """Apply drop / delay / duplicate events to the READY buffer (post
+    held-split, pre fault-plane — the point where both execution paths
+    still hold every message on its src's shard).  Returns
+    ``(now, extra_held, counts)``:
+
+      * ``now`` with dropped and re-held slots invalidated;
+      * ``extra_held`` — a flat buffer of chaos-delayed re-holds and
+        duplicate copies for the caller to concat into its held traffic
+        (``None`` when the schedule has no delay/dup events, so the
+        carry shape is unchanged — program shape depends only on the
+        static schedule);
+      * ``counts`` — ``{"chaos_dropped", "chaos_delayed",
+        "chaos_duplicated"}`` int32 scalars over THIS buffer (the
+        sharded step psums them; the totals match the unsharded run).
+
+    Order inside the plane: drops first, then delays on the survivors,
+    then duplication of the remaining ready slots — one deterministic
+    pipeline, identical on both paths.
+    """
+    zero = jnp.int32(0)
+    counts = {"chaos_dropped": zero, "chaos_delayed": zero,
+              "chaos_duplicated": zero}
+    if not sched.has_msg_events:
+        return now, None, counts
+
+    if sched.has_drop:
+        drop = jnp.zeros((now.cap,), bool)
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_DROP,)):
+            active = (rnd >= ev_rnd) & (rnd < ev_rnd + max(c, 1))
+            drop = drop | (_match(now, a, b) & active)
+        counts["chaos_dropped"] = jnp.sum(drop).astype(jnp.int32)
+        now = now.replace(valid=now.valid & ~drop)
+
+    parts = []
+    if sched.has_delay:
+        bump = jnp.zeros((now.cap,), jnp.int32)
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_DELAY,)):
+            hit = _match(now, a, b) & (rnd == ev_rnd)
+            bump = jnp.maximum(bump, jnp.where(hit, jnp.int32(c), 0))
+        delayed = now.replace(delay=now.delay + bump)
+        # the '$delay' re-hold split, exactly the engine's recv-side
+        # shape: held copies age one round immediately (the next round's
+        # held split would otherwise double-count this round)
+        re_held = delayed.replace(
+            valid=delayed.valid & (delayed.delay > 0),
+            delay=jnp.maximum(delayed.delay - 1, 0))
+        counts["chaos_delayed"] = jnp.sum(re_held.valid).astype(jnp.int32)
+        now = delayed.replace(valid=delayed.valid & (delayed.delay <= 0))
+        parts.append(re_held)
+
+    if sched.has_dup:
+        cdel = jnp.full((now.cap,), -1, jnp.int32)
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_DUP,)):
+            hit = _match(now, a, b) & (rnd == ev_rnd)
+            cdel = jnp.maximum(cdel, jnp.where(hit, jnp.int32(max(c, 1)),
+                                               -1))
+        copy = now.replace(valid=now.valid & (cdel >= 0),
+                           delay=jnp.maximum(cdel - 1, 0))
+        counts["chaos_duplicated"] = jnp.sum(copy.valid).astype(jnp.int32)
+        parts.append(copy)
+
+    if not parts:
+        return now, None, counts
+    extra_held = msgops.concat(*parts) if len(parts) > 1 else parts[0]
+    return now, extra_held, counts
+
+
+# ----------------------------------------------------- resubscribe policy
+
+def quiesce_resub(sched: ChaosSchedule, margin: int = 2):
+    """Chaos-aware isolation-resubscribe policy for the dense models
+    (``hyparview_dense.make_dense_round(resub_policy=)`` /
+    ``scamp_dense.make_dense_scamp_round(resub_policy=)``): suppress the
+    re-subscribe for ``margin`` rounds starting at each crash/partition
+    event.  A node isolated BY the event would otherwise fire a join
+    storm into an overlay that is mid-disruption (walks into crashed
+    contacts, subscriptions across a partition boundary) — the
+    reference's own isolation detection waits out a silence window
+    before re-subscribing (scamp_v2 :130-178).  Pure table arithmetic:
+    jit-safe, zero collectives, and the all-clear schedule folds to the
+    identity policy."""
+    if margin < 1:
+        raise ValueError(f"margin must be >= 1, got {margin}")
+    rr = sched.disruptive_rounds()
+
+    def policy(lonely: jax.Array, rnd: jax.Array) -> jax.Array:
+        if rr.size == 0:
+            return jnp.ones_like(lonely)
+        r = jnp.asarray(rr)
+        quiet = jnp.any((rnd >= r) & (rnd < r + margin))
+        return jnp.broadcast_to(~quiet, lonely.shape)
+
+    return policy

@@ -1,0 +1,188 @@
+"""The in-scan health plane (ISSUE 4) — self-healing monitors recorded
+into the PR-1 metrics ring.
+
+``metrics.connectivity`` is the faithful check (all-pairs reachability,
+the digraph membership check of test/partisan_SUITE.erl:2044-2109) but
+costs O(N^2 log N) — a health PROBE, not an every-round-in-scan cost.
+This module provides the scan-speed proxies a chaos soak needs to watch
+an overlay break and re-knit:
+
+  * :func:`reach_fraction` — bounded frontier BFS over the padded views
+    (the dense models' ``bounded_bfs`` expansion shape: per hop one
+    scatter along each view edge and one gather back, O(hops * N * C)),
+    from the first alive node.  ``1.0`` PROVES the alive subgraph is
+    connected (undirected closure); ``< 1.0`` means disconnected OR
+    diameter > hops — conservative in exactly the direction a
+    convergence assertion needs.
+  * :func:`view_fill` — mean occupied view-slot fraction over alive
+    rows (the view-starvation signal; HyParView health is "views full",
+    hyparview_membership_check).
+  * ``isolated`` / ``inflight`` ride the existing registry metrics; the
+    inflight WATERMARK is a host fold over flushed rows
+    (:func:`inflight_watermark`) — a running max has no business
+    costing ring state.
+
+:func:`health_registry` appends the health, chaos-plane and QoS-ring
+metric specs to the default registry so
+``telemetry.run_with_telemetry(registry=health_registry(), ...)``
+records the whole plane with the standard one-transfer-per-window ring;
+``telemetry.runner.collect_round_metrics`` wires the collectors (and
+the ``ProtocolBase.health_counters`` tap that surfaces the qos ack-ring
+overflow / dead-letter counters) whenever these names are present.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry.registry import (COUNTER, GAUGE, MetricRegistry,
+                                  MetricSpec, default_registry)
+
+# Gauges, not counters: the chaos metrics are per-round counts (counter
+# semantics) but the qos-ring taps are CUMULATIVE device counters — a
+# Prometheus sink accumulates counter rows as deltas, which would
+# double-count a cumulative series, so cumulative taps export as gauges.
+HEALTH_SPECS = (
+    MetricSpec("health_reach_frac", GAUGE,
+               "Fraction of alive nodes reached from the first alive "
+               "root by the bounded frontier BFS over the padded views "
+               "(1.0 proves the alive overlay is connected)."),
+    MetricSpec("health_view_fill", GAUGE,
+               "Mean occupied view-slot fraction over alive nodes."),
+)
+
+CHAOS_SPECS = (
+    MetricSpec("chaos_dropped", COUNTER,
+               "Messages dropped by chaos-plane drop events this round."),
+    MetricSpec("chaos_delayed", COUNTER,
+               "Messages re-held by chaos-plane delay events this round."),
+    MetricSpec("chaos_duplicated", COUNTER,
+               "Duplicate copies injected by chaos-plane events this "
+               "round."),
+)
+
+QOS_SPECS = (
+    MetricSpec("ack_outstanding", GAUGE,
+               "Unacked slots across all outstanding rings."),
+    MetricSpec("ack_send_dropped", GAUGE,
+               "Cumulative sends lost to a full outstanding ring."),
+    MetricSpec("ack_dead_lettered", GAUGE,
+               "Cumulative slots abandoned by retransmission give-up "
+               "(backoff max_attempts exhausted)."),
+    MetricSpec("fwd_send_dropped", GAUGE,
+               "Cumulative DataPlane acked sends lost to a full ring."),
+    MetricSpec("fwd_dead_lettered", GAUGE,
+               "Cumulative DataPlane outstanding slots dead-lettered by "
+               "retransmission give-up."),
+    MetricSpec("relay_expired", GAUGE,
+               "Cumulative relays dropped at TTL 0 / no next hop."),
+)
+
+
+def health_registry(extra: Sequence[MetricSpec] = (),
+                    disabled: Optional[Iterable[str]] = None
+                    ) -> MetricRegistry:
+    """The default registry + health + chaos + qos specs (the chaos
+    soak's ring layout).  ``disabled`` behaves like
+    ``default_registry``'s (None keeps the default off-set)."""
+    reg = default_registry(disabled)
+    return reg.with_specs(HEALTH_SPECS + CHAOS_SPECS + QOS_SPECS
+                          + tuple(extra))
+
+
+def default_hops(n: int) -> int:
+    """Default BFS hop budget: gossip overlays have O(log N) diameter;
+    2*log2 + 4 covers the post-heal re-knit transient without paying a
+    diameter-N worst case every round."""
+    return int(2 * np.ceil(np.log2(max(n, 2)))) + 4
+
+
+def reach_mask(views: jax.Array, alive: jax.Array,
+               hops: Optional[int] = None,
+               partition: Optional[jax.Array] = None) -> jax.Array:
+    """[N] bool — alive nodes reached from the first alive node within
+    ``hops`` frontier expansions of the UNDIRECTED view graph.  Each hop
+    is one scatter (row -> its view members) plus one gather (row <- any
+    reached member), so cost is O(hops * N * C) — in-scan safe, no
+    [N, N] adjacency ever materializes.  ``partition`` (the world's
+    fault-plane vector) additionally severs cross-partition edges, so a
+    standing partition reads as disconnected even while stale views
+    still list peers across the boundary — EFFECTIVE connectivity, the
+    signal a chaos soak watches."""
+    n, c = views.shape
+    hops = default_hops(n) if hops is None else hops
+    vc = jnp.clip(views, 0, n - 1)
+    vok = (views >= 0) & alive[:, None] & alive[vc]
+    if partition is not None:
+        vok = vok & (partition[:, None] == partition[vc])
+    root = jnp.argmax(alive)          # first alive node (0 if none)
+    reached0 = (jnp.arange(n) == root) & alive
+
+    def body(_, reached):
+        fwd = jnp.zeros((n,), bool).at[vc].max(reached[:, None] & vok)
+        rev = jnp.any(reached[vc] & vok, axis=1)
+        return (reached | fwd | rev) & alive
+
+    return jax.lax.fori_loop(0, hops, body, reached0)
+
+
+def reach_fraction(views: jax.Array, alive: jax.Array,
+                   hops: Optional[int] = None,
+                   partition: Optional[jax.Array] = None) -> jax.Array:
+    """Scalar float32 in [0, 1]; 1.0 proves connectivity of the alive
+    subgraph (sufficient, not necessary, when diameter > hops)."""
+    reached = reach_mask(views, alive, hops, partition)
+    return (jnp.sum(reached) / jnp.maximum(jnp.sum(alive), 1)
+            ).astype(jnp.float32)
+
+
+def view_fill(views: jax.Array, alive: jax.Array) -> jax.Array:
+    """Scalar float32 — mean occupied view-slot fraction over alive
+    rows (0 when nobody is alive)."""
+    frac = jnp.sum(views >= 0, axis=1) / views.shape[1]
+    return (jnp.sum(jnp.where(alive, frac, 0.0))
+            / jnp.maximum(jnp.sum(alive), 1)).astype(jnp.float32)
+
+
+def collect_health_views(views: jax.Array, alive: jax.Array,
+                         hops: Optional[int] = None,
+                         partition: Optional[jax.Array] = None
+                         ) -> Dict[str, jax.Array]:
+    """The device-side health collectors keyed by registry names (the
+    runner calls this when ``health_reach_frac`` is in the registry)."""
+    return {
+        "health_reach_frac": reach_fraction(views, alive, hops,
+                                            partition),
+        "health_view_fill": view_fill(views, alive),
+    }
+
+
+# ------------------------------------------------------------ host folds
+
+def inflight_watermark(rows: Sequence[Dict[str, float]]) -> float:
+    """Host fold over flushed ring rows: the in-flight buffer occupancy
+    high-water mark (the queue-depth instrumentation analog, pluggable
+    :875-879, folded instead of carried)."""
+    return max((r.get("inflight", 0.0) for r in rows), default=0.0)
+
+
+def converged_round(rows: Sequence[Dict[str, float]], after: int,
+                    key: str = "health_reach_frac") -> Optional[int]:
+    """First round > ``after`` from which ``key`` stays 1.0 through the
+    END of the recorded rows (a momentary reconnect that re-splits does
+    not count).  None if never."""
+    cand: Optional[int] = None
+    for r in rows:
+        rnd = int(r.get("round", -1))
+        if rnd <= after:
+            continue
+        if r.get(key, 0.0) >= 1.0:
+            if cand is None:
+                cand = rnd
+        else:
+            cand = None
+    return cand

@@ -1,0 +1,255 @@
+"""Chaos campaign runner (ISSUE 4) — the seed x fault-mix matrix that
+closes the chaos-plane loop.
+
+Each CELL compiles one :class:`verify.chaos.ChaosSchedule` into the
+engine round (``make_step(chaos=)``), runs it under the in-scan health
+plane (``verify.health.health_registry`` through the PR-1 telemetry
+ring) with the PR-3 flight recorder armed, and asserts
+**convergence-after-heal**: the partition-aware connectivity proxy
+(``health_reach_frac``) must return to 1.0 within ``--heal-margin``
+rounds of the schedule's last heal/recover event and STAY there to the
+end of the run.  Every cell appends one JSONL row to ``BENCH_chaos.jsonl``
+(seed, mix, chaos counters, watermark, converged round, verdict); a
+failing cell additionally dumps a flight-recorder POSTMORTEM — the last
+recorded window's wire trace (``verify.trace.write_trace`` format) —
+and records its path in the row.
+
+This is the fault-injection analog of the reference's
+``partisan_trace_orchestrator`` + crash_fault_model campaigns
+(prop_partisan), with the orchestrator compiled away: fault schedules
+are data, the health monitors run in-scan, and the soak only touches
+the host once per window.
+
+Usage:
+    python scripts/chaos_soak.py                      # full campaign
+        [--n 4096] [--rounds 160] [--window 32]
+        [--seeds 1,2,3,4] [--mixes crash_recover,partition_heal,lossy_combo]
+        [--heal-margin 60] [--out BENCH_chaos.jsonl]
+        [--flight-cap 2048] [--postmortem-dir /tmp]
+    python scripts/chaos_soak.py --smoke              # one tiny cell
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU verify path (the real chip stays free for bench.py) — the same
+# env + config dance as suite_matrix.py / tests/conftest.py
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import peer_service as ps  # noqa: E402
+from partisan_tpu import telemetry  # noqa: E402
+from partisan_tpu.models.hyparview import HyParView  # noqa: E402
+from partisan_tpu.telemetry.flight import FlightSpec, flight_entries  # noqa: E402
+from partisan_tpu.verify import trace as trace_mod  # noqa: E402
+from partisan_tpu.verify.chaos import ChaosSchedule  # noqa: E402
+from partisan_tpu.verify import health  # noqa: E402
+
+
+# ------------------------------------------------------------- fault mixes
+#
+# Each mix maps (n, rounds) -> ChaosSchedule.  Events scale with the
+# run: disruption in the first half, heal/recover by ~60%, leaving the
+# tail to re-knit.  Mixes are seed-independent (the seed varies the
+# PROTOCOL trajectory; the schedule is the controlled variable).
+
+def _mix_crash_recover(n: int, rounds: int) -> ChaosSchedule:
+    """Crash 1/8 of the cluster mid-bootstrap, recover later."""
+    q = rounds // 4
+    lo, hi = n // 4, n // 4 + n // 8 - 1
+    return (ChaosSchedule()
+            .crash(q, (lo, hi))
+            .recover(2 * q + q // 2, (lo, hi)))
+
+
+def _mix_partition_heal(n: int, rounds: int) -> ChaosSchedule:
+    """Split the cluster into halves, heal at ~60%."""
+    q = rounds // 4
+    return (ChaosSchedule()
+            .partition(q, (0, n // 2 - 1), 1)
+            .partition(q, (n // 2, n - 1), 2)
+            .heal(2 * q + q // 2))
+
+
+def _mix_lossy_combo(n: int, rounds: int) -> ChaosSchedule:
+    """Everything at once: a crashed block inside a partitioned half,
+    a lossy window, delays and duplication — the kitchen-sink cell."""
+    q = rounds // 4
+    return (ChaosSchedule()
+            .partition(q, (0, n // 2 - 1), 1)
+            .partition(q, (n // 2, n - 1), 2)
+            .crash(q + 2, (n // 8, n // 8 + n // 16 - 1))
+            .drop(q + 4, dst=7, rounds=q)          # one victim's inbox
+            .delay(q + 6, src=3, extra=2)
+            .duplicate(q + 8, copy_delay=1)
+            .heal(2 * q + q // 2)
+            .recover(2 * q + q // 2 + 2,
+                     (n // 8, n // 8 + n // 16 - 1)))
+
+
+MIXES = {
+    "crash_recover": _mix_crash_recover,
+    "partition_heal": _mix_partition_heal,
+    "lossy_combo": _mix_lossy_combo,
+}
+
+
+class _Rows:
+    """Sink capturing ring rows on the host (per-cell, bounded)."""
+
+    def __init__(self):
+        self.rows = []
+
+    def write_row(self, row):
+        self.rows.append(row)
+
+    def close(self):
+        pass
+
+
+def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
+             heal_margin: int, flight_cap: int, postmortem_dir: str,
+             shuffle_interval: int = 5) -> dict:
+    """Run one (seed, mix) cell; returns its JSONL row (a plain dict)."""
+    sched = MIXES[mix](n, rounds)
+    heal_rnd = sched.last_heal_round()
+    cfg = pt.Config(n_nodes=n, inbox_cap=16,
+                    shuffle_interval=shuffle_interval, seed=seed)
+    proto = HyParView(cfg)
+    # binary-tree contacts spread the join storm (each contact takes at
+    # most 2 joins) so the overlay is connected within a few rounds even
+    # at N=4096 — a chain + trickle bootstrap would still be injecting
+    # joins when the chaos events fire (scripts/bench_telemetry.py uses
+    # the same shape)
+    world = ps.cluster(pt.init_world(cfg, proto), proto,
+                       [(i, (i - 1) // 2) for i in range(1, n)])
+    registry = health.health_registry()
+    sink = _Rows()
+    last_window = {"entries": None}
+
+    def on_flight(entries):
+        last_window["entries"] = entries  # keep only the latest window
+
+    t0 = time.perf_counter()
+    world, timeline = telemetry.run_with_telemetry(
+        cfg, proto, rounds, window=window, registry=registry,
+        sinks=[sink], world=world,
+        flight=FlightSpec(window=window, cap=flight_cap),
+        on_flight=on_flight,
+        step_kw={"chaos": sched})
+    dt = time.perf_counter() - t0
+
+    rows = [r for r in sink.rows if "health_reach_frac" in r]
+    conv = health.converged_round(rows, after=heal_rnd)
+    ok = conv is not None and (conv - heal_rnd) <= heal_margin
+    row = {
+        "bench": "chaos_soak",
+        "mix": mix,
+        "seed": seed,
+        "n_nodes": n,
+        "rounds": rounds,
+        "heal_round": heal_rnd,
+        "converged_round": conv,
+        "heal_margin": heal_margin,
+        "converged": bool(ok),
+        "final_reach_frac": rows[-1]["health_reach_frac"] if rows else None,
+        "final_alive": rows[-1]["alive"] if rows else None,
+        "chaos_dropped": sum(r.get("chaos_dropped", 0) for r in rows),
+        "chaos_delayed": sum(r.get("chaos_delayed", 0) for r in rows),
+        "chaos_duplicated": sum(r.get("chaos_duplicated", 0)
+                                for r in rows),
+        "fault_dropped": sum(r.get("fault_dropped", 0) for r in rows),
+        "inflight_watermark": health.inflight_watermark(rows),
+        "wall_s": round(dt, 2),
+        "rounds_per_sec": round(rounds / dt, 2) if dt > 0 else None,
+        "postmortem": None,
+    }
+    if not ok:
+        # flight-recorder postmortem: the last window's wire trace in
+        # the verify.trace dump format (replayable through the model
+        # checker / drop-schedule machinery) + the health tail
+        os.makedirs(postmortem_dir, exist_ok=True)
+        base = os.path.join(postmortem_dir,
+                            f"chaos_postmortem_{mix}_s{seed}_n{n}")
+        trace_path = base + ".trace"
+        trace_mod.write_trace(trace_path, last_window["entries"] or [])
+        with open(base + ".health.jsonl", "w") as f:
+            for r in rows[-2 * window:]:
+                f.write(json.dumps(r) + "\n")
+        row["postmortem"] = trace_path
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--rounds", type=int, default=160)
+    ap.add_argument("--window", type=int, default=32)
+    ap.add_argument("--seeds", default="1,2,3,4")
+    ap.add_argument("--mixes", default=",".join(MIXES))
+    ap.add_argument("--heal-margin", type=int, default=60)
+    ap.add_argument("--out", default="BENCH_chaos.jsonl")
+    ap.add_argument("--flight-cap", type=int, default=2048)
+    ap.add_argument("--postmortem-dir", default="/tmp")
+    ap.add_argument("--smoke", action="store_true",
+                    help="one tiny cell (n=64, 1 seed, lossy_combo) — "
+                         "the tier-1 smoke configuration")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.n, args.rounds, args.window = 64, 60, 20
+        args.seeds, args.mixes = "1", "lossy_combo"
+        args.heal_margin = 25
+
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+    mixes = [m for m in args.mixes.split(",") if m]
+    for m in mixes:
+        if m not in MIXES:
+            ap.error(f"unknown mix {m!r}; have {sorted(MIXES)}")
+
+    failures = 0
+    rows = []
+    for mix in mixes:
+        for seed in seeds:
+            row = run_cell(n=args.n, rounds=args.rounds, seed=seed,
+                           mix=mix, window=args.window,
+                           heal_margin=args.heal_margin,
+                           flight_cap=args.flight_cap,
+                           postmortem_dir=args.postmortem_dir)
+            rows.append(row)
+            verdict = "PASS" if row["converged"] else "FAIL"
+            print(f"{verdict} {mix} seed={seed}: heal@{row['heal_round']}"
+                  f" converged@{row['converged_round']}"
+                  f" ({row['rounds_per_sec']} r/s,"
+                  f" dropped={row['chaos_dropped']},"
+                  f" watermark={row['inflight_watermark']:.0f}"
+                  + (f", postmortem={row['postmortem']}"
+                     if row["postmortem"] else "") + ")")
+            if not row["converged"]:
+                failures += 1
+    with open(args.out, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"\n{len(rows)} cells -> {args.out}; {failures} failed "
+          f"convergence-after-heal")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -650,6 +650,75 @@ def dataplane_flight_telemetry_test():
     assert sorted(map(key, got)) == sorted(map(key, rec.entries))
 
 
+def chaos_parity_test():
+    """ISSUE 4 tentpole contract: the SAME compiled ChaosSchedule
+    (crash + partition + heal + recover mid-run, plus message-level
+    drop/delay/duplicate events) over HyParView through the shard_map
+    dataplane bit-matches the unsharded chaos run — states, fault
+    planes, metrics AND the chaos counters — with the 2-collective
+    budget unchanged."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel import make_mesh
+    from partisan_tpu.parallel.dataplane import (
+        make_sharded_step, place_sharded_world, sharded_out_cap)
+    from partisan_tpu.parallel.mesh import assert_collective_budget
+    from partisan_tpu.verify.chaos import ChaosSchedule
+    n, rounds = 64, 30
+    sched = (ChaosSchedule()
+             .crash(8, (3, 6))
+             .partition(12, (0, 31), 1).partition(12, (32, 63), 2)
+             .drop(14, dst=7, rounds=3)
+             .delay(16, src=2, extra=2)
+             .duplicate(18, copy_delay=1)
+             .heal(22)
+             .recover(24, (3, 6)))
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    mesh = make_mesh(n_devices=8)
+    pairs = [(i, i - 1) for i in range(1, n)]
+    w = ps.cluster(pt.init_world(cfg, proto), proto, pairs, stagger=16)
+    step = pt.make_step(cfg, proto, donate=False, chaos=sched)
+    w2 = ps.cluster(
+        pt.init_world(cfg, proto,
+                      out_cap=sharded_out_cap(cfg, proto, 8)),
+        proto, pairs, stagger=16)
+    w2 = place_sharded_world(w2, cfg, mesh)
+    sstep = make_sharded_step(cfg, proto, mesh, donate=False,
+                              chaos=sched)
+    st = assert_collective_budget(
+        sstep.lower(w2).compile(), max_collectives=2,
+        max_bytes=32 * 1024 * 1024, forbid=("all-gather",))
+    assert st["counts"]["all-to-all"] == 1
+    for _ in range(rounds):
+        w, mp = step(w)
+        w2, msh = sstep(w2)
+        assert all(int(msh[k]) == int(v) for k, v in mp.items()), \
+            (mp, msh)
+    for lp, lsh in zip(jax.tree_util.tree_leaves((w.state, w.alive,
+                                                  w.partition)),
+                       jax.tree_util.tree_leaves((w2.state, w2.alive,
+                                                  w2.partition))):
+        assert (np.asarray(lp) == np.asarray(lsh)).all()
+
+
+def chaos_soak_smoke():
+    """ISSUE 4 campaign smoke: one tiny chaos_soak cell (lossy_combo,
+    N=64) must report convergence-after-heal and write its JSONL row."""
+    import importlib.util
+    import tempfile
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "chaos_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    with tempfile.TemporaryDirectory() as td:
+        row = soak.run_cell(n=64, rounds=60, seed=1, mix="lossy_combo",
+                            window=20, heal_margin=25, flight_cap=2048,
+                            postmortem_dir=td)
+        assert row["converged"], row
+        assert row["postmortem"] is None, row
+
+
 def performance_test():
     """performance_test (:1029): the echo harness completes its streams
     (the full swept numbers live in scripts/perf_suite.py ->
@@ -1204,6 +1273,14 @@ def build_matrix():
         "hyparview", "engine", flight_recorder_parity_test)
     add("observability/flight", "dataplane_flight_telemetry_test",
         "hyparview", "engine", dataplane_flight_telemetry_test)
+
+    # ISSUE 4: the compiled chaos plane — sharded/unsharded fault
+    # parity under one schedule, and the campaign runner's smoke cell
+    # (full seed x mix campaigns live in scripts/chaos_soak.py)
+    add("robustness/chaos", "chaos_parity_test", "hyparview", "engine",
+        chaos_parity_test)
+    add("robustness/chaos", "chaos_soak_smoke", "hyparview", "engine",
+        chaos_soak_smoke)
 
     return M
 

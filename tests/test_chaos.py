@@ -1,0 +1,572 @@
+"""Chaos-plane tests (ISSUE 4): compiled fault schedules on the engine,
+the self-healing backoff retransmission leg, the in-scan health plane,
+shard-aware checkpointing and the campaign runner's smoke cell.
+
+The sharded-vs-unsharded fault PARITY contract lives in
+tests/test_dataplane.py (TestChaosFaultParity) next to the fault-free
+parity it extends."""
+
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import checkpoint, peer_service as ps, telemetry
+from partisan_tpu.models.full_membership import FullMembership
+from partisan_tpu.models.dataplane import DataPlane
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.stack import Stacked
+from partisan_tpu.qos import ack
+from partisan_tpu.qos.causal import CausalAcked
+from partisan_tpu.verify import ChaosSchedule, faults, health
+from partisan_tpu.verify.chaos import quiesce_resub
+
+pytestmark = pytest.mark.standard
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def leaves_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestSchedule:
+    def test_builders_validate(self):
+        s = ChaosSchedule()
+        with pytest.raises(ValueError, match="round"):
+            s.crash(-1, 0)
+        with pytest.raises(ValueError, match="partition id"):
+            s.partition(1, (0, 3), 0)
+        with pytest.raises(ValueError, match="node range"):
+            s.crash(1, (5, 2))
+        with pytest.raises(ValueError, match="delay"):
+            s.delay(1, extra=0)
+        with pytest.raises(ValueError, match="copy_delay"):
+            s.duplicate(1, copy_delay=0)
+        with pytest.raises(ValueError, match="window"):
+            s.drop(1, rounds=0)
+
+    def test_table_and_anchors(self):
+        s = (ChaosSchedule().crash(5, (1, 2)).drop(10, dst=3, rounds=4)
+             .heal(20).recover(22, 1))
+        assert s.table().shape == (4, 5)
+        assert s.n_events == 4
+        assert s.has_node_events and s.has_drop
+        assert not (s.has_delay or s.has_dup)
+        assert s.last_heal_round() == 22
+        assert list(s.disruptive_rounds()) == [5]
+        assert ChaosSchedule().last_heal_round() == -1
+        # frozen + hashable: a valid jit closure constant / dict key
+        assert hash(s) == hash(ChaosSchedule(s.events))
+
+    def test_quiesce_resub_mask(self):
+        sched = ChaosSchedule().crash(10, 3).partition(20, (0, 7), 1)
+        pol = quiesce_resub(sched, margin=3)
+        lonely = jnp.ones((4,), bool)
+        for rnd, keep in ((9, True), (10, False), (12, False),
+                          (13, True), (20, False), (23, True)):
+            assert bool(np.asarray(pol(lonely, jnp.int32(rnd)))[0]) \
+                == keep, rnd
+        # an event-free schedule folds to the identity policy
+        idle = quiesce_resub(ChaosSchedule().heal(5), margin=4)
+        assert bool(np.asarray(idle(lonely, jnp.int32(5)))[0])
+
+
+class TestNodePlane:
+    @pytest.mark.slow
+    def test_schedule_matches_host_driven_faults(self):
+        """A compiled crash/partition/heal/recover schedule reproduces
+        the host-driven verify.faults mutations bit-for-bit — same
+        states, same fault planes, same metrics, every round."""
+        n, rounds = 16, 30
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        pairs = [(i, 0) for i in range(1, n)]
+        sched = (ChaosSchedule().crash(6, (2, 3))
+                 .partition(10, (0, 7), 1).partition(10, (8, 15), 2)
+                 .heal(18).recover(20, (2, 3)))
+        wc = ps.cluster(pt.init_world(cfg, proto), proto, pairs)
+        wh = ps.cluster(pt.init_world(cfg, proto), proto, pairs)
+        cstep = pt.make_step(cfg, proto, donate=False, chaos=sched)
+        hstep = pt.make_step(cfg, proto, donate=False)
+        for r in range(rounds):
+            # host path: apply the same event before the round it fires
+            if r == 6:
+                wh = faults.crash(wh, [2, 3])
+            if r == 10:
+                wh = faults.inject_partition(
+                    wh, [list(range(8)), list(range(8, 16))])
+            if r == 18:
+                wh = faults.resolve_partition(wh)
+            if r == 20:
+                wh = faults.recover(wh, [2, 3])
+            wc, mc = cstep(wc)
+            wh, mh = hstep(wh)
+            assert {k: int(v) for k, v in mh.items()} \
+                == {k: int(v) for k, v in mc.items()
+                    if not k.startswith("chaos_")}, r
+        leaves_equal(wc.state, wh.state)
+        np.testing.assert_array_equal(np.asarray(wc.alive),
+                                      np.asarray(wh.alive))
+        np.testing.assert_array_equal(np.asarray(wc.partition),
+                                      np.asarray(wh.partition))
+
+
+class TestMsgPlane:
+    """Drop / delay / duplicate semantics over the DataPlane payload
+    path (the interposition_test premise with the schedule compiled)."""
+
+    def boot(self, sched):
+        cfg = pt.Config(n_nodes=4, inbox_cap=16, periodic_interval=2)
+        proto = Stacked(FullMembership(cfg), DataPlane(cfg))
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto, [(i, 0) for i in range(1, 4)])
+        step = pt.make_step(cfg, proto, donate=False, chaos=sched)
+        for _ in range(8):
+            world, _ = step(world)
+        return proto, world, step
+
+    def send(self, world, proto, **kw):
+        return ps.forward_message(world, proto, **kw)
+
+    def test_drop_matching(self):
+        # the fwd 0 -> 2 ships in round 8 (ctl hop) and would deliver in
+        # round 9 — the drop window eats it; 0 -> 3 is untouched
+        sched = ChaosSchedule().drop(9, src=0, dst=2, rounds=2)
+        proto, world, step = self.boot(sched)
+        world = self.send(world, proto, src=0, dst=2, server_ref=1,
+                          payload=[5])
+        world = self.send(world, proto, src=0, dst=3, server_ref=1,
+                          payload=[6])
+        dropped = 0
+        for _ in range(4):
+            world, m = step(world)
+            dropped += int(m["chaos_dropped"])
+        assert ps.receive_messages(world, proto, 2)[0] == []
+        assert ps.receive_messages(world, proto, 3)[0] \
+            == [(0, 1, [6, 0, 0, 0])]
+        assert dropped >= 1
+
+    def test_delay_matching(self):
+        sched = ChaosSchedule().delay(9, src=0, dst=2, extra=4)
+        proto, world, step = self.boot(sched)
+        world = self.send(world, proto, src=0, dst=2, server_ref=1,
+                          payload=[5])
+        delayed = 0
+        for _ in range(3):
+            world, m = step(world)
+            delayed += int(m["chaos_delayed"])
+        assert ps.receive_messages(world, proto, 2)[0] == []  # not yet
+        for _ in range(4):
+            world, _ = step(world)
+        assert ps.receive_messages(world, proto, 2)[0] \
+            == [(0, 1, [5, 0, 0, 0])]                         # ...late
+        # >= 1: the wildcard-typ match also re-holds same-edge
+        # membership gossip riding the 0 -> 2 connection that round
+        assert delayed >= 1
+
+    def test_duplicate_matching(self):
+        sched = ChaosSchedule().duplicate(9, src=0, dst=2, copy_delay=2)
+        proto, world, step = self.boot(sched)
+        world = self.send(world, proto, src=0, dst=2, server_ref=1,
+                          payload=[5])
+        dups = 0
+        for _ in range(6):
+            world, m = step(world)
+            dups += int(m["chaos_duplicated"])
+        recs, _, _ = ps.receive_messages(world, proto, 2)
+        assert recs == [(0, 1, [5, 0, 0, 0])] * 2  # original + copy
+        assert dups >= 1  # same-edge gossip duplicates too (wildcard typ)
+
+
+class TestBackoff:
+    def test_disabled_backoff_bit_equals_fixed_timer(self):
+        """factor=1, jitter=0, max_attempts=0 reduces retransmit_backoff
+        to exactly retransmit_due (the acceptance bit-equality)."""
+        rng = np.random.default_rng(7)
+        for _ in range(8):
+            valid = jnp.asarray(rng.random(8) < 0.6)
+            age = jnp.asarray(rng.integers(0, 6, 8), jnp.int32)
+            attempt = jnp.asarray(rng.integers(0, 5, 8), jnp.int32)
+            a1, d1 = ack.retransmit_due(valid, age, 3)
+            v2, a2, _at, d2, dead = ack.retransmit_backoff(
+                valid, age, attempt, 5, base=3)
+            np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+            np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+            np.testing.assert_array_equal(np.asarray(valid),
+                                          np.asarray(v2))
+            assert int(dead) == 0
+
+    def _lossy_run(self, cfg, rounds=100, k=4):
+        """Acked sends into a 20%-of-the-run outage window (a chaos
+        drop schedule); returns (world, total app emissions) where
+        emissions = delivered copies + chaos-dropped copies."""
+        proto = ack.AckedDelivery(cfg)
+        sched = ChaosSchedule().drop(10, dst=1, rounds=rounds // 5)
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False, chaos=sched)
+        dropped = 0
+        for r in range(rounds):
+            if 8 <= r < 8 + k:  # staggered sends into the outage
+                world = ps.send_ctl(world, proto, 0, "ctl_send",
+                                    peer=1, payload=100 + r)
+            world, m = step(world)
+            dropped += int(m["chaos_dropped"])
+        return world, int(world.state.seen[1].sum()) + dropped
+
+    def test_backoff_reduces_retransmissions_under_loss(self):
+        """The acceptance contract: under a 20%-loss chaos schedule the
+        exponential backoff measurably cuts retransmit emissions while
+        every payload still lands and the ring drains."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=16, retransmit_interval=3)
+        w_fixed, em_fixed = self._lossy_run(cfg)
+        w_bo, em_bo = self._lossy_run(cfg.replace(
+            retransmit_backoff_factor=2, retransmit_backoff_max=32,
+            retransmit_jitter=1))
+        for w in (w_fixed, w_bo):
+            assert int(w.state.seen[1].sum()) >= 4   # all delivered
+            assert int(w.state.out_valid.sum()) == 0  # ring drained
+            assert int(w.state.dead_lettered.sum()) == 0
+        assert em_bo < em_fixed, (em_bo, em_fixed)
+
+    def test_causal_lossy_delivery_backoff(self):
+        """CausalAcked under the same outage: causal order holds, every
+        payload delivers exactly once, and backoff fires fewer reemits
+        (out_attempt totals are the emission counter here)."""
+        def run(cfg):
+            proto = CausalAcked(cfg)
+            sched = ChaosSchedule().drop(3, dst=1, rounds=12)
+            world = pt.init_world(cfg, proto)
+            step = pt.make_step(cfg, proto, donate=False,
+                                randomize_delivery=False, chaos=sched)
+            attempts = 0
+            for r in range(60):
+                if r < 3:
+                    world = ps.send_ctl(world, proto, 0, "ctl_csend",
+                                        peer=1, payload=r + 1, cdelay=0)
+                prev = int(world.state.out_attempt.sum())
+                world, _ = step(world)
+                cur = int(world.state.out_attempt.sum())
+                attempts += max(cur - prev, 0)
+            return world, attempts
+
+        cfg = pt.Config(n_nodes=4, inbox_cap=16, retransmit_interval=3)
+        wf, at_fixed = run(cfg)
+        wb, at_bo = run(cfg.replace(retransmit_backoff_factor=2,
+                                    retransmit_backoff_max=32))
+        for w in (wf, wb):
+            assert int(w.state.causal.log_n[1]) == 3
+            assert list(np.asarray(w.state.causal.log[1])[:3]) \
+                == [1, 2, 3]
+            assert int(w.state.out_valid.sum()) == 0
+        assert at_bo < at_fixed, (at_bo, at_fixed)
+
+    def test_dead_letter_give_up_and_event_tap(self):
+        """A permanently-dead destination: after max_attempts the slots
+        dead-letter (freed + counted), the health_counters tap reports
+        them, and the host event tap emits to global sinks."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=16, retransmit_interval=2,
+                        retransmit_max_attempts=3)
+        proto = ack.AckedDelivery(cfg)
+        world = pt.init_world(cfg, proto)
+        world = world.replace(alive=world.alive.at[2].set(False))
+        for i in range(3):
+            world = ps.send_ctl(world, proto, 0, "ctl_send", peer=2,
+                                payload=i)
+        step = pt.make_step(cfg, proto, donate=False)
+        for _ in range(30):
+            world, _ = step(world)
+        assert int(world.state.out_valid.sum()) == 0
+        assert int(world.state.dead_lettered.sum()) == 3
+        hc = {k: int(v) for k, v in
+              proto.health_counters(world.state).items()}
+        assert hc["ack_dead_lettered"] == 3
+        assert hc["ack_outstanding"] == 0
+        events = []
+
+        class Sink:
+            def write_row(self, row):
+                events.append(row)
+
+            def close(self):
+                pass
+
+        sink = telemetry.add_global_sink(Sink())
+        try:
+            totals = ack.emit_ring_events(world.state)
+        finally:
+            telemetry.remove_global_sink(sink)
+        assert totals["dead_letter"] == 3
+        assert any(e["event"] == "ack_dead_letter" and e["total"] == 3
+                   for e in events), events
+
+    def test_store_ring_overflow_event_tap(self):
+        """The satellite's store-overflow surface: a full ring emits a
+        send_ring_overflow event with the counted total."""
+        cfg = pt.Config(n_nodes=4, inbox_cap=16, retransmit_interval=50)
+        proto = ack.AckedDelivery(cfg, ring_cap=2)
+        world = pt.init_world(cfg, proto)
+        world = world.replace(alive=world.alive.at[3].set(False))
+        for i in range(4):
+            world = ps.send_ctl(world, proto, 0, "ctl_send", peer=3,
+                                payload=i)
+        step = pt.make_step(cfg, proto, donate=False)
+        for _ in range(3):
+            world, _ = step(world)
+        totals = ack.emit_ring_events(world.state)
+        assert totals["send_ring_overflow"] == 2
+
+
+class TestHealthPlane:
+    def test_reach_fraction_ring_topology(self):
+        """Hand-built ring views: connected -> 1.0; cutting two opposite
+        edges -> two components and the proxy reports the root's side."""
+        n = 16
+        ids = np.arange(n)
+        views = np.stack([(ids + 1) % n, (ids - 1) % n], axis=1)
+        alive = jnp.ones((n,), bool)
+        frac = float(health.reach_fraction(jnp.asarray(views), alive))
+        assert frac == 1.0
+        cut = views.copy()
+        cut[0, 0] = -1   # 0 -/-> 1
+        cut[1, 1] = -1   # 1 -/-> 0  (undirected cut)
+        cut[8, 0] = -1   # 8 -/-> 9
+        cut[9, 1] = -1
+        frac = float(health.reach_fraction(jnp.asarray(cut), alive,
+                                           hops=n))
+        # components {1..8} and {9..15, 0}; the root (node 0) sees its
+        # own 8-node side
+        assert frac == pytest.approx(0.5)
+
+    def test_reach_fraction_partition_aware(self):
+        """A standing partition severs view edges even while the views
+        still list cross-boundary peers."""
+        n = 8
+        ids = np.arange(n)
+        views = jnp.asarray(np.stack([(ids + 1) % n, (ids - 1) % n],
+                                     axis=1))
+        alive = jnp.ones((n,), bool)
+        part = jnp.asarray([1, 1, 1, 1, 2, 2, 2, 2], jnp.int32)
+        assert float(health.reach_fraction(views, alive)) == 1.0
+        assert float(health.reach_fraction(views, alive,
+                                           partition=part)) == 0.5
+
+    def test_view_fill_and_host_folds(self):
+        views = jnp.asarray([[1, -1], [0, 2], [-1, -1]], jnp.int32)
+        alive = jnp.asarray([True, True, False])
+        assert float(health.view_fill(views, alive)) \
+            == pytest.approx(0.75)
+        rows = [{"round": r, "inflight": 10 * r,
+                 "health_reach_frac": 1.0 if r >= 5 else 0.5}
+                for r in range(8)]
+        assert health.inflight_watermark(rows) == 70
+        assert health.converged_round(rows, after=2) == 5
+        # a re-split after a momentary reconnect does not count
+        rows[6]["health_reach_frac"] = 0.5
+        assert health.converged_round(rows, after=2) == 7
+
+    @pytest.mark.slow
+    def test_runner_records_health_and_chaos_metrics(self):
+        """run_with_telemetry + health_registry + a chaos schedule: the
+        ring rows carry the health plane and the chaos counters."""
+        n = 16
+        sched = (ChaosSchedule().partition(4, (0, 7), 1)
+                 .partition(4, (8, 15), 2).heal(10))
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        world = ps.cluster(pt.init_world(cfg, proto), proto,
+                           [(i, 0) for i in range(1, n)])
+        rows = []
+
+        class Sink:
+            def write_row(self, row):
+                rows.append(row)
+
+            def close(self):
+                pass
+
+        telemetry.run_with_telemetry(
+            cfg, proto, 16, window=8, registry=health.health_registry(),
+            sinks=[Sink()], world=world, step_kw={"chaos": sched})
+        rr = [r for r in rows if "health_reach_frac" in r]
+        assert len(rr) == 16
+        mid = [r for r in rr if 5 <= r["round"] < 10]
+        assert all(r["health_reach_frac"] <= 0.6 for r in mid), mid
+        assert {"chaos_dropped", "chaos_delayed",
+                "chaos_duplicated"} <= set(rr[0])
+
+
+class TestShardAwareCheckpoint:
+    def test_mismatches_raise_named_errors(self, tmp_path):
+        """n_nodes / protocol / leaf-shape drift between save and
+        restore configs raises a NAMED error, not a reshape crash.  No
+        stepping needed — validation is save/load-layer only."""
+        cfg = pt.Config(n_nodes=8, inbox_cap=8)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        path = str(tmp_path / "ck")
+        checkpoint.save(path, cfg, world, proto=proto)
+        cfg2 = cfg.replace(n_nodes=16)
+        template = pt.init_world(cfg2, HyParView(cfg2))
+        with pytest.raises(ValueError, match="n_nodes"):
+            checkpoint.load(path, template, cfg=cfg2)
+        # without cfg the per-leaf check still names the leaf
+        with pytest.raises(ValueError, match="leaf"):
+            checkpoint.load(path, template)
+        with pytest.raises(ValueError, match="cross-protocol"):
+            checkpoint.load(path, pt.init_world(cfg, proto),
+                            proto="FullMembership")
+        # the happy path round-trips with validation on
+        back, manifest = checkpoint.load(path, pt.init_world(cfg, proto),
+                                         cfg=cfg, proto=proto)
+        assert manifest["proto"] == "HyParView"
+        leaves_equal(back, world)
+
+    @needs_mesh
+    @pytest.mark.slow
+    def test_sharded_save_load_resume_bit_identical(self, tmp_path):
+        """A sharded world checkpoints mid-chaos-run and resumes through
+        place_sharded_world bit-identically (the soak crash-resume
+        path)."""
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (
+            init_sharded_world, make_sharded_step, place_sharded_world,
+            sharded_out_cap)
+        n = 32
+        sched = ChaosSchedule().crash(2, (3, 4)).recover(6, (3, 4))
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        w = ps.cluster(
+            pt.init_world(cfg, proto,
+                          out_cap=sharded_out_cap(cfg, proto, 8)),
+            proto, [(i, 0) for i in range(1, n)])
+        w = place_sharded_world(w, cfg, mesh)
+        step = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 chaos=sched)
+        for _ in range(4):
+            w, _ = step(w)
+        path = str(tmp_path / "ck")
+        checkpoint.save(path, cfg, w, proto=proto)
+        w2, manifest = checkpoint.load_sharded(path, cfg, proto, mesh)
+        assert manifest["round"] == 4
+        for _ in range(4):
+            w, _ = step(w)
+            w2, _ = step(w2)
+        leaves_equal(w.state, w2.state)
+        np.testing.assert_array_equal(np.asarray(w.alive),
+                                      np.asarray(w2.alive))
+
+
+class TestResubPolicyHook:
+    @pytest.mark.slow
+    def test_identity_policy_bit_equal(self):
+        """An all-True policy compiles to the pre-hook program on both
+        dense models (the hook's zero-cost contract)."""
+        from partisan_tpu.models.hyparview_dense import (dense_init,
+                                                         make_dense_round)
+        from partisan_tpu.models.scamp_dense import (
+            dense_scamp_init, make_dense_scamp_round)
+        cfg = pt.Config(n_nodes=32, seed=3, shuffle_interval=4,
+                        random_promotion_interval=2)
+        always = lambda lonely, rnd: jnp.ones_like(lonely)
+        for init, mk in ((dense_init,
+                          lambda **kw: make_dense_round(cfg, 0.05, **kw)),
+                         (dense_scamp_init,
+                          lambda **kw: make_dense_scamp_round(
+                              cfg, 0.05, **kw))):
+            sa = sb = init(cfg)
+            a, b = jax.jit(mk()), jax.jit(mk(resub_policy=always))
+            for _ in range(10):
+                sa, sb = a(sa), b(sb)
+            leaves_equal(sa, sb)
+
+    def test_suppressing_policy_strands_churned_rows(self):
+        """In dense SCAMP a churned row rejoins EXCLUSIVELY through the
+        isolation re-subscribe (the round-4 churn restructure), so a
+        never-resubscribe policy strands churned rows lonely while the
+        identity run re-knits them — the suppression is observable, not
+        just plumbed."""
+        from partisan_tpu.models.scamp_dense import (
+            dense_scamp_init, make_dense_scamp_round)
+        cfg = pt.Config(n_nodes=64, seed=5)
+
+        def lonely_count(s):
+            part = np.asarray(s.partial) >= 0
+            pos = np.asarray(s.walk_pos) >= 0
+            return int(((part.sum(1) == 0) & (pos.sum(1) == 0)).sum())
+
+        def run(policy):
+            step = jax.jit(make_dense_scamp_round(
+                cfg, churn=0.1, resub_policy=policy))
+            s = dense_scamp_init(cfg)
+            for _ in range(15):
+                s = step(s)
+            return lonely_count(s)
+
+        never = lambda lonely, rnd: jnp.zeros_like(lonely)
+        stranded = run(never)
+        healed = run(None)
+        assert stranded > healed, (stranded, healed)
+        assert stranded > 0
+
+
+class TestSoakSmoke:
+    def _soak(self):
+        spec = importlib.util.spec_from_file_location(
+            "chaos_soak", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "scripts", "chaos_soak.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_single_cell_smoke(self, tmp_path):
+        """One tiny lossy_combo cell converges after heal and writes no
+        postmortem (the tier-1 smoke of the campaign runner)."""
+        soak = self._soak()
+        row = soak.run_cell(n=64, rounds=60, seed=1, mix="lossy_combo",
+                            window=20, heal_margin=25, flight_cap=2048,
+                            postmortem_dir=str(tmp_path))
+        assert row["converged"], row
+        assert row["postmortem"] is None
+        assert row["chaos_dropped"] > 0
+        assert row["chaos_duplicated"] > 0
+        assert row["inflight_watermark"] > 0
+
+    @pytest.mark.slow
+    def test_failing_cell_writes_postmortem(self, tmp_path):
+        """An impossible heal margin forces a FAIL cell: the row records
+        the flight-recorder postmortem path and the trace file decodes."""
+        from partisan_tpu.verify.trace import read_trace
+        soak = self._soak()
+        # partition that never heals within the run -> cannot converge
+        row = soak.run_cell(n=64, rounds=24, seed=1,
+                            mix="partition_heal", window=12,
+                            heal_margin=1, flight_cap=2048,
+                            postmortem_dir=str(tmp_path))
+        assert not row["converged"]
+        assert row["postmortem"] and os.path.exists(row["postmortem"])
+        assert read_trace(row["postmortem"]), "empty postmortem trace"
+
+    @pytest.mark.slow
+    def test_small_campaign(self, tmp_path):
+        """A reduced seed x mix campaign (N=256) end to end through
+        main(): every cell converges after heal."""
+        soak = self._soak()
+        out = str(tmp_path / "BENCH_chaos.jsonl")
+        rc = soak.main(["--n", "256", "--rounds", "120", "--window",
+                        "24", "--seeds", "1,2", "--mixes",
+                        "crash_recover,partition_heal,lossy_combo",
+                        "--heal-margin", "45", "--out", out,
+                        "--postmortem-dir", str(tmp_path)])
+        assert rc == 0
+        assert sum(1 for _ in open(out)) == 6
