@@ -170,6 +170,14 @@ def load_sharded(path: str, cfg: Config, proto: Any, mesh,
     return place_sharded_world(world, cfg, mesh), manifest
 
 
+def load_extra(path: str) -> Dict[str, Any]:
+    """The manifest's harness-owned ``extra`` dict alone — campaign
+    runners (scripts/chaos_soak.py --resume) stash completed-cell
+    bookkeeping there and read it back without touching the arrays."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        return json.load(f).get("extra", {}) or {}
+
+
 def load_config(path: str) -> Config:
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)

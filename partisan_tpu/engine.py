@@ -607,7 +607,13 @@ def make_step(
     ``chaos_dropped``/``chaos_delayed``/``chaos_duplicated`` counters.
     The sharded dataplane accepts the same schedule
     (``parallel/dataplane.make_sharded_step(chaos=)``) and applies it
-    shard-locally, bit-identically to this path.
+    shard-locally, bit-identically to this path.  Passing a
+    :class:`verify.chaos.DynamicSchedule` instead compiles the chaos
+    planes against a TRACED ``[n_events, 5]`` table: the returned step
+    is ``step(world, chaos_table)`` and one program executes any padded
+    schedule (the fault-space explorer's batch axis, verify/explorer.py);
+    static schedules are validated against ``n_nodes`` at compile time
+    (``ChaosSchedule.validate``).
 
     ``capture_wire=True`` adds the post-interposition pre-route buffer to
     the metrics dict (keys ``wire_valid/src/dst/typ/channel/hash``) — the
@@ -648,19 +654,37 @@ def make_step(
         # lazy: telemetry.runner imports engine, so engine must not
         # import telemetry at module load
         from .telemetry.flight import flight_record
+    dynamic_chaos = False
     if chaos is not None:
         # lazy for the same reason: verify imports engine
-        from .verify.chaos import apply_chaos_msgs, apply_chaos_nodes
+        from .verify.chaos import (DynamicSchedule, apply_chaos_msgs,
+                                   apply_chaos_msgs_table,
+                                   apply_chaos_nodes,
+                                   apply_chaos_nodes_table)
+        dynamic_chaos = isinstance(chaos, DynamicSchedule)
+        if dynamic_chaos and flight is not None:
+            raise ValueError(
+                "make_step: flight recording and a DynamicSchedule "
+                "cannot combine (both change the step arity); run the "
+                "found schedule through the static chaos= path to "
+                "record its flight trace")
+        if not dynamic_chaos:
+            chaos.validate(n_nodes=N, n_types=n_types)
 
-    def step(world: World, fring=None):
+    def step(world: World, fring=None, chaos_table=None):
         rnd = world.rnd
         node_ids = jnp.arange(N, dtype=jnp.int32)
         if chaos is not None:
             # node plane first: a node crashed at round r neither sends
             # nor receives IN round r, and the updated planes persist in
             # the carried world
-            alive2, part2 = apply_chaos_nodes(
-                chaos, rnd, world.alive, world.partition, node_ids)
+            if dynamic_chaos:
+                alive2, part2 = apply_chaos_nodes_table(
+                    chaos_table, rnd, world.alive, world.partition,
+                    node_ids)
+            else:
+                alive2, part2 = apply_chaos_nodes(
+                    chaos, rnd, world.alive, world.partition, node_ids)
             world = world.replace(alive=alive2, partition=part2)
         state, msgs = world.state, world.msgs
         rkeys = jax.vmap(prng.round_key, in_axes=(0, None))(world.keys, rnd)
@@ -678,8 +702,12 @@ def make_step(
         #    uses (src-shard residency), so both paths stay bit-equal
         chaos_counts = None
         if chaos is not None:
-            now, chaos_held, chaos_counts = apply_chaos_msgs(
-                chaos, rnd, now)
+            if dynamic_chaos:
+                now, chaos_held, chaos_counts = apply_chaos_msgs_table(
+                    chaos_table, rnd, now)
+            else:
+                now, chaos_held, chaos_counts = apply_chaos_msgs(
+                    chaos, rnd, now)
             if chaos_held is not None:
                 held = msgops.concat(held, chaos_held)
 
@@ -807,6 +835,13 @@ def make_step(
 
     if flight is not None:
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    if dynamic_chaos:
+        # step(world, chaos_table) — the table is a traced argument, so
+        # ONE compiled program executes any schedule of <= n_events rows
+        # (verify/explorer.py vmaps this over a [B, n_events, 5] stack)
+        def dyn_step(world: World, chaos_table):
+            return step(world, None, chaos_table)
+        return jax.jit(dyn_step, donate_argnums=(0,) if donate else ())
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -846,6 +881,11 @@ def run(cfg: Config, proto: ProtocolBase, n_rounds: int,
 def make_run_scan(cfg: Config, proto: ProtocolBase, n_rounds: int, **kw):
     """Whole-run-on-device: lax.scan over rounds, returns stacked metrics.
     This is the benchmark path — zero host round-trips per round."""
+    sched = kw.get("chaos")
+    if sched is not None and hasattr(sched, "validate"):
+        # the one call site that knows the horizon: an event scheduled
+        # past n_rounds would silently never fire
+        sched.validate(n_rounds=n_rounds)
     step = make_step(cfg, proto, donate=False, **kw)
 
     @functools.partial(jax.jit, donate_argnums=(0,))

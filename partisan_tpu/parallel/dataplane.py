@@ -328,6 +328,7 @@ def make_sharded_step(
                                         flight_record)
     if chaos is not None:
         from ..verify.chaos import apply_chaos_msgs, apply_chaos_nodes
+        chaos.validate(n_nodes=cfg.n_nodes)
 
     def exchange(now: Msgs, src_part: jax.Array):
         """Bucket the local ready messages by destination shard and

@@ -235,6 +235,26 @@ class AckedDelivery(ProtocolBase):
                 "ack_dead_lettered": jnp.sum(state.dead_lettered)}
 
 
+# ---------------------------------------------------------- device taps
+
+def dead_letter_total(state) -> jax.Array:
+    """Device-side scalar: total dead-lettered slots summed across the
+    protocol's layer stack (walks ``.lower`` wrappers, so Stacked /
+    causal layers over an acked core all surface their give-ups).  The
+    fault-space explorer's no-dead-letter-loss invariant reads this
+    every round INSIDE the scan (verify/explorer.py) — zero when the
+    state carries no ``dead_lettered`` field, so the invariant is
+    vacuously true on un-acked protocols rather than an error."""
+    total = jnp.int32(0)
+    st = state
+    while st is not None:
+        arr = getattr(st, "dead_lettered", None)
+        if arr is not None:
+            total = total + jnp.sum(arr).astype(jnp.int32)
+        st = getattr(st, "lower", None)
+    return total
+
+
 # ------------------------------------------------------------- host taps
 
 def emit_ring_events(state, label: str = "ack") -> Dict[str, int]:

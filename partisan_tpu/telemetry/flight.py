@@ -45,7 +45,7 @@ unchanged.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -243,4 +243,20 @@ def flight_entries(rows: np.ndarray) -> List["TraceEntry"]:
     for r, s, d, t, c, h in flat[valid]:
         out.append(TraceEntry(int(r), int(s), int(d), int(t), int(c),
                               int(np.uint32(h))))
+    return out
+
+
+def flight_pairs(entries) -> Dict[Tuple[int, int, int], int]:
+    """Fold a flight-trace entry stream into observed traffic:
+    ``(src, dst, typ) -> count``.  This is the fault-space explorer's
+    frontier source (ISSUE 7): only pairs that actually carried protocol
+    traffic are worth perturbing — the reference's trace-membership
+    pruning (filibuster_SUITE), read off the recorder instead of a
+    bespoke trace pass.  Accepts any iterable of
+    :class:`verify.trace.TraceEntry` (``flight_entries`` output or the
+    legacy recorder's stream)."""
+    out: Dict[Tuple[int, int, int], int] = {}
+    for e in entries:
+        k = (int(e.src), int(e.dst), int(e.typ))
+        out[k] = out.get(k, 0) + 1
     return out

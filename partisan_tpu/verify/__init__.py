@@ -10,4 +10,5 @@ from . import faults  # noqa: F401
 from .trace import TraceRecorder, TraceEntry  # noqa: F401
 from . import chaos  # noqa: F401  (ISSUE 4: compiled fault schedules)
 from . import health  # noqa: F401  (ISSUE 4: in-scan health plane)
-from .chaos import ChaosSchedule  # noqa: F401
+from .chaos import ChaosSchedule, DynamicSchedule  # noqa: F401
+from . import explorer  # noqa: F401  (ISSUE 7: batched fault-space search)

@@ -23,7 +23,7 @@ emissions, the analog of the reference's periodic sends).
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Set
+from typing import Dict, List, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -286,6 +286,39 @@ def write_annotations(path: str, causality: Dict[str, List[str]]) -> None:
 def read_annotations(path: str) -> Dict[str, List[str]]:
     with open(path) as f:
         return json.load(f)
+
+
+def independence_relation(causality: Dict[str, List[str]],
+                          proto) -> Tuple[Set[Tuple[int, int]], Set[int]]:
+    """The pruning relation both schedule searchers share (ISSUE 7):
+    from a causality map (:func:`infer_causality` /
+    ``static_analysis.merged_causality``) build
+
+      * ``related`` — the symmetric set of wire-tag pairs ``(ta, tb)``
+        where one type can causally reach the other: faults on UNRELATED
+        types compose independently, so a schedule combining them is
+        implied by its singletons (the reference's annotation pruning,
+        filibuster_SUITE :697-930);
+      * ``relate_all`` — wire tags of state-gated timer emissions (in
+        ``__tick__`` but not ``__background__``): their firing predicate
+        reads state arbitrary deliveries mutate, so nothing can be
+        proven independent of them (the VERDICT r3 soundness hole).
+
+    Keys use ``proto.typ()`` (not ``msg_types.index``) so layered
+    protocols with a ``_typ_offset`` relate their actual wire tags.
+    ``verify/model_checker.py`` consults it to skip redundant schedule
+    extensions; ``verify/explorer.py`` consults it to keep only frontier
+    perturbations causally related to the invariant's channels."""
+    names = list(proto.msg_types)
+    reach = {t: reachable_types(causality, [t]) for t in names}
+    related = {
+        (proto.typ(a), proto.typ(b))
+        for a in names for b in names
+        if a in reach.get(b, ()) or b in reach.get(a, ())}
+    gated = (set(causality.get("__tick__", []))
+             - set(causality.get("__background__", [])))
+    relate_all = {proto.typ(t) for t in gated if t in names}
+    return related, relate_all
 
 
 def reachable_types(causality: Dict[str, List[str]],

@@ -64,12 +64,20 @@ KIND_HEAL = 3       # nodes [a, b] back to partition 0; a < 0 = everyone
 KIND_DROP = 4       # msgs src=a dst=b (-1 wildcard) dropped for c rounds
 KIND_DELAY = 5      # msgs src=a dst=b delayed +c rounds (this round only)
 KIND_DUP = 6        # msgs src=a dst=b duplicated, copy lands +c rounds
+KIND_DROP_TYP = 7   # msgs typ=a dst=b (-1 wildcard) dropped for c rounds
+                    # — the channel-targeted omission the fault-space
+                    # explorer perturbs (ISSUE 7): "drop the recovery
+                    # channel" is a typ, not a (src, dst) pair
 
 KIND_NAMES = ("crash", "recover", "partition", "heal", "drop", "delay",
-              "duplicate")
+              "duplicate", "drop_typ")
 _NODE_KINDS = (KIND_CRASH, KIND_RECOVER, KIND_PARTITION, KIND_HEAL)
-_MSG_KINDS = (KIND_DROP, KIND_DELAY, KIND_DUP)
+_MSG_KINDS = (KIND_DROP, KIND_DELAY, KIND_DUP, KIND_DROP_TYP)
 N_COLS = 5
+
+# the padding row of a dynamic table: kind -1 matches no plane, round -1
+# never fires — a guaranteed no-op on both the node and message planes
+SENTINEL = (-1, -1, -1, -1, 0)
 
 
 def _rng(nodes) -> Tuple[int, int]:
@@ -152,6 +160,17 @@ class ChaosSchedule:
                 f"duplicate copy_delay must be >= 1, got {copy_delay}")
         return self._add(rnd, KIND_DUP, src, dst, copy_delay)
 
+    def drop_typ(self, rnd: int, typ: int, dst: int = -1,
+                 rounds: int = 1) -> "ChaosSchedule":
+        """Drop messages of wire type ``typ`` (to ``dst``, -1 = any) for
+        ``rounds`` rounds — the channel-targeted omission (e.g. "drop
+        every recovery-channel message cluster-wide")."""
+        if typ < 0:
+            raise ValueError(f"drop_typ type must be >= 0, got {typ}")
+        if rounds < 1:
+            raise ValueError(f"drop window must be >= 1 rounds, got {rounds}")
+        return self._add(rnd, KIND_DROP_TYP, typ, dst, rounds)
+
     # ------------------------------------------------------------- queries
 
     @property
@@ -173,7 +192,7 @@ class ChaosSchedule:
 
     @property
     def has_drop(self) -> bool:
-        return bool(self._kinds((KIND_DROP,)))
+        return bool(self._kinds((KIND_DROP, KIND_DROP_TYP)))
 
     @property
     def has_delay(self) -> bool:
@@ -199,7 +218,7 @@ class ChaosSchedule:
             if kind in (KIND_HEAL, KIND_RECOVER, KIND_CRASH,
                         KIND_PARTITION):
                 ends.append(rnd)
-            elif kind == KIND_DROP:
+            elif kind in (KIND_DROP, KIND_DROP_TYP):
                 ends.append(rnd + max(c, 1) - 1)
             else:
                 ends.append(rnd)
@@ -211,6 +230,82 @@ class ChaosSchedule:
         rr = [e[0] for e in self.events
               if e[1] in (KIND_CRASH, KIND_PARTITION)]
         return np.asarray(sorted(set(rr)), np.int32)
+
+    def padded_table(self, n_events: int) -> np.ndarray:
+        """The [n_events, 5] int32 table padded with :data:`SENTINEL`
+        no-op rows — the fixed-shape row a :class:`DynamicSchedule` step
+        consumes and the fault-space explorer stacks along its batch
+        axis.  Raises if the schedule has more events than ``n_events``
+        (a silent truncation would un-inject faults)."""
+        if self.n_events > n_events:
+            raise ValueError(
+                f"schedule has {self.n_events} events, table capacity "
+                f"is {n_events}")
+        rows = list(self.events) + [SENTINEL] * (n_events - self.n_events)
+        return np.asarray(rows, np.int32).reshape(n_events, N_COLS)
+
+    # ---------------------------------------------------------- validation
+
+    def validate(self, n_nodes: Optional[int] = None,
+                 n_rounds: Optional[int] = None,
+                 n_types: Optional[int] = None) -> "ChaosSchedule":
+        """Compile-time schedule validation (ISSUE 7 satellite): events
+        that previously folded into silent no-ops now raise named
+        ``ValueError``s.  Checks, each gated on the caller knowing the
+        bound:
+
+          * ``n_rounds`` — an event at ``round >= n_rounds`` never fires
+            (builders already reject ``round < 0``);
+          * ``n_nodes`` — node-range or src/dst ids outside ``[0, n)``
+            never match a node or message (``-1`` wildcards stay legal);
+          * ``n_types`` — a ``drop_typ`` type outside ``[0, n_types)``
+            matches no wire type;
+          * same-round partition events whose SAME gid covers every node
+            (requires ``n_nodes``) — "two halves, one gid" puts the
+            whole cluster in one group, i.e. no partition at all.
+
+        Returns ``self`` so call sites can validate inline."""
+        n = n_nodes
+        # (round, gid) -> node-count covered, for the collision check
+        cover: dict = {}
+        for i, (rnd, kind, a, b, c) in enumerate(self.events):
+            name = KIND_NAMES[kind] if 0 <= kind < len(KIND_NAMES) else kind
+            where = f"chaos event #{i} ({name} @ round {rnd})"
+            if n_rounds is not None and rnd >= n_rounds:
+                raise ValueError(
+                    f"{where}: fires at round {rnd} but the run is only "
+                    f"{n_rounds} rounds — the event would never apply")
+            if kind in _NODE_KINDS:
+                if n is not None and a >= 0 and (a >= n or b >= n):
+                    raise ValueError(
+                        f"{where}: node range ({a}, {b}) out of "
+                        f"[0, {n}) — the event would never match a node")
+                if kind == KIND_PARTITION and n is not None:
+                    lo, hi = max(a, 0), min(b, n - 1)
+                    cover[(rnd, c)] = (cover.get((rnd, c), 0)
+                                       + max(hi - lo + 1, 0))
+            elif kind == KIND_DROP_TYP:
+                if n_types is not None and a >= n_types:
+                    raise ValueError(
+                        f"{where}: wire type {a} out of [0, {n_types}) "
+                        f"— the event would never match a message")
+                if n is not None and b >= n:
+                    raise ValueError(
+                        f"{where}: dst {b} out of [0, {n}) — the event "
+                        f"would never match a message")
+            else:  # src/dst message kinds
+                if n is not None and (a >= n or b >= n):
+                    raise ValueError(
+                        f"{where}: src/dst ({a}, {b}) out of [0, {n}) "
+                        f"— the event would never match a message")
+        for (rnd, gid), covered in cover.items():
+            if n is not None and covered >= n:
+                raise ValueError(
+                    f"partition gid collision at round {rnd}: gid {gid} "
+                    f"covers all {n} nodes — every node lands in one "
+                    f"group, which is no partition at all (use distinct "
+                    f"gids per side)")
+        return self
 
 
 # --------------------------------------------------------------- node plane
@@ -286,9 +381,16 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
 
     if sched.has_drop:
         drop = jnp.zeros((now.cap,), bool)
-        for ev_rnd, _k, a, b, c in sched._kinds((KIND_DROP,)):
+        for ev_rnd, kind, a, b, c in sched._kinds((KIND_DROP,
+                                                   KIND_DROP_TYP)):
             active = (rnd >= ev_rnd) & (rnd < ev_rnd + max(c, 1))
-            drop = drop | (_match(now, a, b) & active)
+            if kind == KIND_DROP_TYP:
+                hit = now.valid & (now.typ == a)
+                if b >= 0:
+                    hit = hit & (now.dst == b)
+            else:
+                hit = _match(now, a, b)
+            drop = drop | (hit & active)
         counts["chaos_dropped"] = jnp.sum(drop).astype(jnp.int32)
         now = now.replace(valid=now.valid & ~drop)
 
@@ -324,6 +426,131 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
         return now, None, counts
     extra_held = msgops.concat(*parts) if len(parts) > 1 else parts[0]
     return now, extra_held, counts
+
+
+# ------------------------------------------------------ dynamic (traced)
+#
+# The static plane above bakes the schedule into the compiled step —
+# right for a soak running ONE campaign, wrong for a fault-space SEARCH
+# where every candidate schedule would recompile the world.  The
+# explorer (verify/explorer.py) instead compiles the step ONCE against a
+# fixed-shape [n_events, 5] table passed as a TRACED argument, and vmaps
+# it over a [B, n_events, 5] stack: hundreds of fault scenarios per
+# compiled scan.  The table functions below are the traced twins of
+# apply_chaos_nodes / apply_chaos_msgs and are BIT-IDENTICAL to them for
+# any schedule the static path accepts:
+#
+#   * the node plane folds rows sequentially (fori_loop), so table order
+#     still wins ties exactly like the static unroll;
+#   * the message plane's folds are all order-independent reductions
+#     (drop = OR, delay bump = max, dup copy-delay = max) computed over
+#     the event axis at once;
+#   * SENTINEL padding rows (kind -1) match no plane and no kind;
+#   * extra_held is ALWAYS materialized ([2 * cap]: delay re-holds then
+#     dup copies, all-invalid when nothing matched) — msgops.compact is
+#     a stable sort on validity, so trailing invalid slots change no
+#     downstream valid content, only which garbage sits in dead slots.
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicSchedule:
+    """Marker for ``engine.make_step(chaos=DynamicSchedule(E))``: compile
+    the chaos planes against a TRACED ``[n_events, 5]`` table instead of
+    a baked-in :class:`ChaosSchedule` — the returned step is then
+    ``step(world, chaos_table)`` and one compiled program executes any
+    schedule of up to ``n_events`` events (pad with
+    :meth:`ChaosSchedule.padded_table`)."""
+
+    n_events: int
+
+    def __post_init__(self):
+        if self.n_events < 1:
+            raise ValueError(
+                f"DynamicSchedule needs n_events >= 1, got {self.n_events}")
+
+
+def apply_chaos_nodes_table(table: jax.Array, rnd: jax.Array,
+                            alive: jax.Array, partition: jax.Array,
+                            node_ids: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Traced-table twin of :func:`apply_chaos_nodes`: sequential fold
+    over the event rows (later rows win ties, exactly the static
+    unroll's semantics), each row a fused select on its kind."""
+
+    def body(i, carry):
+        alive, part = carry
+        ev_rnd, kind, a, b, c = (table[i, 0], table[i, 1], table[i, 2],
+                                 table[i, 3], table[i, 4])
+        fire = rnd == ev_rnd
+        in_rng = jnp.where(a < 0, jnp.ones_like(node_ids, dtype=bool),
+                           (node_ids >= a) & (node_ids <= b))
+        hit = fire & in_rng
+        alive = jnp.where(kind == KIND_CRASH, alive & ~hit, alive)
+        alive = jnp.where(kind == KIND_RECOVER, alive | hit, alive)
+        part = jnp.where((kind == KIND_PARTITION) & hit, c, part)
+        part = jnp.where((kind == KIND_HEAL) & hit, jnp.int32(0), part)
+        return alive, part
+
+    return jax.lax.fori_loop(0, table.shape[0], body, (alive, partition))
+
+
+def apply_chaos_msgs_table(table: jax.Array, rnd: jax.Array, now: Msgs):
+    """Traced-table twin of :func:`apply_chaos_msgs`.  Same pipeline
+    (drops, then delays on the survivors, then duplication), but each
+    stage reduces over the whole event axis at once — legal because the
+    static folds are order-independent (OR / max).  ``extra_held`` is
+    always a ``[2 * cap]`` buffer (delay re-holds ++ dup copies), so the
+    program shape is schedule-independent."""
+    ev_rnd, kind = table[:, 0], table[:, 1]
+    a, b, c = table[:, 2], table[:, 3], table[:, 4]
+
+    def pair_match(m: Msgs) -> jax.Array:
+        """[E, cap] — src/dst wildcard match per event row."""
+        msrc = (a[:, None] < 0) | (m.src[None, :] == a[:, None])
+        mdst = (b[:, None] < 0) | (m.dst[None, :] == b[:, None])
+        return m.valid[None, :] & msrc & mdst
+
+    def typ_match(m: Msgs) -> jax.Array:
+        """[E, cap] — wire-type/dst match per event row (KIND_DROP_TYP)."""
+        mtyp = m.typ[None, :] == a[:, None]
+        mdst = (b[:, None] < 0) | (m.dst[None, :] == b[:, None])
+        return m.valid[None, :] & mtyp & mdst
+
+    # -- drops (windowed): OR over events, matching the static fold
+    win = jnp.maximum(c, 1)
+    drop_active = ((ev_rnd >= 0) & (rnd >= ev_rnd)
+                   & (rnd < ev_rnd + win))                       # [E]
+    drop_ev = (((kind == KIND_DROP) & drop_active)[:, None] & pair_match(now)
+               | ((kind == KIND_DROP_TYP) & drop_active)[:, None]
+               & typ_match(now))
+    drop = jnp.any(drop_ev, axis=0)
+    counts = {"chaos_dropped": jnp.sum(drop).astype(jnp.int32)}
+    now = now.replace(valid=now.valid & ~drop)
+
+    # -- delays on the survivors: max bump over events, then the
+    #    '$delay' re-hold split (held copies age one round immediately)
+    delay_fire = ((kind == KIND_DELAY) & (rnd == ev_rnd))        # [E]
+    hit_d = delay_fire[:, None] & pair_match(now)
+    bump = jnp.max(jnp.where(hit_d, c[:, None], 0), axis=0,
+                   initial=0).astype(jnp.int32)
+    delayed = now.replace(delay=now.delay + bump)
+    re_held = delayed.replace(
+        valid=delayed.valid & (delayed.delay > 0),
+        delay=jnp.maximum(delayed.delay - 1, 0))
+    counts["chaos_delayed"] = jnp.sum(re_held.valid).astype(jnp.int32)
+    now = delayed.replace(valid=delayed.valid & (delayed.delay <= 0))
+
+    # -- duplication of the remaining ready slots: max copy-delay with a
+    #    -1 "no copy" floor, exactly the static fold
+    dup_fire = ((kind == KIND_DUP) & (rnd == ev_rnd))            # [E]
+    hit_u = dup_fire[:, None] & pair_match(now)
+    cdel = jnp.max(jnp.where(hit_u, jnp.maximum(c, 1)[:, None], -1),
+                   axis=0, initial=-1).astype(jnp.int32)
+    copy = now.replace(valid=now.valid & (cdel >= 0),
+                       delay=jnp.maximum(cdel - 1, 0))
+    counts["chaos_duplicated"] = jnp.sum(copy.valid).astype(jnp.int32)
+
+    return now, msgops.concat(re_held, copy), counts
 
 
 # ----------------------------------------------------- resubscribe policy

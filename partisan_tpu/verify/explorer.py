@@ -1,0 +1,621 @@
+"""Batched fault-space explorer (ISSUE 7 tentpole) — the TPU rebuild of
+the reference's "filibuster" search loop (``test/filibuster_SUITE.erl``,
+``bin/counterexample-find.sh`` / ``counterexample-replay.sh``) with the
+search itself moved onto the batch axis.
+
+The model checker (verify/model_checker.py) replays one omission
+schedule per host call; scripts/chaos_soak.py runs one fault cell per
+compile.  Here a fault SCENARIO is a row: B :class:`ChaosSchedule`
+tables stack into one ``[B, n_events, 5]`` array, the engine round
+compiles ONCE against a traced table (``engine.make_step(chaos=
+DynamicSchedule(E))``), and ``vmap`` + ``lax.scan`` executes B complete
+chaos'd runs in one program — hundreds of fault scenarios per scan
+(lineage-driven fault injection's systematic search, at device speed).
+
+Invariants evaluate ON DEVICE inside the scan as ``[I]`` boolean
+verdicts per execution, built from the verify/health.py primitives:
+
+  * ``convergence_after_heal`` — the partition-aware connectivity proxy
+    (:func:`verify.health.reach_mask`) must be 1.0 from ``check_from``
+    (last heal + margin) to the end of the run;
+  * ``view_fill_floor`` — mean view occupancy over alive nodes stays
+    above a floor after ``check_from`` (view starvation);
+  * ``no_dead_letter_loss`` — the qos ``dead_lettered`` give-up counter
+    stays zero (``qos.ack.dead_letter_total``, summed over the layer
+    stack);
+  * ``causal_order`` — the causal delivery frontier (``last_seq``) and
+    delivered count (``log_n``) never move backwards on the acked
+    protocols.
+
+A full batch costs ONE host transfer: the ``[B, I]`` verdict bits and
+first-violation rounds.  The schedule frontier comes from PR 3 flight
+traces (:func:`telemetry.flight.flight_pairs` — only (src, dst, typ)
+triples that actually carried traffic are perturbed) filtered through
+the causality annotations' independence relation
+(:func:`verify.analysis.independence_relation`), with a seeded random
+fallback.  Failing schedules shrink by delta-debugging directly on the
+event table — every single-removal candidate of a round re-executes in
+ONE device batch — and the minimal counterexample serializes to JSON
+that ``scripts/chaos_soak.py --replay`` re-executes, flight-recorder
+postmortem attached.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..engine import ProtocolBase, World, init_world, make_step
+from . import health
+from .chaos import KIND_NAMES, ChaosSchedule, DynamicSchedule
+
+
+# ------------------------------------------------------------- invariants
+#
+# An invariant is (name, init, update): ``init(world) -> aux`` builds the
+# carried auxiliary state (previous-round snapshots for monotonicity
+# checks; () when stateless) and ``update(aux, world, metrics, rnd,
+# check_from) -> (aux, violated)`` returns the device bool for THIS
+# round.  The explorer folds (ok, first_violation_round) generically.
+
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    name: str
+    init: Callable[[World], object]
+    update: Callable[..., Tuple[object, jax.Array]]
+
+
+def _views_of(state):
+    """The padded view array ([N, C], -1 padding) of a membership layer,
+    unwrapping Stacked ``lower`` chains — telemetry.runner's walk."""
+    st = state
+    while st is not None:
+        views = getattr(st, "active", None)
+        if views is None:
+            views = getattr(st, "partial", None)
+        if views is not None:
+            return views
+        st = getattr(st, "lower", None)
+    return None
+
+
+def _state_attr(state, name):
+    """Find ``name`` anywhere in the (possibly nested) state tree:
+    protocols wrap rows both linearly (Stacked ``lower`` chains) and as
+    plain fields (CausalAckedRow holds its CausalRow under ``causal``),
+    so descend into every dataclass-valued field, shallowest first."""
+    queue = [state]
+    while queue:
+        st = queue.pop(0)
+        if st is None:
+            continue
+        arr = getattr(st, name, None)
+        if arr is not None:
+            return arr
+        for f in getattr(st, "__dataclass_fields__", {}):
+            v = getattr(st, f, None)
+            if hasattr(v, "__dataclass_fields__"):
+                queue.append(v)
+    return None
+
+
+def convergence_after_heal(hops: Optional[int] = None) -> Invariant:
+    """reach_fraction == 1.0 for every round >= check_from: the overlay
+    re-knit after the last injected disruption and STAYED connected."""
+
+    def update(aux, world, metrics, rnd, check_from):
+        frac = health.reach_fraction(_views_of(world.state), world.alive,
+                                     hops, world.partition)
+        return aux, (rnd >= check_from) & (frac < 1.0)
+
+    return Invariant("convergence_after_heal", lambda w: (), update)
+
+
+def view_fill_floor(floor: float = 0.1) -> Invariant:
+    """Mean occupied view fraction over alive nodes >= floor after
+    check_from — the view-starvation signal."""
+
+    def update(aux, world, metrics, rnd, check_from):
+        fill = health.view_fill(_views_of(world.state), world.alive)
+        return aux, (rnd >= check_from) & (fill < floor)
+
+    return Invariant("view_fill_floor", lambda w: (), update)
+
+
+def no_dead_letter_loss() -> Invariant:
+    """The qos give-up counter stays zero: no acked message was ever
+    abandoned at the retransmit backoff threshold.  Checked EVERY round
+    (the counter is cumulative), so first_violation_round is the round
+    the first slot dead-lettered."""
+    from ..qos.ack import dead_letter_total
+
+    def update(aux, world, metrics, rnd, check_from):
+        return aux, dead_letter_total(world.state) > 0
+
+    return Invariant("no_dead_letter_loss", lambda w: (), update)
+
+
+def causal_order() -> Invariant:
+    """The causal delivery frontier never regresses: per-receiver
+    ``last_seq`` (last delivered seq per sender) and ``log_n`` (total
+    delivered) are monotone round-over-round.  A violation means a
+    delivery was un-delivered or the frontier moved backwards — the
+    causal-order safety net on the acked/causal protocols."""
+
+    def init(world):
+        return (_state_attr(world.state, "last_seq"),
+                _state_attr(world.state, "log_n"))
+
+    def update(aux, world, metrics, rnd, check_from):
+        prev_seq, prev_n = aux
+        seq = _state_attr(world.state, "last_seq")
+        log_n = _state_attr(world.state, "log_n")
+        viol = jnp.any(seq < prev_seq) | jnp.any(log_n < prev_n)
+        return (seq, log_n), viol
+
+    return Invariant("causal_order", init, update)
+
+
+def default_invariants(proto: ProtocolBase, world: World,
+                       view_floor: float = 0.1,
+                       hops: Optional[int] = None) -> List[Invariant]:
+    """Pick the invariants the protocol's state actually supports (host
+    inspection, once): membership layers get the connectivity pair,
+    acked layers the dead-letter check, causal layers the order check."""
+    inv: List[Invariant] = []
+    if _views_of(world.state) is not None:
+        inv.append(convergence_after_heal(hops))
+        inv.append(view_fill_floor(view_floor))
+    if _state_attr(world.state, "dead_lettered") is not None:
+        inv.append(no_dead_letter_loss())
+    if (_state_attr(world.state, "last_seq") is not None
+            and _state_attr(world.state, "log_n") is not None):
+        inv.append(causal_order())
+    if not inv:
+        raise ValueError(
+            f"no explorer invariant applies to {type(proto).__name__} "
+            f"state — pass invariants= explicitly")
+    return inv
+
+
+# --------------------------------------------------------------- verdicts
+
+@dataclasses.dataclass
+class BatchVerdict:
+    """One host transfer's worth of answers for a batch of schedules."""
+    names: Tuple[str, ...]
+    ok: np.ndarray          # [B, I] bool — invariant held for the run
+    first_bad: np.ndarray   # [B, I] int32 — first violation round, -1
+
+    def failures(self) -> List[Tuple[int, str, int]]:
+        """(batch index, invariant name, first violation round) rows."""
+        out = []
+        for b, i in zip(*np.nonzero(~self.ok)):
+            out.append((int(b), self.names[i], int(self.first_bad[b, i])))
+        return out
+
+    def passed(self, b: int) -> bool:
+        return bool(self.ok[b].all())
+
+
+# --------------------------------------------------------------- explorer
+
+class Explorer:
+    """Compile once, search many: one vmapped scan checks a batch of
+    fault schedules against device-evaluated invariants.
+
+    ``batch`` is the compiled batch width — every ``run_batch`` call
+    pads its schedule list to this width (repeating the last schedule)
+    so ONE compiled program serves the whole campaign, shrinking
+    included.  B=1 executions are bit-identical to the static
+    ``engine.make_step(chaos=)`` path (tests/test_explorer.py pins
+    states, fault planes, metrics and chaos counters on 60-round
+    HyParView)."""
+
+    def __init__(self, cfg: Config, proto: ProtocolBase, *,
+                 n_rounds: int, n_events: int = 8, batch: int = 16,
+                 world: Optional[World] = None,
+                 invariants: Optional[Sequence[Invariant]] = None,
+                 heal_margin: int = 12,
+                 view_floor: float = 0.1,
+                 hops: Optional[int] = None,
+                 mesh=None):
+        self.cfg, self.proto = cfg, proto
+        self.n_rounds, self.n_events = n_rounds, n_events
+        self.batch = batch
+        self.heal_margin = heal_margin
+        self.world0 = world if world is not None else init_world(cfg, proto)
+        self.invariants = list(invariants) if invariants is not None \
+            else default_invariants(proto, self.world0, view_floor, hops)
+        self.names = tuple(i.name for i in self.invariants)
+        self.mesh = mesh
+        self._shard = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            axis = tuple(mesh.axis_names)[0]
+            self._shard = NamedSharding(mesh, PartitionSpec(axis))
+        # ONE compiled step for every schedule: the table is traced
+        self.step = make_step(cfg, proto, donate=False,
+                              chaos=DynamicSchedule(n_events))
+        # ... and ONE compiled scan for every entry point: the verdict
+        # fold and the stacked per-round metrics ride the same program
+        # (the metrics ys cost B * n_rounds scalars — nothing — and a
+        # second lean program would double the dominant cost on this
+        # engine, XLA compile time)
+        self._run = jax.jit(jax.vmap(self._one, in_axes=(0, 0, 0)))
+
+    # ----------------------------------------------------------- core scan
+
+    def _one(self, world: World, table: jax.Array,
+             check_from: jax.Array):
+        """One complete chaos'd execution + in-scan invariant fold."""
+        I = len(self.invariants)
+        auxs = tuple(inv.init(world) for inv in self.invariants)
+        ok0 = jnp.ones((I,), bool)
+        fb0 = jnp.full((I,), -1, jnp.int32)
+
+        def body(carry, _):
+            w, auxs, ok, fb = carry
+            w2, m = self.step(w, table)
+            rnd = m["round"]
+            new_auxs, viols = [], []
+            for inv, aux in zip(self.invariants, auxs):
+                aux2, viol = inv.update(aux, w2, m, rnd, check_from)
+                new_auxs.append(aux2)
+                viols.append(viol)
+            viol = jnp.stack(viols)
+            fb = jnp.where(ok & viol & (fb < 0), rnd, fb)
+            ok = ok & ~viol
+            return (w2, tuple(new_auxs), ok, fb), m
+
+        (wf, _, ok, fb), metrics = jax.lax.scan(
+            body, (world, auxs, ok0, fb0), None, length=self.n_rounds)
+        return wf, ok, fb, metrics
+
+    # --------------------------------------------------------- batch entry
+
+    def _check_from(self, sched: ChaosSchedule) -> int:
+        return max(sched.last_heal_round(), 0) + self.heal_margin
+
+    def _pad_batch(self, schedules: Sequence[ChaosSchedule]
+                   ) -> List[ChaosSchedule]:
+        if len(schedules) > self.batch:
+            raise ValueError(
+                f"{len(schedules)} schedules > compiled batch width "
+                f"{self.batch}; chunk the frontier (Explorer.explore "
+                f"does)")
+        pad = [schedules[-1]] * (self.batch - len(schedules))
+        return list(schedules) + pad
+
+    def _stack_inputs(self, schedules: Sequence[ChaosSchedule]):
+        n_types = len(self.proto.msg_types)
+        for s in schedules:
+            s.validate(n_nodes=self.cfg.n_nodes, n_rounds=self.n_rounds,
+                       n_types=n_types)
+        tables = jnp.asarray(np.stack(
+            [s.padded_table(self.n_events) for s in schedules]))
+        check = jnp.asarray([self._check_from(s) for s in schedules],
+                            jnp.int32)
+        B = len(schedules)
+        worldB = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(
+                x, (B,) + jnp.shape(x)).copy(), self.world0)
+        if self._shard is not None and B % self.mesh.devices.size == 0:
+            tables = jax.device_put(tables, self._shard)
+            check = jax.device_put(check, self._shard)
+            worldB = jax.device_put(worldB, self._shard)
+        return worldB, tables, check
+
+    def run_batch(self, schedules: Sequence[ChaosSchedule]
+                  ) -> BatchVerdict:
+        """Execute up to ``batch`` schedules in one vmapped scan; ONE
+        host transfer of verdict bits + first-violation rounds."""
+        n = len(schedules)
+        worldB, tables, check = self._stack_inputs(
+            self._pad_batch(schedules))
+        _, ok, fb, _ = self._run(worldB, tables, check)
+        ok, fb = np.asarray(ok), np.asarray(fb)  # the one transfer
+        return BatchVerdict(self.names, ok[:n], fb[:n])
+
+    def run_batch_with_metrics(self, schedules: Sequence[ChaosSchedule]):
+        """Parity variant: returns ``(final_worlds, metrics, verdict)``
+        where ``metrics`` stacks the per-round metric dict to
+        ``[B, n_rounds]`` per key — the B=1 bit-identity surface against
+        the static chaos path.  Same compiled program as
+        :meth:`run_batch`; the extra outputs are simply fetched."""
+        n = len(schedules)
+        worldB, tables, check = self._stack_inputs(
+            self._pad_batch(schedules))
+        wf, ok, fb, metrics = self._run(worldB, tables, check)
+        verdict = BatchVerdict(self.names, np.asarray(ok)[:n],
+                               np.asarray(fb)[:n])
+        return wf, metrics, verdict
+
+    def explore(self, schedules: Sequence[ChaosSchedule],
+                on_batch: Optional[Callable] = None
+                ) -> List[Tuple[ChaosSchedule, str, int]]:
+        """Sweep a frontier in compiled-width chunks.  Returns failing
+        ``(schedule, invariant, first_violation_round)`` rows."""
+        failures = []
+        for i in range(0, len(schedules), self.batch):
+            chunk = list(schedules[i:i + self.batch])
+            verdict = self.run_batch(chunk)
+            for b, name, rnd in verdict.failures():
+                failures.append((chunk[b], name, rnd))
+            if on_batch is not None:
+                on_batch(i // self.batch, chunk, verdict)
+        return failures
+
+    # ----------------------------------------------------------- shrinking
+
+    def _fails(self, verdict: BatchVerdict, b: int,
+               invariant: str) -> bool:
+        return not verdict.ok[b, self.names.index(invariant)]
+
+    def shrink(self, sched: ChaosSchedule, invariant: str,
+               max_iters: int = 64) -> ChaosSchedule:
+        """Greedy delta-debugging directly on the event table: each
+        round, EVERY single-event-removal candidate executes in one
+        device batch (padded to the compiled width, chunked if the
+        schedule has more events than the batch); the first failing
+        candidate (table order — deterministic) becomes the new
+        schedule.  Stops when no single removal still violates
+        ``invariant``, i.e. the result is 1-minimal."""
+        if invariant not in self.names:
+            raise ValueError(f"unknown invariant {invariant!r}; "
+                             f"have {self.names}")
+        current = ChaosSchedule(tuple(sched.events))
+        for _ in range(max_iters):
+            events = list(current.events)
+            if len(events) <= 1:
+                break
+            cands = [ChaosSchedule(tuple(events[:i] + events[i + 1:]))
+                     for i in range(len(events))]
+            chosen = None
+            for lo in range(0, len(cands), self.batch):
+                chunk = cands[lo:lo + self.batch]
+                verdict = self.run_batch(chunk)
+                for b in range(len(chunk)):
+                    if self._fails(verdict, b, invariant):
+                        chosen = chunk[b]
+                        break
+                if chosen is not None:
+                    break
+            if chosen is None:
+                return current
+            current = chosen
+        return current
+
+
+# --------------------------------------------------------------- frontier
+
+def frontier_from_trace(entries, proto: Optional[ProtocolBase] = None, *,
+                        n_rounds: int,
+                        causality: Optional[Dict] = None,
+                        target_types: Optional[Sequence[str]] = None,
+                        base: Optional[ChaosSchedule] = None,
+                        start: Optional[int] = None,
+                        window: Optional[int] = None,
+                        max_schedules: int = 64
+                        ) -> List[ChaosSchedule]:
+    """Generate candidate schedules from OBSERVED traffic: the flight
+    recorder's (src, dst, typ) pairs (:func:`telemetry.flight.
+    flight_pairs`), optionally pruned through the causality annotations
+    (keep a pair only if its type is causally related to a
+    ``target_types`` root or is a never-prunable state-gated timer —
+    the reference's annotation pruning via
+    :func:`verify.analysis.independence_relation`).  Each surviving
+    pair yields a drop-window schedule on the pair, a cluster-wide
+    ``drop_typ`` on its type, and a delay schedule — grafted onto
+    ``base`` (e.g. a partition/heal scaffold) when given.  Pairs are
+    ordered by traffic volume (then key) so the frontier is
+    deterministic and truncation keeps the busiest channels."""
+    from ..telemetry.flight import flight_pairs
+    pairs = flight_pairs(entries)
+    keep: List[Tuple[int, int, int]] = sorted(
+        pairs, key=lambda k: (-pairs[k], k))
+    if causality is not None and proto is not None and target_types:
+        from .analysis import independence_relation
+        related, relate_all = independence_relation(causality, proto)
+        roots = {proto.typ(t) for t in target_types}
+        keep = [k for k in keep
+                if k[2] in relate_all
+                or any((k[2], r) in related for r in roots)]
+    start = (n_rounds // 4) if start is None else start
+    window = max(n_rounds // 4, 1) if window is None else window
+    base = base or ChaosSchedule()
+    out: List[ChaosSchedule] = []
+    seen_typ = set()
+    for src, dst, typ in keep:
+        if len(out) >= max_schedules:
+            break
+        out.append(base.drop(start, src=src, dst=dst, rounds=window))
+        if typ not in seen_typ:
+            seen_typ.add(typ)
+            out.append(base.drop_typ(start, typ=typ, rounds=window))
+        out.append(base.delay(start, src=src, dst=dst, extra=2))
+    return out[:max_schedules]
+
+
+def random_frontier(seed: int, n_nodes: int, n_rounds: int, *,
+                    count: int = 32, n_types: int = 4,
+                    base: Optional[ChaosSchedule] = None
+                    ) -> List[ChaosSchedule]:
+    """Seeded random fallback when no trace/annotations exist: uniform
+    drop / drop_typ / delay / crash-recover perturbations over the node
+    and type space, deterministic in ``seed``."""
+    rng = np.random.default_rng(seed)
+    base = base or ChaosSchedule()
+    out: List[ChaosSchedule] = []
+    horizon = max(n_rounds // 2, 2)
+    for _ in range(count):
+        rnd = int(rng.integers(1, horizon))
+        kind = int(rng.integers(0, 4))
+        if kind == 0:
+            out.append(base.drop(rnd, src=int(rng.integers(0, n_nodes)),
+                                 dst=int(rng.integers(0, n_nodes)),
+                                 rounds=int(rng.integers(1, horizon))))
+        elif kind == 1:
+            out.append(base.drop_typ(rnd, typ=int(rng.integers(0, n_types)),
+                                     rounds=int(rng.integers(1, horizon))))
+        elif kind == 2:
+            out.append(base.delay(rnd, src=int(rng.integers(0, n_nodes)),
+                                  extra=int(rng.integers(1, 4))))
+        else:
+            lo = int(rng.integers(0, n_nodes))
+            hi = min(lo + int(rng.integers(0, max(n_nodes // 8, 1))),
+                     n_nodes - 1)
+            out.append(base.crash(rnd, (lo, hi))
+                       .recover(min(rnd + int(rng.integers(1, horizon)),
+                                    n_rounds - 1), (lo, hi)))
+    return out
+
+
+# ------------------------------------------------ counterexample artifact
+#
+# A counterexample must be REPLAYABLE from the JSON alone, so it names a
+# setup from this registry (protocol + initial world construction) plus
+# the Config — not a pickled closure.
+
+def _setup_hyparview_tree(cfg: Config):
+    """HyParView bootstrapped over a binary-tree contact graph — the
+    chaos_soak.run_cell world shape."""
+    from .. import peer_service as ps
+    from ..models.hyparview import HyParView
+    proto = HyParView(cfg)
+    world = ps.cluster(init_world(cfg, proto), proto,
+                       [(i, (i - 1) // 2) for i in range(1, cfg.n_nodes)])
+    return proto, world
+
+
+def _setup_acked_uniform(cfg: Config):
+    """AckedDelivery with every node holding one in-flight ctl_send to
+    its ring successor — the dead-letter / causal-order surface."""
+    from .. import peer_service as ps
+    from ..qos.ack import AckedDelivery
+    proto = AckedDelivery(cfg)
+    world = init_world(cfg, proto)
+    n = cfg.n_nodes
+    for i in range(n):
+        world = ps.send_ctl(world, proto, i, "ctl_send",
+                            peer=(i + 1) % n, payload=100 + i)
+    return proto, world
+
+
+SETUPS: Dict[str, Callable[[Config], Tuple[ProtocolBase, World]]] = {
+    "hyparview_tree": _setup_hyparview_tree,
+    "acked_uniform": _setup_acked_uniform,
+}
+
+
+def write_counterexample(path: str, *, setup: str, cfg: Config,
+                         sched: ChaosSchedule, invariant: str,
+                         first_violation_round: int, n_rounds: int,
+                         heal_margin: int, n_events: int,
+                         original_events: int,
+                         extra: Optional[Dict] = None) -> str:
+    """Serialize a (shrunk) failing schedule as the replayable artifact
+    — the analog of the reference's counterexample.tar
+    (bin/counterexample-find.sh)."""
+    doc = {
+        "kind": "chaos_counterexample",
+        "setup": setup,
+        "config": dataclasses.asdict(cfg),
+        "n_rounds": int(n_rounds),
+        "n_events": int(n_events),
+        "heal_margin": int(heal_margin),
+        "invariant": invariant,
+        "first_violation_round": int(first_violation_round),
+        "events": [list(e) for e in sched.events],
+        "event_names": [
+            f"{KIND_NAMES[k] if 0 <= k < len(KIND_NAMES) else k}"
+            f"@{r}(a={a}, b={b}, c={c})"
+            for r, k, a, b, c in sched.events],
+        "original_events": int(original_events),
+    }
+    doc.update(extra or {})
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    return path
+
+
+def read_counterexample(path: str) -> Dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("kind") != "chaos_counterexample":
+        raise ValueError(f"{path}: not a chaos counterexample artifact")
+    return doc
+
+
+def replay_counterexample(path: str,
+                          postmortem_dir: Optional[str] = None) -> Dict:
+    """Rebuild the world from the artifact's named setup + Config, re-run
+    the schedule through the SAME vmapped checker (B=1), and report
+    whether the violation reproduces.  With ``postmortem_dir`` the
+    schedule additionally re-executes on the STATIC chaos path with the
+    flight recorder armed and the last window's wire trace is written —
+    the counterexample-replay.sh + postmortem workflow."""
+    doc = read_counterexample(path)
+    raw = dict(doc["config"])
+    for k, v in raw.items():
+        if isinstance(v, list):
+            raw[k] = tuple(v)
+    cfg = Config(**raw)
+    proto, world = SETUPS[doc["setup"]](cfg)
+    sched = ChaosSchedule(tuple(tuple(int(x) for x in e)
+                                for e in doc["events"]))
+    ex = Explorer(cfg, proto, n_rounds=doc["n_rounds"],
+                  n_events=doc["n_events"], batch=1, world=world,
+                  heal_margin=doc["heal_margin"])
+    verdict = ex.run_batch([sched])
+    try:
+        idx = ex.names.index(doc["invariant"])
+        reproduced = not bool(verdict.ok[0, idx])
+        first_bad = int(verdict.first_bad[0, idx])
+    except ValueError:
+        reproduced, first_bad = False, -1
+    out = {"reproduced": reproduced, "invariant": doc["invariant"],
+           "first_violation_round": first_bad,
+           "expected_round": doc["first_violation_round"],
+           "postmortem": None}
+    if postmortem_dir is not None:
+        out["postmortem"] = _flight_postmortem(
+            cfg, proto, world, sched, doc, postmortem_dir)
+    return out
+
+
+def _flight_postmortem(cfg: Config, proto: ProtocolBase, world: World,
+                       sched: ChaosSchedule, doc: Dict,
+                       out_dir: str) -> str:
+    """Re-execute on the static chaos path with the flight recorder and
+    dump the last recorded window's wire trace (verify.trace format)."""
+    from .. import telemetry
+    from ..telemetry.flight import FlightSpec
+    from . import trace as trace_mod
+    n_rounds = int(doc["n_rounds"])
+    window = min(32, max(n_rounds, 1))
+    last = {"entries": []}
+
+    def on_flight(entries):
+        last["entries"] = entries
+
+    telemetry.run_with_telemetry(
+        cfg, proto, n_rounds, window=window, world=world,
+        registry=health.health_registry(),
+        flight=FlightSpec(window=window,
+                          cap=int(doc.get("flight_cap", 2048))),
+        on_flight=on_flight, step_kw={"chaos": sched})
+    os.makedirs(out_dir, exist_ok=True)
+    base = os.path.join(
+        out_dir, f"counterexample_{doc['setup']}_{doc['invariant']}")
+    trace_path = base + ".trace"
+    trace_mod.write_trace(trace_path, last["entries"])
+    return trace_path

@@ -161,19 +161,11 @@ class ModelChecker:
         related = None
         relate_all: set = set()
         if annotations is not None:
-            from .analysis import reachable_types
-            names = list(self.proto.msg_types)
-            reach = {t: reachable_types(annotations, [t]) for t in names}
-            # proto.typ() (not names.index) so _typ_offset-bearing
-            # protocols key `related` by their actual wire tags
-            related = {
-                (self.proto.typ(a), self.proto.typ(b))
-                for a in names for b in names
-                if a in reach.get(b, ()) or b in reach.get(a, ())}
-            # state-gated timer emissions: never prune against them
-            gated = (set(annotations.get("__tick__", []))
-                     - set(annotations.get("__background__", [])))
-            relate_all = {self.proto.typ(t) for t in gated if t in names}
+            # shared with the fault-space explorer's frontier pruning
+            # (verify/explorer.py) — one construction, one semantics
+            from .analysis import independence_relation
+            related, relate_all = independence_relation(
+                annotations, self.proto)
 
         actions = (0,) + tuple(int(d) for d in delays)
         passed = failed = 0

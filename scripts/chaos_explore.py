@@ -1,0 +1,326 @@
+"""Batched fault-space exploration campaign (ISSUE 7) — the
+``bin/counterexample-find.sh`` analog, with the search batched onto the
+device: B complete chaos'd executions per vmapped scan, invariants
+checked in-scan, failing schedules delta-debugged in device batches and
+serialized as replayable counterexample JSON.
+
+Campaign phases (all rows append to ``BENCH_explore.jsonl``):
+
+  1. **frontier** — run the clean AckedDelivery workload with the PR-3
+     flight recorder armed; only (src, dst, typ) triples that actually
+     carried traffic seed the schedule frontier
+     (``explorer.frontier_from_trace``), topped up with seeded-random
+     schedules (``explorer.random_frontier``).
+  2. **explore** — sweep the frontier through one batched
+     :class:`verify.explorer.Explorer`; the planted bug (a bounded
+     retransmit budget: ``retransmit_max_attempts=2``) dead-letters
+     under any drop window that outlasts the backoff schedule.
+  3. **shrink + replay** — delta-debug the first counterexample to a
+     minimal event table, write the JSON artifact, and verify it
+     reproduces through a fresh B=1 checker (the same path
+     ``scripts/chaos_soak.py --replay FILE`` drives, flight-recorder
+     postmortem attached).
+  4. **hyparview** — the membership-plane hunt: a standing partition
+     hidden among benign perturbations violates convergence-after-heal;
+     found and shrunk through a B=1 explorer (the vmapped HyParView
+     program is the expensive compile on this engine — the batched
+     machinery is exercised on the cheap AckedDelivery program, and the
+     B=1 program is shared with tests/test_explorer.py via the
+     persistent compilation cache).  Skipped under ``--smoke``.
+  5. **bench** — batched-vs-serial schedules/sec on the 8-device CPU
+     mesh: one ``run_batch`` of B schedules against a B=1 explorer
+     looping over the same list; the batch is sharded across the mesh
+     when B divides evenly.
+
+Usage:
+    python scripts/chaos_explore.py                   # full campaign
+        [--batch 64] [--rounds 30] [--events 4] [--seed 7]
+        [--out BENCH_explore.jsonl] [--counterexample-dir .]
+        [--postmortem-dir /tmp]
+    python scripts/chaos_explore.py --smoke           # tier-1 cell
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# CPU verify path + the persistent compilation cache (the vmapped
+# explorer programs are compile-heavy; tests/conftest.py points at the
+# same cache, so test and script runs warm each other)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import telemetry  # noqa: E402
+from partisan_tpu.telemetry.flight import FlightSpec  # noqa: E402
+from partisan_tpu.verify import explorer, health  # noqa: E402
+from partisan_tpu.verify.chaos import ChaosSchedule  # noqa: E402
+from partisan_tpu.verify.explorer import Explorer, SETUPS  # noqa: E402
+
+ACK_N = 8
+HYP_N, HYP_ROUNDS, HYP_EVENTS = 16, 60, 10
+
+
+def acked_cfg(seed: int = 5) -> pt.Config:
+    """The planted-bug configuration: a retransmit budget of 2 attempts
+    at interval 2 with factor-2 backoff gives up inside any drop window
+    longer than ~2 + 4 rounds — a dead-letter bug the explorer must
+    find from traffic alone."""
+    return pt.Config(n_nodes=ACK_N, inbox_cap=8, seed=seed,
+                     retransmit_interval=2, retransmit_backoff_factor=2,
+                     retransmit_max_attempts=2)
+
+
+def record_clean_trace(cfg, proto, world, rounds: int):
+    """Clean (chaos-free) run with the flight recorder armed; returns
+    every decoded TraceEntry — the observed-traffic frontier source."""
+    entries = []
+    telemetry.run_with_telemetry(
+        cfg, proto, rounds, window=rounds, world=world,
+        registry=health.health_registry(),
+        flight=FlightSpec(window=rounds, cap=1024),
+        on_flight=lambda es: entries.extend(es))
+    return entries
+
+
+def acked_phase(args, rows):
+    cfg = acked_cfg()
+    proto, world = SETUPS["acked_uniform"](cfg)
+
+    # -------------------------------------------------- 1. the frontier
+    t0 = time.perf_counter()
+    entries = record_clean_trace(cfg, proto, world, args.rounds)
+    frontier = explorer.frontier_from_trace(
+        entries, proto, n_rounds=args.rounds, start=1,
+        window=args.rounds - 5, max_schedules=args.batch)
+    n_trace = len(frontier)
+    if len(frontier) < args.batch:  # seeded-random top-up
+        frontier += [
+            s for s in explorer.random_frontier(
+                args.seed, ACK_N, args.rounds,
+                count=args.batch - len(frontier),
+                n_types=len(proto.msg_types))
+            if not s.has_node_events]
+    rows.append({
+        "bench": "chaos_explore", "phase": "frontier",
+        "trace_entries": len(entries), "trace_schedules": n_trace,
+        "frontier": len(frontier),
+        "wall_s": round(time.perf_counter() - t0, 2)})
+    print(f"frontier: {len(entries)} trace entries -> {n_trace} "
+          f"traffic-derived + {len(frontier) - n_trace} random "
+          f"schedules")
+
+    # ------------------------------------------- 2. the batched sweep
+    ex = Explorer(cfg, proto, n_rounds=args.rounds,
+                  n_events=args.events, batch=args.batch, world=world,
+                  heal_margin=5)
+    t0 = time.perf_counter()
+    failures = ex.explore(frontier)
+    sweep_s = time.perf_counter() - t0
+    rows.append({
+        "bench": "chaos_explore", "phase": "explore",
+        "protocol": "AckedDelivery", "n": ACK_N,
+        "rounds": args.rounds, "batch": args.batch,
+        "frontier": len(frontier),
+        "counterexamples_found": len(failures),
+        "wall_s": round(sweep_s, 2)})
+    print(f"explore: {len(failures)}/{len(frontier)} schedules violate "
+          f"({sweep_s:.1f}s incl. compile)")
+    if not failures:
+        print("no counterexample found — planted bug missing?")
+        return None
+
+    # --------------------------------------- 3. shrink, write, replay
+    sched, inv, first_bad = failures[0]
+    t0 = time.perf_counter()
+    shrunk = ex.shrink(sched, inv)
+    verdict = ex.run_batch([shrunk])
+    rnd = int(verdict.first_bad[0, ex.names.index(inv)])
+    cx_path = os.path.join(args.counterexample_dir,
+                           "counterexample_acked.json")
+    explorer.write_counterexample(
+        cx_path, setup="acked_uniform", cfg=cfg, sched=shrunk,
+        invariant=inv, first_violation_round=rnd,
+        n_rounds=args.rounds, heal_margin=5, n_events=args.events,
+        original_events=len(sched.events))
+    rep = explorer.replay_counterexample(
+        cx_path, postmortem_dir=args.postmortem_dir)
+    rows.append({
+        "bench": "chaos_explore", "phase": "shrink",
+        "invariant": inv, "original_events": len(sched.events),
+        "shrunk_events": len(shrunk.events),
+        "first_violation_round": rnd,
+        "replay_reproduced": bool(rep["reproduced"]),
+        "counterexample": cx_path,
+        "postmortem": rep["postmortem"],
+        "wall_s": round(time.perf_counter() - t0, 2)})
+    print(f"shrink: {len(sched.events)} -> {len(shrunk.events)} events "
+          f"({inv} @ round {rnd}); replay "
+          f"{'REPRODUCED' if rep['reproduced'] else 'FAILED'} -> "
+          f"{cx_path}")
+    print(f"  (same replay via: python scripts/chaos_soak.py "
+          f"--replay {cx_path})")
+    return ex
+
+
+def hyparview_phase(args, rows):
+    """Membership-plane hunt on the SAME program shape as the tier-1
+    parity tests (n=16, 60 rounds, 10 events, B=1) — one compile,
+    shared through the persistent cache."""
+    cfg = pt.Config(n_nodes=HYP_N, inbox_cap=16, shuffle_interval=5,
+                    seed=3)
+    proto, world = SETUPS["hyparview_tree"](cfg)
+    ex = Explorer(cfg, proto, n_rounds=HYP_ROUNDS, n_events=HYP_EVENTS,
+                  batch=1, world=world, heal_margin=12)
+    half = HYP_N // 2
+    healed = (ChaosSchedule()
+              .partition(10, (0, half - 1), 1)
+              .partition(10, (half, HYP_N - 1), 2).heal(24))
+    noise = explorer.random_frontier(
+        args.seed, HYP_N, HYP_ROUNDS, count=4,
+        n_types=len(proto.msg_types), base=healed)
+    planted = (ChaosSchedule().drop(3, dst=5, rounds=2)
+               .delay(4, extra=1)
+               .partition(6, (0, half - 1), 1))  # never healed
+    frontier = [s for s in noise if not s.has_node_events] + [planted]
+
+    t0 = time.perf_counter()
+    failures = ex.explore(frontier)
+    conv = [(s, n, r) for s, n, r in failures
+            if n == "convergence_after_heal"]
+    if not conv:
+        print("hyparview: no convergence violation found")
+        return
+    sched, inv, rnd = conv[0]
+    shrunk = ex.shrink(sched, inv)
+    cx_path = os.path.join(args.counterexample_dir,
+                           "counterexample_hyparview.json")
+    explorer.write_counterexample(
+        cx_path, setup="hyparview_tree", cfg=cfg, sched=shrunk,
+        invariant=inv, first_violation_round=rnd,
+        n_rounds=HYP_ROUNDS, heal_margin=12, n_events=HYP_EVENTS,
+        original_events=len(sched.events))
+    rep = explorer.replay_counterexample(
+        cx_path, postmortem_dir=args.postmortem_dir)
+    rows.append({
+        "bench": "chaos_explore", "phase": "hyparview",
+        "protocol": "HyParView", "n": HYP_N, "rounds": HYP_ROUNDS,
+        "frontier": len(frontier),
+        "counterexamples_found": len(conv),
+        "invariant": inv, "original_events": len(sched.events),
+        "shrunk_events": len(shrunk.events),
+        "first_violation_round": rnd,
+        "replay_reproduced": bool(rep["reproduced"]),
+        "counterexample": cx_path,
+        "wall_s": round(time.perf_counter() - t0, 2)})
+    print(f"hyparview: standing partition found "
+          f"({len(sched.events)} -> {len(shrunk.events)} events, "
+          f"{inv} @ round {rnd}); replay "
+          f"{'REPRODUCED' if rep['reproduced'] else 'FAILED'}")
+
+
+def bench_phase(args, rows, batched_ex):
+    """Batched vs serial schedules/sec.  The batched explorer shards
+    its inputs across the mesh when B divides the device count; the
+    serial baseline re-executes the same schedules one compiled B=1
+    program at a time."""
+    cfg = acked_cfg()
+    proto, world = SETUPS["acked_uniform"](cfg)
+    B = args.batch
+    mesh = None
+    if B % len(jax.devices()) == 0:
+        mesh = jax.make_mesh((len(jax.devices()),), ("b",))
+    ex = Explorer(cfg, proto, n_rounds=args.rounds,
+                  n_events=args.events, batch=B, world=world,
+                  heal_margin=5, mesh=mesh) if mesh is not None \
+        else batched_ex
+    scheds = [s for s in explorer.random_frontier(
+        args.seed + 1, ACK_N, args.rounds, count=B + 8,
+        n_types=len(proto.msg_types)) if not s.has_node_events][:B]
+    scheds += [ChaosSchedule().drop(1, dst=1, rounds=2)] \
+        * (B - len(scheds))
+
+    ex.run_batch(scheds)  # compile + warm
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        ex.run_batch(scheds)
+    batched_s = (time.perf_counter() - t0) / reps
+    batched_sps = B / batched_s
+
+    serial = Explorer(cfg, proto, n_rounds=args.rounds,
+                      n_events=args.events, batch=1, world=world,
+                      heal_margin=5)
+    serial.run_batch(scheds[:1])  # compile + warm
+    t0 = time.perf_counter()
+    for s in scheds:
+        serial.run_batch([s])
+    serial_s = time.perf_counter() - t0
+    serial_sps = B / serial_s
+
+    rows.append({
+        "bench": "chaos_explore", "phase": "bench",
+        "protocol": "AckedDelivery", "n": ACK_N,
+        "rounds": args.rounds, "batch": B,
+        "devices": len(jax.devices()),
+        "sharded": mesh is not None,
+        "batched_s": round(batched_s, 4),
+        "serial_s": round(serial_s, 4),
+        "batched_schedules_per_sec": round(batched_sps, 2),
+        "serial_schedules_per_sec": round(serial_sps, 2),
+        "speedup": round(batched_sps / serial_sps, 2)})
+    print(f"bench: batched {batched_sps:.1f} sched/s vs serial "
+          f"{serial_sps:.1f} sched/s -> {batched_sps / serial_sps:.1f}x "
+          f"(B={B}, sharded={mesh is not None})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--events", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="BENCH_explore.jsonl")
+    ap.add_argument("--counterexample-dir", default=".")
+    ap.add_argument("--postmortem-dir", default="/tmp")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small batch, AckedDelivery phases only — the "
+                         "tier-1 smoke configuration")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.batch = 8
+
+    os.makedirs(args.counterexample_dir, exist_ok=True)
+    rows = []
+    batched_ex = acked_phase(args, rows)
+    if batched_ex is None:
+        return 1
+    if not args.smoke:
+        hyparview_phase(args, rows)
+    bench_phase(args, rows, batched_ex)
+
+    with open(args.out, "a") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"\n{len(rows)} rows -> {args.out}")
+    shr = [r for r in rows if r["phase"] in ("shrink", "hyparview")]
+    return 0 if shr and all(r["replay_reproduced"] for r in shr) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,13 +20,28 @@ This is the fault-injection analog of the reference's
 are data, the health monitors run in-scan, and the soak only touches
 the host once per window.
 
+``--checkpoint DIR`` saves the campaign state after every cell through
+the shard-aware :mod:`partisan_tpu.checkpoint` (the finished cell's
+world + a ``completed``/``rows`` ledger in the manifest's ``extra``);
+``--resume`` restores the ledger, integrity-checks the saved world
+against its own config, and continues from the first unfinished cell —
+the resumed ``BENCH_chaos.jsonl`` is row-identical to an uninterrupted
+run (modulo wall-clock fields).
+
+``--replay FILE`` re-executes a fault-space counterexample artifact
+(``verify.explorer.write_counterexample`` / scripts/chaos_explore.py)
+through the B=1 vmapped checker and attaches a flight-recorder
+postmortem — the ``bin/counterexample-replay.sh`` analog.
+
 Usage:
     python scripts/chaos_soak.py                      # full campaign
         [--n 4096] [--rounds 160] [--window 32]
         [--seeds 1,2,3,4] [--mixes crash_recover,partition_heal,lossy_combo]
         [--heal-margin 60] [--out BENCH_chaos.jsonl]
         [--flight-cap 2048] [--postmortem-dir /tmp]
+        [--checkpoint DIR] [--resume]
     python scripts/chaos_soak.py --smoke              # one tiny cell
+    python scripts/chaos_soak.py --replay cx.json     # counterexample
 """
 
 from __future__ import annotations
@@ -53,6 +68,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import checkpoint  # noqa: E402
 from partisan_tpu import peer_service as ps  # noqa: E402
 from partisan_tpu import telemetry  # noqa: E402
 from partisan_tpu.models.hyparview import HyParView  # noqa: E402
@@ -125,8 +141,11 @@ class _Rows:
 
 def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
              heal_margin: int, flight_cap: int, postmortem_dir: str,
-             shuffle_interval: int = 5) -> dict:
-    """Run one (seed, mix) cell; returns its JSONL row (a plain dict)."""
+             shuffle_interval: int = 5, out: dict = None) -> dict:
+    """Run one (seed, mix) cell; returns its JSONL row (a plain dict).
+
+    ``out``, when given, receives the cell's final ``world`` and ``cfg``
+    so the campaign loop can checkpoint them (--checkpoint/--resume)."""
     sched = MIXES[mix](n, rounds)
     heal_rnd = sched.last_heal_round()
     cfg = pt.Config(n_nodes=n, inbox_cap=16,
@@ -154,6 +173,8 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
         on_flight=on_flight,
         step_kw={"chaos": sched})
     dt = time.perf_counter() - t0
+    if out is not None:
+        out["world"], out["cfg"] = world, cfg
 
     rows = [r for r in sink.rows if "health_reach_frac" in r]
     conv = health.converged_round(rows, after=heal_rnd)
@@ -210,7 +231,38 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="one tiny cell (n=64, 1 seed, lossy_combo) — "
                          "the tier-1 smoke configuration")
+    ap.add_argument("--checkpoint", metavar="DIR", default=None,
+                    help="save campaign state here after every cell "
+                         "(partisan_tpu.checkpoint directory)")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the --checkpoint ledger and continue "
+                         "from the first unfinished cell")
+    ap.add_argument("--replay", metavar="FILE", default=None,
+                    help="re-execute a chaos counterexample JSON "
+                         "(verify.explorer / scripts/chaos_explore.py) "
+                         "with a flight-recorder postmortem; exits 0 "
+                         "iff the violation reproduces")
+    # test hook: simulate a mid-campaign kill after N cells (exit 3,
+    # BENCH not written — the checkpoint is the only survivor)
+    ap.add_argument("--fail-after", type=int, default=0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+
+    if args.replay:
+        from partisan_tpu.verify import explorer
+        res = explorer.replay_counterexample(
+            args.replay, postmortem_dir=args.postmortem_dir)
+        verdict = ("REPRODUCED" if res["reproduced"]
+                   else "NOT REPRODUCED")
+        print(f"{verdict} {res['invariant']} @ round "
+              f"{res['first_violation_round']} "
+              f"(expected {res['expected_round']})"
+              + (f", postmortem={res['postmortem']}"
+                 if res["postmortem"] else ""))
+        return 0 if res["reproduced"] else 1
+
+    if args.resume and not args.checkpoint:
+        ap.error("--resume requires --checkpoint")
 
     if args.smoke:
         args.n, args.rounds, args.window = 64, 60, 20
@@ -223,16 +275,37 @@ def main(argv=None) -> int:
         if m not in MIXES:
             ap.error(f"unknown mix {m!r}; have {sorted(MIXES)}")
 
-    failures = 0
     rows = []
+    completed = []  # [mix, seed] pairs, campaign order
+    if args.resume:
+        # ledger + integrity gate: the saved world must restore cleanly
+        # against its own recorded config/protocol before we trust the
+        # completed-cell list (the shard-aware load validates every
+        # leaf's shape and dtype)
+        extra = checkpoint.load_extra(args.checkpoint)
+        completed = [list(c) for c in extra.get("completed", [])]
+        rows = list(extra.get("rows", []))
+        ccfg = checkpoint.load_config(args.checkpoint)
+        checkpoint.load(args.checkpoint,
+                        pt.init_world(ccfg, HyParView(ccfg)),
+                        cfg=ccfg, proto="HyParView")
+        print(f"resumed {args.checkpoint}: {len(completed)} cells "
+              f"already complete")
+
+    done_this_run = 0
     for mix in mixes:
         for seed in seeds:
+            if [mix, seed] in completed:
+                continue
+            cell_out = {}
             row = run_cell(n=args.n, rounds=args.rounds, seed=seed,
                            mix=mix, window=args.window,
                            heal_margin=args.heal_margin,
                            flight_cap=args.flight_cap,
-                           postmortem_dir=args.postmortem_dir)
+                           postmortem_dir=args.postmortem_dir,
+                           out=cell_out)
             rows.append(row)
+            completed.append([mix, seed])
             verdict = "PASS" if row["converged"] else "FAIL"
             print(f"{verdict} {mix} seed={seed}: heal@{row['heal_round']}"
                   f" converged@{row['converged_round']}"
@@ -241,8 +314,19 @@ def main(argv=None) -> int:
                   f" watermark={row['inflight_watermark']:.0f}"
                   + (f", postmortem={row['postmortem']}"
                      if row["postmortem"] else "") + ")")
-            if not row["converged"]:
-                failures += 1
+            if args.checkpoint:
+                checkpoint.save(args.checkpoint, cell_out["cfg"],
+                                cell_out["world"],
+                                extra={"completed": completed,
+                                       "rows": rows},
+                                proto="HyParView")
+            done_this_run += 1
+            if args.fail_after and done_this_run >= args.fail_after:
+                print("injected kill: exiting mid-campaign",
+                      file=sys.stderr)
+                return 3
+
+    failures = sum(1 for r in rows if not r["converged"])
     with open(args.out, "a") as f:
         for row in rows:
             f.write(json.dumps(row) + "\n")

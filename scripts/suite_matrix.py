@@ -41,6 +41,13 @@ import jax  # noqa: E402
 # case).  The env var alone does NOT select CPU on this image — only
 # config.update does (see the tpu-tunnel measurement notes).
 jax.config.update("jax_platforms", "cpu")
+# share the repo-local persistent compilation cache with the tests and
+# scripts: the explorer parity case reuses the vmapped HyParView program
+# (minutes cold, seconds warm) that tests/test_explorer.py compiles
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -719,6 +726,81 @@ def chaos_soak_smoke():
         assert row["postmortem"] is None, row
 
 
+def explorer_parity_test():
+    """ISSUE 7 tentpole contract: a B=1 execution through the batched
+    fault-space explorer (vmapped scan over a traced chaos table) is
+    bit-identical to the static ``make_step(chaos=)`` path — per-round
+    metrics with chaos counters, final state, fault planes and the
+    valid message prefix — on 60-round HyParView under a schedule
+    exercising every event kind.  Same program shapes as
+    tests/test_explorer.py, shared via the persistent compile cache."""
+    from partisan_tpu.verify.chaos import ChaosSchedule
+    from partisan_tpu.verify.explorer import Explorer, SETUPS
+    n, rounds = 16, 60
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto, world = SETUPS["hyparview_tree"](cfg)
+    ex = Explorer(cfg, proto, n_rounds=rounds, n_events=10, batch=1,
+                  world=world, heal_margin=12)
+    sched = (ChaosSchedule().crash(8, (4, 7))
+             .partition(10, (0, 7), 1).partition(10, (8, 15), 2)
+             .drop(12, dst=3, rounds=5).drop_typ(13, typ=1, rounds=3)
+             .delay(14, src=2, extra=2).duplicate(16)
+             .heal(30).recover(32, (4, 7)))
+    wf, metrics, _ = ex.run_batch_with_metrics([sched])
+    step = pt.make_step(cfg, proto, donate=False, chaos=sched)
+    w = world
+    for r in range(rounds):
+        w, m = step(w)
+        for k, v in m.items():
+            assert int(np.asarray(metrics[k])[0, r]) == int(v), (k, r)
+    w0 = jax.tree_util.tree_map(lambda l: np.asarray(l)[0], wf)
+    for lp, lb in zip(
+            jax.tree_util.tree_leaves((w.state, w.alive, w.partition,
+                                       w.keys, w.rnd)),
+            jax.tree_util.tree_leaves((w0.state, w0.alive, w0.partition,
+                                       w0.keys, w0.rnd))):
+        assert (np.asarray(lp) == np.asarray(lb)).all()
+    va, vb = w0.msgs.valid.astype(bool), np.asarray(w.msgs.valid)
+    assert (va == vb).all()
+    for name in ("src", "dst", "typ", "channel", "lane", "delay",
+                 "born"):
+        assert (getattr(w0.msgs, name)[va]
+                == np.asarray(getattr(w.msgs, name))[vb]).all(), name
+
+
+def explore_smoke():
+    """ISSUE 7 campaign smoke: the batched explorer campaign
+    (AckedDelivery phases, B=8) finds the planted dead-letter bug from
+    a flight-trace frontier, shrinks it, and the written counterexample
+    JSON replays — exit 0 and JSONL rows on disk."""
+    import importlib.util
+    import json as json_mod
+    import tempfile
+    spec = importlib.util.spec_from_file_location(
+        "chaos_explore", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "chaos_explore.py"))
+    explore = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(explore)
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "BENCH_explore.jsonl")
+        rc = explore.main(["--smoke", "--out", out,
+                           "--counterexample-dir", td,
+                           "--postmortem-dir", td])
+        assert rc == 0
+        with open(out) as f:
+            rows = [json_mod.loads(ln) for ln in f]
+    phases = {r["phase"] for r in rows}
+    assert {"frontier", "explore", "shrink", "bench"} <= phases
+    sweep = next(r for r in rows if r["phase"] == "explore")
+    assert sweep["counterexamples_found"] > 0
+    shrink = next(r for r in rows if r["phase"] == "shrink")
+    assert shrink["replay_reproduced"] is True
+    assert shrink["shrunk_events"] <= 3
+    bench = next(r for r in rows if r["phase"] == "bench")
+    assert bench["batched_schedules_per_sec"] > 0
+    assert bench["serial_schedules_per_sec"] > 0
+
+
 def performance_test():
     """performance_test (:1029): the echo harness completes its streams
     (the full swept numbers live in scripts/perf_suite.py ->
@@ -1281,6 +1363,14 @@ def build_matrix():
         chaos_parity_test)
     add("robustness/chaos", "chaos_soak_smoke", "hyparview", "engine",
         chaos_soak_smoke)
+
+    # ISSUE 7: the batched fault-space explorer — B=1 vmapped/static
+    # bit-identity and the find -> shrink -> replay campaign smoke
+    # (full frontiers live in scripts/chaos_explore.py)
+    add("robustness/explore", "explorer_parity_test", "hyparview",
+        "engine", explorer_parity_test)
+    add("robustness/explore", "explore_smoke", "hyparview", "engine",
+        explore_smoke)
 
     return M
 

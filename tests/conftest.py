@@ -41,3 +41,20 @@ _cache_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)),
                           ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
+# Tier-1 brushes the 870 s CI ceiling on the 1-vCPU box, and the box's
+# throughput varies run to run.  Run the newest additions (ISSUE 7
+# fault-space explorer surface) LAST, preserving every other test's
+# relative order: if a slow run hits the timeout, the truncation eats
+# the newest coverage first instead of pushing long-standing tests past
+# the kill point.
+_RUN_LAST = ("tests/test_explorer.py", "TestScheduleValidation",
+             "TestSoakResumeReplay", "test_shrink_deterministic")
+
+
+def pytest_collection_modifyitems(config, items):
+    late = [it for it in items if any(k in it.nodeid for k in _RUN_LAST)]
+    if late:
+        rest = [it for it in items if it not in set(late)]
+        items[:] = rest + late

@@ -7,6 +7,7 @@ tests/test_dataplane.py (TestChaosFaultParity) next to the fault-free
 parity it extends."""
 
 import importlib.util
+import json
 import os
 
 import jax
@@ -518,16 +519,20 @@ class TestResubPolicyHook:
         assert stranded > 0
 
 
+def _load_soak():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            "scripts", "chaos_soak.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 class TestSoakSmoke:
     def _soak(self):
-        spec = importlib.util.spec_from_file_location(
-            "chaos_soak", os.path.join(
-                os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__))),
-                "scripts", "chaos_soak.py"))
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return mod
+        return _load_soak()
 
     def test_single_cell_smoke(self, tmp_path):
         """One tiny lossy_combo cell converges after heal and writes no
@@ -570,3 +575,204 @@ class TestSoakSmoke:
                         "--postmortem-dir", str(tmp_path)])
         assert rc == 0
         assert sum(1 for _ in open(out)) == 6
+
+
+class TestScheduleValidation:
+    """ISSUE 7 satellite: events that would silently never fire are
+    named ValueErrors, raised from every compile wiring point (static
+    make_step, make_run_scan's horizon check, the sharded dataplane and
+    the batched explorer's table stacker)."""
+
+    def test_builders_reject_malformed_events(self):
+        with pytest.raises(ValueError, match="round must be >= 0"):
+            ChaosSchedule().crash(-1, (0, 3))
+        with pytest.raises(ValueError, match="bad node range"):
+            ChaosSchedule().crash(1, (5, 2))
+        with pytest.raises(ValueError, match="partition id"):
+            ChaosSchedule().partition(1, (0, 3), 0)
+        with pytest.raises(ValueError, match="drop window"):
+            ChaosSchedule().drop(1, dst=0, rounds=0)
+        with pytest.raises(ValueError, match="drop_typ type"):
+            ChaosSchedule().drop_typ(1, typ=-1)
+
+    def test_validate_round_past_horizon(self):
+        sched = ChaosSchedule().heal(50)
+        with pytest.raises(ValueError,
+                           match=r"heal @ round 50.*would never apply"):
+            sched.validate(n_rounds=30)
+        sched.validate(n_rounds=51)  # in range -> returns self
+
+    def test_validate_node_range_out_of_cluster(self):
+        sched = ChaosSchedule().crash(1, (4, 20))
+        with pytest.raises(ValueError,
+                           match=r"node range \(4, 20\) out of"):
+            sched.validate(n_nodes=16)
+        sched.validate(n_nodes=32)
+
+    def test_validate_msg_src_dst_out_of_cluster(self):
+        with pytest.raises(ValueError, match=r"src/dst .* out of"):
+            ChaosSchedule().drop(1, dst=99).validate(n_nodes=16)
+        with pytest.raises(ValueError, match=r"dst 99 out of"):
+            ChaosSchedule().drop_typ(1, typ=0, dst=99).validate(
+                n_nodes=16)
+
+    def test_validate_wire_type_out_of_protocol(self):
+        sched = ChaosSchedule().drop_typ(1, typ=9)
+        with pytest.raises(ValueError, match="wire type 9 out of"):
+            sched.validate(n_types=4)
+        sched.validate(n_types=10)
+
+    def test_validate_partition_gid_collision(self):
+        # both halves labelled gid 1 -> every node in one group, which
+        # is no partition at all
+        sched = (ChaosSchedule()
+                 .partition(5, (0, 7), 1)
+                 .partition(5, (8, 15), 1))
+        with pytest.raises(ValueError, match="gid collision at round 5"):
+            sched.validate(n_nodes=16)
+        # distinct gids are the real split
+        (ChaosSchedule()
+         .partition(5, (0, 7), 1)
+         .partition(5, (8, 15), 2)).validate(n_nodes=16)
+
+    def test_make_step_validates_static_schedule(self):
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=0)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="out of"):
+            pt.make_step(cfg, proto,
+                         chaos=ChaosSchedule().crash(1, (4, 20)))
+
+    def test_make_run_scan_validates_horizon(self):
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=0)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="would never apply"):
+            pt.make_run_scan(cfg, proto, 10,
+                             chaos=ChaosSchedule().heal(50))
+
+    @needs_mesh
+    def test_sharded_step_validates_static_schedule(self):
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import make_sharded_step
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=0)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="out of"):
+            make_sharded_step(cfg, proto, make_mesh(n_devices=8),
+                              chaos=ChaosSchedule().crash(1, (4, 20)))
+
+    def test_explorer_stack_validates_before_compile(self):
+        # _stack_inputs validates every schedule host-side, so the bad
+        # table is rejected before any trace/compile happens
+        from partisan_tpu.verify.explorer import SETUPS, Explorer
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, seed=5)
+        proto, world = SETUPS["acked_uniform"](cfg)
+        ex = Explorer(cfg, proto, n_rounds=12, n_events=2, batch=1,
+                      world=world, heal_margin=2)
+        with pytest.raises(ValueError, match="would never apply"):
+            ex.run_batch([ChaosSchedule().drop(40, dst=1)])
+        with pytest.raises(ValueError, match="out of"):
+            ex.run_batch([ChaosSchedule().drop(1, dst=30)])
+
+
+class TestSoakResumeReplay:
+    """ISSUE 7 satellites: --checkpoint/--resume crash-resume of the
+    campaign through the shard-aware checkpointer, and --replay of a
+    fault-space counterexample artifact through the soak CLI."""
+
+    # the tier-1 smoke cell shape (cache-shared with TestSoakSmoke)
+    _BASE = ["--n", "64", "--rounds", "60", "--window", "20",
+             "--mixes", "lossy_combo", "--heal-margin", "25"]
+
+    def test_resume_requires_checkpoint(self):
+        soak = _load_soak()
+        with pytest.raises(SystemExit):
+            soak.main(["--smoke", "--resume"])
+
+    @pytest.mark.slow
+    def test_kill_and_resume_rows_bit_match(self, tmp_path):
+        """Kill the campaign after cell 1 of 2 (--fail-after), resume
+        from the checkpoint, and assert the resumed BENCH rows equal an
+        uninterrupted run's rows bit-for-bit (modulo wall-clock).
+
+        slow-tier: four full soak cells (~26 s warm) on the 1-vCPU box;
+        tier-1 keeps the --resume arg/ledger/integrity gates below."""
+        soak = _load_soak()
+        base = self._BASE + ["--seeds", "1,2",
+                             "--postmortem-dir", str(tmp_path)]
+        ck = str(tmp_path / "ckpt")
+        killed = str(tmp_path / "killed.jsonl")
+        rc = soak.main(base + ["--out", killed, "--checkpoint", ck,
+                               "--fail-after", "1"])
+        assert rc == 3
+        # the kill happens before BENCH is written: the checkpoint is
+        # the only survivor, holding the finished cell's row + world
+        assert not os.path.exists(killed)
+        extra = checkpoint.load_extra(ck)
+        assert extra["completed"] == [["lossy_combo", 1]]
+        assert len(extra["rows"]) == 1
+
+        resumed = str(tmp_path / "resumed.jsonl")
+        rc = soak.main(base + ["--out", resumed, "--checkpoint", ck,
+                               "--resume"])
+        assert rc == 0
+
+        ref = str(tmp_path / "ref.jsonl")
+        rc = soak.main(base + ["--out", ref])
+        assert rc == 0
+
+        def rows(path):
+            return [{k: v for k, v in json.loads(line).items()
+                     if k not in ("wall_s", "rounds_per_sec")}
+                    for line in open(path)]
+
+        got, want = rows(resumed), rows(ref)
+        assert len(got) == 2
+        assert got == want
+
+    def test_resume_refuses_mismatched_cluster(self, tmp_path):
+        """The integrity gate: resuming with a checkpoint whose world
+        was saved at a different n_nodes fails loudly, not silently."""
+        soak = _load_soak()
+        ck = str(tmp_path / "ckpt")
+        cfg = pt.Config(n_nodes=32, inbox_cap=16, seed=1)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        checkpoint.save(ck, cfg, world,
+                        extra={"completed": [], "rows": []},
+                        proto="HyParView")
+        # corrupt the manifest's n_nodes so config and arrays disagree
+        man = os.path.join(ck, "manifest.json")
+        doc = json.load(open(man))
+        doc["config"]["n_nodes"] = 64
+        json.dump(doc, open(man, "w"))
+        with pytest.raises(ValueError, match="checkpoint leaf"):
+            soak.main(self._BASE + [
+                "--seeds", "1", "--out", str(tmp_path / "o.jsonl"),
+                "--postmortem-dir", str(tmp_path),
+                "--checkpoint", ck, "--resume"])
+
+    def test_replay_cli_reproduces_counterexample(self, tmp_path):
+        """`chaos_soak.py --replay cx.json` rebuilds the named setup,
+        re-runs the schedule through the B=1 vmapped checker, writes a
+        flight-recorder postmortem and exits 0 on reproduction."""
+        from partisan_tpu.verify import explorer
+        soak = _load_soak()
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, seed=5,
+                        retransmit_interval=2,
+                        retransmit_backoff_factor=2,
+                        retransmit_max_attempts=2)
+        proto, _ = explorer.SETUPS["acked_uniform"](cfg)
+        sched = ChaosSchedule().drop_typ(
+            1, typ=proto.typ("app"), rounds=25)
+        cx = str(tmp_path / "cx.json")
+        explorer.write_counterexample(
+            cx, setup="acked_uniform", cfg=cfg, sched=sched,
+            invariant="no_dead_letter_loss", first_violation_round=13,
+            n_rounds=30, heal_margin=5, n_events=4, original_events=3)
+        rc = soak.main(["--replay", cx,
+                        "--postmortem-dir", str(tmp_path)])
+        assert rc == 0
+        trace = (tmp_path /
+                 "counterexample_acked_uniform_no_dead_letter_loss.trace")
+        assert trace.exists()
+        from partisan_tpu.verify.trace import read_trace
+        assert read_trace(str(trace)), "empty postmortem trace"

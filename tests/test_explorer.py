@@ -1,0 +1,362 @@
+"""Batched fault-space explorer tests (ISSUE 7): B=1 vmapped-vs-static
+bit-identity on 60-round HyParView, device-checked invariants, trace- and
+seed-driven frontier generation, batched counterexample shrinking and the
+replayable JSON artifact.
+
+The HyParView explorer program (vmapped scan, n=16, 60 rounds) is the
+expensive compile in this module — every test here shares ONE
+module-scoped Explorer so the program compiles once and lands in the
+persistent ``.jax_cache`` (tests/conftest.py points JAX at it)."""
+
+import jax
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu.models.full_membership import FullMembership
+from partisan_tpu.verify import ChaosSchedule, explorer
+from partisan_tpu.verify.chaos import (KIND_DROP_TYP, KIND_PARTITION,
+                                       DynamicSchedule)
+from partisan_tpu.verify.explorer import Explorer, SETUPS
+from partisan_tpu.verify.trace import TraceEntry
+
+pytestmark = pytest.mark.standard
+
+
+def leaves_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def batch_elem(tree, b):
+    """Select batch element ``b`` from every leaf of a vmapped output."""
+    return jax.tree_util.tree_map(lambda l: np.asarray(l)[b], tree)
+
+
+# ------------------------------------------------------------- HyParView
+#
+# ONE explorer instance for the module: n=16, 60 rounds, 10-event tables,
+# compiled batch width 1 (the B=1 bit-identity contract is the acceptance
+# gate; the batched verdict machinery is exercised on the cheap-to-compile
+# AckedDelivery program below).
+
+HYP_ROUNDS = 60
+
+
+@pytest.fixture(scope="module")
+def hyp():
+    cfg = pt.Config(n_nodes=16, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto, world = SETUPS["hyparview_tree"](cfg)
+    ex = Explorer(cfg, proto, n_rounds=HYP_ROUNDS, n_events=10, batch=1,
+                  world=world, heal_margin=12)
+    return cfg, proto, world, ex
+
+
+# every event kind in one table: crash + recover, a healed split-brain,
+# pair-drop, type-drop, delay and duplication
+RICH = (ChaosSchedule().crash(8, (4, 7))
+        .partition(10, (0, 7), 1).partition(10, (8, 15), 2)
+        .drop(12, dst=3, rounds=5).drop_typ(13, typ=1, rounds=3)
+        .delay(14, src=2, extra=2).duplicate(16)
+        .heal(30).recover(32, (4, 7)))
+
+
+class TestVmapParity:
+    def test_b1_bit_identical_to_static(self, hyp):
+        """The acceptance gate: a B=1 vmapped execution of a schedule
+        exercising EVERY event kind is bit-identical to the static
+        ``make_step(chaos=)`` path — per-round metrics (chaos counters
+        included), final protocol state, fault planes, PRNG keys, round
+        counter and the valid-masked message buffer."""
+        cfg, proto, world, ex = hyp
+        wf, metrics, _ = ex.run_batch_with_metrics([RICH])
+
+        step = pt.make_step(cfg, proto, donate=False, chaos=RICH)
+        w = world
+        rows = []
+        for _ in range(HYP_ROUNDS):
+            w, m = step(w)
+            rows.append({k: int(v) for k, v in m.items()})
+
+        for k in rows[0]:
+            np.testing.assert_array_equal(
+                np.asarray(metrics[k])[0],
+                np.asarray([r[k] for r in rows]), err_msg=k)
+
+        w0 = batch_elem(wf, 0)
+        leaves_equal(w0.state, w.state)
+        for f in ("alive", "partition", "keys", "rnd"):
+            np.testing.assert_array_equal(
+                getattr(w0, f), np.asarray(getattr(w, f)), err_msg=f)
+        # msgs: compact()'s stable sort packs the valid prefix, so the
+        # masks agree slot-for-slot; only dead-slot garbage may differ
+        ma, mb = w0.msgs, w.msgs
+        va = ma.valid.astype(bool)
+        vb = np.asarray(mb.valid).astype(bool)
+        np.testing.assert_array_equal(va, vb)
+        for name in ("src", "dst", "typ", "channel", "lane", "delay",
+                     "born"):
+            np.testing.assert_array_equal(
+                getattr(ma, name)[va],
+                np.asarray(getattr(mb, name))[vb], err_msg=name)
+        for k in ma.data:
+            np.testing.assert_array_equal(
+                ma.data[k][va], np.asarray(mb.data[k])[vb], err_msg=k)
+
+    @pytest.mark.slow
+    def test_planted_partition_found_and_shrunk(self, hyp):
+        """A standing (never-healed) partition hidden among benign events
+        trips ``convergence_after_heal`` on device; the explorer sweep
+        reports exactly that schedule and delta-debugging shrinks it to
+        <= 3 events, partition included, still violating.
+
+        slow-tier: ~70 s of heavy-program dispatches even warm (explore
+        + ddmin on the vmapped HyParView checker).  The same find ->
+        shrink -> replay path runs in CI as scripts/chaos_explore.py's
+        hyparview phase (committed BENCH_explore.jsonl /
+        counterexample_hyparview.json), and shrink/explore mechanics
+        stay tier-1 on the cheap AckedDelivery program below."""
+        cfg, proto, world, ex = hyp
+        benign = ChaosSchedule().drop(3, dst=5, rounds=2)
+        planted = (ChaosSchedule().drop(3, dst=5, rounds=2)
+                   .delay(4, extra=1).partition(6, (0, 7), 1))
+
+        failures = ex.explore([benign, planted])
+        failing_events = {s.events for s, _, _ in failures}
+        assert planted.events in failing_events
+        assert benign.events not in failing_events
+        conv = [(s, r) for s, n, r in failures
+                if n == "convergence_after_heal"]
+        assert conv and all(r >= ex.heal_margin for _, r in conv)
+
+        shrunk = ex.shrink(planted, "convergence_after_heal")
+        assert 1 <= len(shrunk.events) <= 3
+        assert any(e[1] == KIND_PARTITION for e in shrunk.events)
+        verdict = ex.run_batch([shrunk])
+        assert not verdict.ok[0, ex.names.index("convergence_after_heal")]
+
+    def test_dynamic_step_rejects_flight(self, hyp):
+        cfg, proto, _, _ = hyp
+        from partisan_tpu.telemetry.flight import FlightSpec
+        with pytest.raises(ValueError, match="DynamicSchedule"):
+            pt.make_step(cfg, proto, chaos=DynamicSchedule(4),
+                         flight=FlightSpec(window=4, cap=64))
+
+
+# --------------------------------------------------------- AckedDelivery
+#
+# Cheap-to-compile program (seconds) — carries the batched-verdict,
+# shrink-determinism and artifact-roundtrip coverage.
+
+ACK_ROUNDS = 30
+
+
+def acked_cfg():
+    return pt.Config(n_nodes=8, inbox_cap=8, seed=5,
+                     retransmit_interval=2, retransmit_backoff_factor=2,
+                     retransmit_max_attempts=2)
+
+
+@pytest.fixture(scope="module")
+def acked():
+    cfg = acked_cfg()
+    proto, world = SETUPS["acked_uniform"](cfg)
+    ex = Explorer(cfg, proto, n_rounds=ACK_ROUNDS, n_events=4, batch=4,
+                  world=world, heal_margin=5)
+    return cfg, proto, world, ex
+
+
+class TestInvariants:
+    def test_default_selection(self, hyp, acked):
+        """Host inspection picks the invariants the state supports."""
+        assert hyp[3].names == ("convergence_after_heal",
+                                "view_fill_floor")
+        assert acked[3].names == ("no_dead_letter_loss",)
+
+    def test_no_applicable_invariant_raises(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        with pytest.raises(ValueError, match="no explorer invariant"):
+            Explorer(cfg, FullMembership(cfg), n_rounds=4)
+
+    def test_causal_order_selected_and_holds(self):
+        """CausalAcked exposes last_seq/log_n, so the causal-order
+        monotonicity check joins the set — and holds on a clean run."""
+        from partisan_tpu import peer_service as ps
+        from partisan_tpu.qos.causal import CausalAcked
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=2)
+        proto = CausalAcked(cfg)
+        world = pt.init_world(cfg, proto)
+        for i in range(4):
+            world = ps.send_ctl(world, proto, i, "ctl_csend",
+                                peer=(i + 1) % 4, payload=10 + i,
+                                cdelay=0)
+        ex = Explorer(cfg, proto, n_rounds=10, n_events=2, batch=1,
+                      world=world, heal_margin=2)
+        assert "causal_order" in ex.names
+        assert ex.run_batch([ChaosSchedule()]).passed(0)
+
+
+class TestAckedExplorer:
+    def test_dead_letter_found_in_batch(self, acked):
+        """One vmapped batch separates the planted dead-letter bug (a
+        long window dropping the app channel outlasts the bounded
+        retransmit budget) from a survivable blip."""
+        cfg, proto, world, ex = acked
+        bad = ChaosSchedule().drop_typ(1, typ=proto.typ("app"),
+                                       rounds=25)
+        blip = ChaosSchedule().drop(1, dst=1, rounds=2)
+        verdict = ex.run_batch([bad, blip])
+        assert not verdict.passed(0)
+        assert verdict.passed(1)
+        rows = verdict.failures()
+        assert rows == [(0, "no_dead_letter_loss",
+                         int(verdict.first_bad[0, 0]))]
+        assert int(verdict.first_bad[0, 0]) >= 1
+
+    def test_shrink_isolates_planted_event(self, acked):
+        """Delta-debugging strips the benign decoys and returns the
+        1-minimal schedule: the drop_typ event alone."""
+        cfg, proto, world, ex = acked
+        noisy = (ChaosSchedule().drop(2, dst=2, rounds=2)
+                 .delay(3, extra=1)
+                 .drop_typ(1, typ=proto.typ("app"), rounds=25))
+        shrunk = ex.shrink(noisy, "no_dead_letter_loss")
+        assert len(shrunk.events) == 1
+        assert shrunk.events[0][1] == KIND_DROP_TYP
+        assert not ex.run_batch([shrunk]).passed(0)
+        # determinism: same input, same minimal schedule
+        assert ex.shrink(noisy, "no_dead_letter_loss").events \
+            == shrunk.events
+
+    def test_shrink_unknown_invariant(self, acked):
+        with pytest.raises(ValueError, match="unknown invariant"):
+            acked[3].shrink(ChaosSchedule().drop(1), "nope")
+
+    def test_counterexample_roundtrip_replay(self, acked, tmp_path):
+        """write -> read -> replay: the JSON artifact alone rebuilds the
+        world from its named setup and reproduces the violation at the
+        recorded round through a fresh B=1 explorer."""
+        cfg, proto, world, ex = acked
+        bad = ChaosSchedule().drop_typ(1, typ=proto.typ("app"),
+                                       rounds=25)
+        verdict = ex.run_batch([bad])
+        rnd = int(verdict.first_bad[0, 0])
+        path = str(tmp_path / "cx.json")
+        explorer.write_counterexample(
+            path, setup="acked_uniform", cfg=cfg, sched=bad,
+            invariant="no_dead_letter_loss", first_violation_round=rnd,
+            n_rounds=ACK_ROUNDS, heal_margin=5, n_events=4,
+            original_events=3)
+        doc = explorer.read_counterexample(path)
+        assert doc["event_names"] == ["drop_typ@1(a=0, b=-1, c=25)"]
+        rep = explorer.replay_counterexample(path)
+        assert rep["reproduced"]
+        assert rep["first_violation_round"] == rep["expected_round"] \
+            == rnd
+
+    def test_batch_width_overflow_raises(self, acked):
+        with pytest.raises(ValueError, match="compiled batch width"):
+            acked[3].run_batch([ChaosSchedule().drop(1)] * 5)
+
+
+class TestFrontier:
+    def test_frontier_from_trace(self):
+        """Only observed (src, dst, typ) traffic is perturbed; pairs are
+        swept busiest-first; each pair yields a drop window, one
+        drop_typ per type, and a delay — all valid schedules."""
+        entries = ([TraceEntry(2, 0, 1, 0, 0, 0)] * 3
+                   + [TraceEntry(3, 2, 3, 1, 0, 0)] * 5)
+        scheds = explorer.frontier_from_trace(entries, n_rounds=40,
+                                              start=4, window=6)
+        assert len(scheds) == 6
+        for s in scheds:
+            s.validate(n_nodes=4, n_rounds=40, n_types=2)
+        # busiest pair (2 -> 3, typ 1, count 5) leads
+        assert scheds[0].events == ((4, 4, 2, 3, 6),)
+        assert scheds[1].events == ((4, KIND_DROP_TYP, 1, -1, 6),)
+        # deterministic regeneration
+        again = explorer.frontier_from_trace(entries, n_rounds=40,
+                                             start=4, window=6)
+        assert [s.events for s in scheds] == [s.events for s in again]
+
+    def test_frontier_causality_pruning(self, acked):
+        """With causality annotations, pairs whose type is unrelated to
+        the target roots drop out of the frontier."""
+        cfg, proto, world, ex = acked
+        app, ack_t = proto.typ("app"), proto.typ("app_ack")
+        ctl = proto.typ("ctl_send")
+        entries = [TraceEntry(2, 0, 1, app, 0, 0),
+                   TraceEntry(3, 1, 0, ack_t, 0, 0),
+                   TraceEntry(4, 2, 2, ctl, 0, 0)]
+        # annotation map, reference shape: {type: [caused types]}
+        causality = {"app": ["app_ack"], "app_ack": [],
+                     "ctl_send": [],
+                     "__tick__": [], "__background__": []}
+        scheds = explorer.frontier_from_trace(
+            entries, proto, n_rounds=ACK_ROUNDS, causality=causality,
+            target_types=["app"], start=2, window=4)
+        typs = {e[2] for s in scheds for e in s.events
+                if e[1] == KIND_DROP_TYP}
+        assert app in typs and ack_t in typs  # both related to root
+        assert ctl not in typs  # unrelated to app, pruned out
+
+    def test_random_frontier_deterministic_and_valid(self):
+        a = explorer.random_frontier(7, 16, 40, count=12, n_types=3)
+        b = explorer.random_frontier(7, 16, 40, count=12, n_types=3)
+        assert [s.events for s in a] == [s.events for s in b]
+        for s in a:
+            s.validate(n_nodes=16, n_rounds=40, n_types=3)
+        assert len(a) == 12
+
+
+# ----------------------------------------------------------- slow sweeps
+
+@pytest.mark.slow
+class TestHeavySweep:
+    def test_b64_sweep_finds_planted_bug(self):
+        """A 64-wide batch sweeps a seeded-random frontier with the
+        planted dead-letter schedule mixed in; the one violation found
+        is the plant."""
+        cfg = acked_cfg()
+        proto, world = SETUPS["acked_uniform"](cfg)
+        ex = Explorer(cfg, proto, n_rounds=ACK_ROUNDS, n_events=4,
+                      batch=64, world=world, heal_margin=5)
+        frontier = explorer.random_frontier(
+            11, cfg.n_nodes, ACK_ROUNDS, count=63,
+            n_types=len(proto.msg_types))
+        # crash-recover rows can legitimately dead-letter (the dead
+        # destination never acks) — keep the sweep to the msg plane so
+        # the plant is the only expected violation
+        frontier = [s for s in frontier
+                    if not s.has_node_events][:40]
+        plant = ChaosSchedule().drop_typ(1, typ=proto.typ("app"),
+                                         rounds=25)
+        failures = ex.explore(frontier + [plant])
+        assert any(s.events == plant.events for s, _, _ in failures)
+
+    def test_shrink_convergence_soak(self):
+        """Shrinking random failing schedules always terminates at a
+        1-minimal table: the result still fails and every single-event
+        removal passes."""
+        cfg = acked_cfg()
+        proto, world = SETUPS["acked_uniform"](cfg)
+        ex = Explorer(cfg, proto, n_rounds=ACK_ROUNDS, n_events=8,
+                      batch=4, world=world, heal_margin=5)
+        plant = (1, KIND_DROP_TYP, proto.typ("app"), -1, 25)
+        rng = np.random.default_rng(13)
+        for trial in range(4):
+            decoys = explorer.random_frontier(
+                int(rng.integers(0, 1 << 16)), cfg.n_nodes, ACK_ROUNDS,
+                count=3, n_types=len(proto.msg_types))
+            decoys = [s for s in decoys if not s.has_node_events]
+            events = tuple(e for s in decoys for e in s.events)[:7]
+            noisy = ChaosSchedule(events + (plant,))
+            shrunk = ex.shrink(noisy, "no_dead_letter_loss")
+            assert len(shrunk.events) <= len(noisy.events)
+            idx = ex.names.index("no_dead_letter_loss")
+            assert not ex.run_batch([shrunk]).ok[0, idx], trial
+            for i in range(len(shrunk.events)):
+                sub = ChaosSchedule(tuple(
+                    e for j, e in enumerate(shrunk.events) if j != i))
+                assert ex.run_batch([sub]).ok[0, idx], (trial, i)

@@ -68,6 +68,45 @@ class TestProp:
         shrunk = runner._shrink(cmds)
         assert shrunk == [Command("leave", (3,))], shrunk
 
+    def test_shrink_deterministic(self):
+        """ISSUE 7 satellite: same seed + same failure predicate =>
+        bit-identical minimal command list, run after run.  The stub
+        runner skips the engine entirely so this pins the SEARCH's
+        determinism (greedy first-improvement order), not the
+        protocol's."""
+
+        class StubRunner(PropRunner):
+            def __init__(self, n):
+                # no engine: _generate/_shrink only touch self.commands
+                self.commands = ClusterCommands(n, tolerance=2)
+
+            def _execute(self, cmds):
+                verbs = {c.verb for c in cmds}
+                # the "bug": a crash combined with any partition fails
+                if "crash" in verbs and "partition" in verbs:
+                    raise AssertionError("planted")
+
+        runner = StubRunner(8)
+        baseline = None
+        for _ in range(3):
+            cmds = runner._generate(seed=1, n_commands=12)
+            try:
+                runner._execute(cmds)
+                failed = True  # predicate never fired: shrink n/a
+            except AssertionError:
+                failed = True
+                shrunk = runner._shrink(cmds)
+                assert {c.verb for c in shrunk} \
+                    == {"crash", "partition"}
+                assert len(shrunk) == 2
+                if baseline is None:
+                    baseline = shrunk
+                assert shrunk == baseline
+            assert failed
+        assert baseline is not None, \
+            "seed 1 generated no crash+partition pair — pick a seed " \
+            "whose sequence contains both kinds"
+
 
 class TestAnalysis:
     def test_2pc_causality(self):
