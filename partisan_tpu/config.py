@@ -172,6 +172,21 @@ class Config:
     #   notes).  None = always dense (bit-identical results either way;
     #   handlers see the same per-node PRNG keys on both paths).
 
+    # --- workload / SLO plane (workload/, Dean & Barroso tail-at-scale) -----
+    slo_deadline_rounds: int = 16
+    # ^ request deadline in rounds for SLO accounting: a completion with
+    #   latency <= deadline counts rpc_slo_ok, else rpc_slo_violated
+    #   (counted device-side at reply delivery, workload/latency.py).
+    shed_token_rate_milli: int = 0
+    # ^ admission-control token refill, milli-tokens per round per node
+    #   (1000 = 1 admitted request/round sustained).  0 = shedding OFF —
+    #   the workload driver bypasses the bucket entirely.
+    shed_token_burst_milli: int = 4000
+    # ^ token bucket cap (burst size), milli-tokens.
+    shed_max_outstanding: int = 0
+    # ^ per-node outstanding-promise cap at admission: a new request is
+    #   shed when this many calls are already in flight.  0 = no cap.
+
     # --- verification-harness flags (env tier, partisan_config.erl:37-151) --
     tag: Optional[str] = None          # node tag (client/server), TAG env
     replaying: bool = False            # trace replay mode, REPLAY env (:78-85)

@@ -175,6 +175,24 @@ class ProtocolBase:
         count its losses (SURVEY §7.3: never silent)."""
         return {}
 
+    # --- in-scan round counters (ISSUE 8 workload plane) -------------------
+    # Names a protocol wants surfaced through the per-round step metrics
+    # (and, under the sharded dataplane, psum-reduced onto every shard as
+    # extra rows of the SINGLE stacked all-reduce).  Empty (the default)
+    # keeps make_step / make_sharded_step bit-identical to pre-ISSUE-8
+    # programs — the tap only traces when a protocol opts in, so existing
+    # cached executables (e.g. the explorer's) stay valid.
+    round_counter_names: Tuple[str, ...] = ()
+
+    def round_counters(self, state) -> Dict[str, jax.Array]:
+        """Scalar int32 device counters, one per round_counter_names
+        entry, computed from the FULL (shard-local) state after tick.
+        Must be pure shard-local arithmetic: the dataplane sums them
+        across shards via its existing stacked psum, so each shard
+        returns its local partial sum (cumulative counters per node sum
+        to cumulative global counters)."""
+        return {}
+
     # --- emission helpers (used inside handlers) ---------------------------
 
     def no_emit(self, cap: Optional[int] = None) -> Msgs:
@@ -631,6 +649,7 @@ def make_step(
     K = cfg.inbox_cap
     T = proto.tick_emit_cap
     n_types = len(proto.msg_types)
+    rc_names = tuple(proto.round_counter_names)
     out_cap = out_cap or default_out_cap(cfg, proto)
     kernels = make_round_kernels(cfg, proto, N)
     deliver_batch, collect = kernels.deliver_batch, kernels.collect
@@ -819,6 +838,13 @@ def make_step(
         }
         if chaos_counts is not None:
             metrics.update(chaos_counts)
+        # workload-plane round counters (ISSUE 8): traced only when the
+        # protocol opts in, so the default program is byte-identical to
+        # pre-ISSUE-8 builds (persistent-cache stability).
+        if rc_names:
+            rc = proto.round_counters(state)
+            for k in rc_names:
+                metrics[k] = jnp.asarray(rc[k], jnp.int32).reshape(())
         if capture_wire:
             metrics.update(
                 wire_valid=now.valid, wire_src=now.src, wire_dst=now.dst,

@@ -69,6 +69,63 @@ class UpperProtocol(ProtocolBase):
             "lower protocol exposes no peer set; override active_peers")
 
 
+class Lifted(UpperProtocol):
+    """Adapter: run a PLAIN ProtocolBase (one that neither reads nor
+    writes membership state — e.g. qos.rpc.Rpc or the workload driver)
+    as the upper layer of a :class:`Stacked`.  The inner protocol's
+    handlers see only their own rows, so lifting is mechanical: copy the
+    wire surface, delegate handlers/tick/init against ``row.upper``.
+
+    This is what lets the ISSUE-8 load suite drive RPC traffic OVER a
+    membership overlay (``Stacked(HyParView(cfg), Lifted(WorkloadRpc(
+    cfg)))``) without teaching the driver about stacking."""
+
+    def __init__(self, inner: ProtocolBase):
+        assert not isinstance(inner, (Stacked, UpperProtocol)), (
+            "Lifted wraps a plain ProtocolBase (nest Stacked on the "
+            "lower side instead)")
+        self.inner = inner
+        self.msg_types = tuple(inner.msg_types)
+        self.data_spec = dict(inner.data_spec)
+        self.emit_cap = inner.emit_cap
+        self.tick_emit_cap = inner.tick_emit_cap
+        self.ctl_peer_field = inner.ctl_peer_field
+        self.autotune_emit_hint = inner.autotune_emit_hint
+        for t in self.msg_types:
+            setattr(self, "handle_" + t, self._lift(
+                getattr(inner, "handle_" + t)))
+
+    @staticmethod
+    def _lift(h):
+        def f(cfg, me, row: StackState, m, key):
+            up, em = h(cfg, me, row.upper, m, key)
+            return row.replace(upper=up), em
+        return f
+
+    def _rewire(self, spec, emit_cap, offset) -> None:
+        super()._rewire(spec, emit_cap, offset)
+        self.inner._rewire(spec, emit_cap, offset)
+
+    def init_upper(self, cfg: Config, key: jax.Array):
+        return self.inner.init(cfg, key)
+
+    def tick_upper(self, cfg, me, row: StackState, rnd, key):
+        up, em = self.inner.tick(cfg, me, row.upper, rnd, key)
+        return row.replace(upper=up), em
+
+    # Stacked hands the upper layer state.upper for both counter taps,
+    # which is exactly the inner protocol's own state — pure delegation.
+    def health_counters(self, state):
+        return self.inner.health_counters(state)
+
+    @property
+    def round_counter_names(self) -> Tuple[str, ...]:
+        return tuple(self.inner.round_counter_names)
+
+    def round_counters(self, state):
+        return self.inner.round_counters(state)
+
+
 class Stacked(ProtocolBase):
     def __init__(self, lower: ProtocolBase, upper: UpperProtocol):
         # nesting is supported on the LOWER side only: handlers(), init and
@@ -147,4 +204,14 @@ class Stacked(ProtocolBase):
     def health_counters(self, state: StackState):
         out = dict(self.lower.health_counters(state.lower))
         out.update(self.upper.health_counters(state.upper))
+        return out
+
+    @property
+    def round_counter_names(self) -> Tuple[str, ...]:
+        return (tuple(self.lower.round_counter_names)
+                + tuple(self.upper.round_counter_names))
+
+    def round_counters(self, state: StackState):
+        out = dict(self.lower.round_counters(state.lower))
+        out.update(self.upper.round_counters(state.upper))
         return out

@@ -299,12 +299,17 @@ def build_inbox_idx(
     return idx, vld, overflow
 
 
-def inject(buf: Msgs, em: Msgs, src) -> Tuple[Msgs, jax.Array]:
+def inject(buf: Msgs, em: Msgs, src, born=0) -> Tuple[Msgs, jax.Array]:
     """Write the valid entries of ``em`` (control-plane commands, host-built)
-    into free slots of the in-flight buffer, stamping ``src``.  Returns
-    (new_buffer, n_dropped) — dropped when the buffer has no free slots."""
+    into free slots of the in-flight buffer, stamping ``src``/``born``.
+    ``born`` should be the injection round (world.rnd): a ctl with delay 0
+    is delivered during the very next step, whose emissions the engine
+    stamps with that same round — so handlers can treat ``m.born`` as the
+    round their own emissions will carry.  Returns (new_buffer, n_dropped)
+    — dropped when the buffer has no free slots."""
     k = em.cap
-    em = em.replace(born=jnp.zeros((k,), jnp.int32))
+    em = em.replace(born=jnp.broadcast_to(
+        jnp.asarray(born, jnp.int32), (k,)))
     free_idx, = jnp.nonzero(~buf.valid, size=k, fill_value=0)
     n_free = jnp.sum(~buf.valid)
     rank = jnp.cumsum(em.valid) - 1          # rank among valid entries

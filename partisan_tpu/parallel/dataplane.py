@@ -308,6 +308,7 @@ def make_sharded_step(
     B = bucket_cap or m_loc
     kernels = make_round_kernels(cfg, proto, n_loc)
     n_types = kernels.n_types
+    rc_names = tuple(proto.round_counter_names)
     _, _, F = _field_layout(proto.data_spec)
     pk_field = "partition_key" if "partition_key" in proto.data_spec \
         else None
@@ -486,6 +487,13 @@ def make_sharded_step(
         ]
         if chaos_counts is not None:
             rows += [chaos_counts[k] for k in _CHAOS_KEYS]
+        if rc_names:
+            # workload-plane round counters (ISSUE 8): shard-local
+            # partial sums riding the SAME stacked psum — the collective
+            # budget is unchanged with the workload plane enabled.
+            rc = proto.round_counters(state)
+            rows += [jnp.asarray(rc[k], jnp.int32).reshape(())
+                     for k in rc_names]
         partials = jnp.stack(rows)
         totals = jax.lax.psum(partials, NODE_AXIS)          # ONE psum
         metrics = {"round": rnd}
@@ -495,7 +503,8 @@ def make_sharded_step(
             return new_world, fring, metrics
         return new_world, metrics
 
-    sum_keys = _SUM_KEYS + (_CHAOS_KEYS if chaos is not None else ())
+    sum_keys = _SUM_KEYS + (_CHAOS_KEYS if chaos is not None else ()) \
+        + rc_names
 
     def spec_of(x):
         return P(NODE_AXIS) if getattr(x, "ndim", 0) >= 1 else P()

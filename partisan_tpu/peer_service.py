@@ -28,7 +28,7 @@ def send_ctl(world: World, proto: ProtocolBase, node: int, typ_name: str,
     goes through."""
     em = proto.emit(jnp.asarray([node], jnp.int32), proto.typ(typ_name),
                     cap=1, delay=delay, channel=channel, **data)
-    msgs, _ = msgops.inject(world.msgs, em, src=node)
+    msgs, _ = msgops.inject(world.msgs, em, src=node, born=world.rnd)
     return world.replace(msgs=msgs)
 
 
@@ -70,7 +70,7 @@ def cluster(world: World, proto: ProtocolBase,
                           for i in range(k)], jnp.int32)
     em = proto.emit(nodes, proto.typ("ctl_join"), cap=k, delay=delays,
                     **{proto.ctl_peer_field: peers})
-    msgs, dropped = msgops.inject(world.msgs, em, src=nodes)
+    msgs, dropped = msgops.inject(world.msgs, em, src=nodes, born=world.rnd)
     if not isinstance(dropped, jax.core.Tracer) and int(dropped) > 0:
         # host path only — inside jit the caller owns overflow accounting
         raise ValueError(
@@ -170,7 +170,7 @@ def forward_batch(world: World, proto: ProtocolBase, records) -> World:
                         jnp.int32),
         partition_key=jnp.asarray([r.get("partition_key", -1)
                                    for r in records], jnp.int32))
-    msgs, dropped = msgops.inject(world.msgs, em, src=srcs)
+    msgs, dropped = msgops.inject(world.msgs, em, src=srcs, born=world.rnd)
     if not isinstance(dropped, jax.core.Tracer) and int(dropped) > 0:
         raise ValueError(f"in-flight buffer too small for the forward "
                          f"batch ({int(dropped)} of {k} dropped); raise "

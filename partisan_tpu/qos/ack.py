@@ -271,7 +271,11 @@ def emit_ring_events(state, label: str = "ack") -> Dict[str, int]:
     from .. import telemetry
     out: Dict[str, int] = {}
     for event, field in (("send_ring_overflow", "send_dropped"),
-                         ("dead_letter", "dead_lettered")):
+                         ("dead_letter", "dead_lettered"),
+                         # rpc promise-ring losses (ISSUE 8 satellite:
+                         # qos/rpc.py call_dropped gets the same host
+                         # event surface as ack-ring overflow)
+                         ("call_ring_overflow", "call_dropped")):
         arr = getattr(state, field, None)
         if arr is None:
             continue

@@ -24,6 +24,7 @@ from ..config import Config
 from ..engine import ProtocolBase
 from ..ops import ring
 from ..ops.msg import Msgs
+from ..workload import latency
 
 
 @struct.dataclass
@@ -34,6 +35,12 @@ class RpcRow:
     prom_result: jax.Array   # [P]
     prom_done: jax.Array     # [P] reply arrived
     call_dropped: jax.Array  # scalar — calls lost to a full promise ring
+    # --- workload plane (ISSUE 8): request birth + latency histogram ---
+    prom_birth: jax.Array    # [P] round the call was issued
+    lat_hist: jax.Array      # [K] log2-bucketed completion latencies
+    lat_sum: jax.Array       # scalar — sum of observed latencies (rounds)
+    slo_ok: jax.Array        # scalar — completions within the deadline
+    slo_violated: jax.Array  # scalar — completions past the deadline
 
 
 def init_rows(n_nodes: int, promise_cap: int = 8) -> RpcRow:
@@ -45,6 +52,11 @@ def init_rows(n_nodes: int, promise_cap: int = 8) -> RpcRow:
         prom_result=jnp.zeros((n, promise_cap), jnp.int32),
         prom_done=jnp.zeros((n, promise_cap), bool),
         call_dropped=jnp.zeros((n,), jnp.int32),
+        prom_birth=jnp.zeros((n, promise_cap), jnp.int32),
+        lat_hist=jnp.zeros((n, latency.N_BUCKETS), jnp.int32),
+        lat_sum=jnp.zeros((n,), jnp.int32),
+        slo_ok=jnp.zeros((n,), jnp.int32),
+        slo_violated=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -80,11 +92,18 @@ class Rpc(ProtocolBase):
         ok = ok & (dst >= 0)
         ref = row.next_ref
         wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+        # birth round of the request: host injections stamp the ctl with
+        # born = world.rnd, and a delay-0 ctl is delivered during the very
+        # next step — whose emissions the engine stamps with that same
+        # round.  So the rpc_req we emit NOW carries born == m.born, and
+        # that is the birth the latency sample must be measured from.
+        # Delay knobs don't apply to the loopback ctl leg.
         row = row.replace(
             next_ref=ref + 1,
             prom_valid=wr(row.prom_valid, True),
             prom_ref=wr(row.prom_ref, ref),
             prom_done=wr(row.prom_done, False),
+            prom_birth=wr(row.prom_birth, m.born),
             call_dropped=row.call_dropped
             + ((~ok) & (dst >= 0)).astype(jnp.int32),
         )
@@ -103,10 +122,42 @@ class Rpc(ProtocolBase):
     def handle_rpc_reply(self, cfg, me, row: RpcRow, m: Msgs, key):
         """Fulfil the promise and free its slot for reuse (the reference's
         promise backend discards resolved promises); the done flag and
-        result stay readable until the slot is reallocated."""
+        result stay readable until the slot is reallocated.
+
+        Completion is also the latency observation point (ISSUE 8): the
+        current round is recoverable from the reply itself — a message
+        born at round r is delivered at r + 1 + delay, and the engine's
+        emission-time delay is the ingress+egress sum — so
+        ``now = m.born + 1 + ingress + egress`` and the sample is
+        ``now - prom_birth`` at the matched slot.  Duplicate replies
+        (retransmission) can't double-count: the first delivery clears
+        prom_valid, so ``hit`` is empty on re-delivery.
+        """
         hit = row.prom_valid & (row.prom_ref == m.data["ref"])
+        got = jnp.any(hit)
+        now = m.born + 1 + cfg.ingress_delay + cfg.egress_delay
+        birth = jnp.sum(jnp.where(hit, row.prom_birth, 0))
+        lat = jnp.maximum(now - birth, 0)
+        hist, lat_sum = latency.observe(row.lat_hist, row.lat_sum,
+                                        lat, got)
+        slo_ok, slo_bad = latency.slo_observe(
+            row.slo_ok, row.slo_violated, lat, got,
+            cfg.slo_deadline_rounds)
         row = row.replace(
             prom_valid=row.prom_valid & ~hit,
             prom_done=row.prom_done | hit,
-            prom_result=jnp.where(hit, m.data["result"], row.prom_result))
+            prom_result=jnp.where(hit, m.data["result"], row.prom_result),
+            lat_hist=hist, lat_sum=lat_sum,
+            slo_ok=slo_ok, slo_violated=slo_bad)
         return row, self.no_emit()
+
+    def health_counters(self, state: RpcRow):
+        """Promise-ring losses + the SLO/latency plane (ISSUE 8: the
+        call_dropped counter finally has a reader — telemetry ring +
+        host event tap, the PR-4 ack-ring-overflow treatment)."""
+        out = {"rpc_call_dropped": jnp.sum(state.call_dropped),
+               "rpc_slo_ok": jnp.sum(state.slo_ok),
+               "rpc_slo_violated": jnp.sum(state.slo_violated)}
+        out.update(latency.hist_counters(
+            "rpc_latency", state.lat_hist, state.lat_sum))
+        return out

@@ -73,6 +73,14 @@ def collect_round_metrics(proto: ProtocolBase, world: World,
     for k, name in ENGINE_KEYMAP.items():
         if k in step_metrics and name in registry:
             vals[name] = step_metrics[k]
+    # workload-plane round counters (ISSUE 8): a protocol's opt-in
+    # round_counter_names surface in step metrics under their REGISTRY
+    # names already — pass them straight through.  No-op (and identical
+    # HLO) when the protocol doesn't opt in or the registry doesn't
+    # carry the names.
+    for k, v in step_metrics.items():
+        if k not in ENGINE_KEYMAP and k in registry:
+            vals[k] = v
     views = _find_views(world.state)
     if views is not None and "isolated" in registry:
         vs = metrics_mod.view_stats(views, world.alive)

@@ -31,6 +31,10 @@ from .registry import MetricRegistry, all_help, all_kinds
 
 Row = Mapping[str, object]
 
+# the workload-plane histogram naming convention (workload/latency.py):
+# per-bucket ring columns "fam__bucket_<upper-edge-or-inf>" + "fam__sum"
+_HIST_BUCKET_RE = re.compile(r"^(?P<fam>.+)__bucket_(?P<bound>\d+|inf)$")
+
 
 class TelemetrySink(Protocol):
     def write_row(self, row: Row) -> None: ...
@@ -121,21 +125,62 @@ class PrometheusSink:
     def _fmt(self, v: float) -> str:
         return repr(int(v)) if float(v).is_integer() else repr(float(v))
 
+    def _hist_families(self) -> Dict[str, Dict[str, float]]:
+        """Group gauge columns following the workload-plane histogram
+        naming (``fam__bucket_<bound>`` + ``fam__sum``, see
+        workload/latency.py) into native histogram families.  A family
+        only qualifies when its ``__sum`` column is present — bare
+        ``__bucket_`` lookalikes keep rendering as plain gauges."""
+        fams: Dict[str, Dict[str, float]] = {}
+        for name, v in self._gauges.items():
+            m = _HIST_BUCKET_RE.match(name)
+            if m is not None:
+                fams.setdefault(m["fam"], {})[m["bound"]] = v
+        return {f: b for f, b in fams.items()
+                if f"{f}__sum" in self._gauges}
+
     def expose(self) -> str:
         """Render the Prometheus text exposition format, one family per
-        metric: ``# HELP`` / ``# TYPE`` headers then the sample line."""
+        metric: ``# HELP`` / ``# TYPE`` headers then the sample line.
+        Bucketed ring metrics render as NATIVE histograms — cumulative
+        ``le`` buckets plus ``_sum``/``_count`` — instead of a pile of
+        per-bucket gauges."""
         ns = self.namespace
         lines: List[str] = []
+        hists = self._hist_families()
+        hidden = {n for f, b in hists.items()
+                  for n in [f"{f}__sum"]
+                  + [f"{f}__bucket_{bound}" for bound in b]}
         for name in sorted(self._counters):
             fam = f"{ns}_{name}_total"
             lines.append(f"# HELP {fam} {self._help.get(name, name)}")
             lines.append(f"# TYPE {fam} counter")
             lines.append(f"{fam} {self._fmt(self._counters[name])}")
         for name in sorted(self._gauges):
+            if name in hidden:
+                continue
             fam = f"{ns}_{name}"
             lines.append(f"# HELP {fam} {self._help.get(name, name)}")
             lines.append(f"# TYPE {fam} gauge")
             lines.append(f"{fam} {self._fmt(self._gauges[name])}")
+        for name in sorted(hists):
+            fam = f"{ns}_{name}"
+            buckets = hists[name]
+            finite = sorted((b for b in buckets if b != "inf"), key=int)
+            help_text = self._help.get(
+                name + "__sum", f"Latency histogram {name} (rounds).")
+            lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} histogram")
+            cum = 0.0
+            for b in finite:
+                cum += buckets[b]
+                lines.append(
+                    f'{fam}_bucket{{le="{b}"}} {self._fmt(cum)}')
+            total = cum + buckets.get("inf", 0.0)
+            lines.append(f'{fam}_bucket{{le="+Inf"}} {self._fmt(total)}')
+            lines.append(
+                f"{fam}_sum {self._fmt(self._gauges[name + '__sum'])}")
+            lines.append(f"{fam}_count {self._fmt(total)}")
         if self._events:
             fam = f"{ns}_events_total"
             lines.append(f"# HELP {fam} Host telemetry events by name.")

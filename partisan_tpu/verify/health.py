@@ -80,6 +80,38 @@ QOS_SPECS = (
                "retransmission give-up."),
     MetricSpec("relay_expired", GAUGE,
                "Cumulative relays dropped at TTL 0 / no next hop."),
+    MetricSpec("rpc_call_dropped", GAUGE,
+               "Cumulative RPC calls lost to a full promise ring "
+               "(qos/rpc.py call_dropped — the ack-ring-overflow "
+               "treatment, ISSUE 8 satellite: counted AND read)."),
+)
+
+# The workload / SLO plane (ISSUE 8): all cumulative device counters
+# (GAUGE kind per the rule above) except wl_outstanding, a true gauge.
+WORKLOAD_SPECS = (
+    MetricSpec("wl_issued", GAUGE,
+               "Cumulative workload requests issued (admitted and "
+               "promise-ring-allocated)."),
+    MetricSpec("wl_shed", GAUGE,
+               "Cumulative requests refused by admission control "
+               "(token bucket / outstanding cap, workload/shed.py)."),
+    MetricSpec("wl_retries", GAUGE,
+               "Cumulative workload rpc_req retransmissions."),
+    MetricSpec("wl_dead_lettered", GAUGE,
+               "Cumulative workload promises abandoned at the "
+               "retransmission give-up threshold."),
+    MetricSpec("wl_outstanding", GAUGE,
+               "Requests currently in flight across all promise rings."),
+    MetricSpec("rpc_slo_ok", GAUGE,
+               "Cumulative completions within slo_deadline_rounds."),
+    MetricSpec("rpc_slo_violated", GAUGE,
+               "Cumulative completions past slo_deadline_rounds."),
+    MetricSpec("otp_slo_ok", GAUGE,
+               "Cumulative gen_server replies within the deadline."),
+    MetricSpec("otp_slo_violated", GAUGE,
+               "Cumulative gen_server replies past the deadline."),
+    MetricSpec("otp_timed_out", GAUGE,
+               "Currently timed-out gen_server call slots."),
 )
 
 
@@ -92,6 +124,20 @@ def health_registry(extra: Sequence[MetricSpec] = (),
     reg = default_registry(disabled)
     return reg.with_specs(HEALTH_SPECS + CHAOS_SPECS + QOS_SPECS
                           + tuple(extra))
+
+
+def workload_registry(extra: Sequence[MetricSpec] = (),
+                      disabled: Optional[Iterable[str]] = None
+                      ) -> MetricRegistry:
+    """health_registry + the workload/SLO counters and the rpc latency
+    histogram family — the ring layout of the load suite and the chaos
+    soak's workload arm."""
+    from ..workload import latency as _latency
+    return health_registry(
+        WORKLOAD_SPECS + _latency.latency_specs(
+            "rpc_latency",
+            "RPC request completion latency (rounds).") + tuple(extra),
+        disabled)
 
 
 def default_hops(n: int) -> int:

@@ -1,0 +1,28 @@
+"""Device-side workload plane (ISSUE 8): compiled traffic generators,
+in-scan latency histograms, and SLO-driven load shedding.
+
+Submodules:
+
+* :mod:`.arrivals` — per-node arrival processes (Poisson thinning,
+  on/off bursts, diurnal ramp, Zipf destinations, closed loop).
+* :mod:`.latency` — log2-bucketed latency histograms carried in scan
+  state, host folds to p50/p95/p99 + SLO counts.
+* :mod:`.shed` — token-bucket + queue-depth admission control.
+* :mod:`.driver` — :class:`WorkloadRpc`, the Rpc subclass whose tick IS
+  the load generator (imported lazily: driver depends on qos.rpc, which
+  itself imports :mod:`.latency` — a top-level import here would cycle).
+"""
+
+from . import arrivals, latency, shed  # noqa: F401
+
+__all__ = ["arrivals", "latency", "shed", "driver", "WorkloadRpc",
+           "WlRow"]
+
+
+def __getattr__(name):  # PEP 562 lazy loader: break the qos.rpc cycle
+    if name in ("driver", "WorkloadRpc", "WlRow"):
+        from . import driver as _driver
+        if name == "driver":
+            return _driver
+        return getattr(_driver, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

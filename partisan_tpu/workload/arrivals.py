@@ -1,0 +1,167 @@
+"""Compiled per-node arrival processes for the workload plane.
+
+All generators are round-synchronous and per-node: each node's driver
+tick asks "how many new requests do I issue this round?" and gets back a
+``[A]`` boolean issue mask over its ``A`` issue slots (``A`` =
+``ArrivalSpec.max_issue``).  Everything is lax-friendly — the spec is a
+frozen Python dataclass baked into the trace, only ``rnd`` and the PRNG
+key are traced values — so one compiled step serves a whole sweep when
+the offered rate itself is carried in state (see
+:class:`workload.driver.WorkloadRpc`, whose ``wl_rate_milli`` state
+column scales these processes without recompiling).
+
+Rates are expressed in MILLI-requests per round per node (int32), the
+repo's idiom for sub-unit rates under integer-only device arithmetic:
+open-loop kinds realize ``rate_milli`` by binomial thinning — each of
+the ``A`` slots fires with probability ``eff_milli / (1000 * A)`` via a
+uniform draw on the repo PRNG — so the expected issue count per round is
+``eff_milli / 1000`` for any ``eff_milli <= 1000 * A``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Arrival kinds (static Python ints baked into the trace).
+POISSON = 0   # open loop, constant rate
+ONOFF = 1     # open loop, bursty: rate scaled up during ON windows, 0 OFF
+DIURNAL = 2   # open loop, triangle-wave ramp with a fixed period
+CLOSED = 3    # closed loop: keep `closed_target` requests outstanding
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalSpec:
+    """Static description of one arrival process (trace-baked)."""
+    kind: int = POISSON
+    # Issue slots per node per round; also the open-loop thinning width
+    # and the hard per-round issue cap.
+    max_issue: int = 4
+    # ON/OFF burst shape (ONOFF kind): cycle = on_rounds + off_rounds,
+    # ON windows carry burst_milli_scale x the base rate (milli scale,
+    # 1000 = 1x), OFF windows are silent.
+    on_rounds: int = 8
+    off_rounds: int = 24
+    burst_milli_scale: int = 4000
+    # DIURNAL: triangle wave over `diurnal_period` rounds, scaling the
+    # base rate from 0 up to 2x and back (mean = base rate).
+    diurnal_period: int = 64
+    # Zipf destination skew (milli exponent s; 0 = uniform).  Applied in
+    # pick_dsts via an inverse-CDF table baked at build time.
+    zipf_milli_s: int = 0
+    # CLOSED: outstanding requests each client keeps in flight.
+    closed_target: int = 1
+
+    def validate(self) -> "ArrivalSpec":
+        if self.kind not in (POISSON, ONOFF, DIURNAL, CLOSED):
+            raise ValueError(f"unknown arrival kind {self.kind}")
+        if self.max_issue < 1:
+            raise ValueError("max_issue must be >= 1")
+        if self.kind == ONOFF and self.on_rounds + self.off_rounds < 1:
+            raise ValueError("on_rounds + off_rounds must be >= 1")
+        if self.kind == DIURNAL and self.diurnal_period < 2:
+            raise ValueError("diurnal_period must be >= 2")
+        if self.kind == CLOSED and not (
+                1 <= self.closed_target <= self.max_issue):
+            raise ValueError("closed_target must be in [1, max_issue]")
+        return self
+
+
+def rate_scale_milli(spec: ArrivalSpec, rnd: jax.Array) -> jax.Array:
+    """Round-dependent rate multiplier (milli, 1000 = 1x) for the
+    open-loop kinds; CLOSED ignores it."""
+    rnd = jnp.asarray(rnd, jnp.int32)
+    if spec.kind == ONOFF:
+        cycle = spec.on_rounds + spec.off_rounds
+        on = (rnd % cycle) < spec.on_rounds
+        return jnp.where(on, jnp.int32(spec.burst_milli_scale),
+                         jnp.int32(0))
+    if spec.kind == DIURNAL:
+        p = spec.diurnal_period
+        ph = rnd % p
+        # triangle 0 -> 2000 -> 0 (mean 1000): rises over the first half.
+        half = p // 2
+        up = (2000 * ph) // half
+        down = 2000 - (2000 * (ph - half)) // max(p - half, 1)
+        return jnp.where(ph < half, up, down).astype(jnp.int32)
+    return jnp.int32(1000)
+
+
+def issue_mask(spec: ArrivalSpec, rate_milli: jax.Array, rnd: jax.Array,
+               outstanding: jax.Array, key: jax.Array) -> jax.Array:
+    """``[A]`` bool: which issue slots fire this round for one node.
+
+    Open loop: each slot independently fires with probability
+    ``eff_milli / (1000 * A)`` (binomial thinning; ``eff_milli`` is the
+    base rate scaled by :func:`rate_scale_milli` and clipped to the
+    ``1000 * A`` realizable ceiling).  Closed loop: the first
+    ``clip(closed_target - outstanding, 0, A)`` slots fire — the next
+    call is issued as soon as a reply (or drop) frees a slot.
+    """
+    a = spec.max_issue
+    if spec.kind == CLOSED:
+        want = jnp.clip(jnp.int32(spec.closed_target)
+                        - jnp.asarray(outstanding, jnp.int32), 0, a)
+        return jnp.arange(a, dtype=jnp.int32) < want
+    eff = (jnp.asarray(rate_milli, jnp.int32)
+           * rate_scale_milli(spec, rnd)) // 1000
+    eff = jnp.clip(eff, 0, 1000 * a)
+    draws = jax.random.randint(key, (a,), 0, 1000 * a, dtype=jnp.int32)
+    return draws < eff
+
+
+# ------------------------------------------------------- destinations
+
+def zipf_cdf_milli(n: int, milli_s: int, table: int = 256) -> np.ndarray:
+    """Quantized inverse-CDF table for Zipf(s) over ``n`` destinations:
+    ``table`` int32 node ids such that a uniform draw over the table
+    approximates the Zipf mass (host-built, baked into the trace).
+    ``milli_s == 0`` degenerates to uniform striding."""
+    if milli_s <= 0:
+        return (np.arange(table, dtype=np.int64) * n // table).astype(
+            np.int32)
+    s = milli_s / 1000.0
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), s)
+    cdf = np.cumsum(w) / w.sum()
+    q = (np.arange(table, dtype=np.float64) + 0.5) / table
+    return np.searchsorted(cdf, q, side="left").astype(np.int32)
+
+
+def pick_dsts(spec: ArrivalSpec, me: jax.Array, n: int,
+              key: jax.Array) -> jax.Array:
+    """``[A]`` int32 destination ids — Zipf-skewed (or uniform) over the
+    id space, with self remapped to the next node so a request always
+    leaves the client."""
+    a = spec.max_issue
+    if spec.zipf_milli_s > 0:
+        tbl = jnp.asarray(
+            zipf_cdf_milli(n, spec.zipf_milli_s), jnp.int32)
+        idx = jax.random.randint(key, (a,), 0, tbl.shape[0],
+                                 dtype=jnp.int32)
+        dst = tbl[idx]
+    else:
+        dst = jax.random.randint(key, (a,), 0, n, dtype=jnp.int32)
+    me = jnp.asarray(me, jnp.int32)
+    return jnp.where(dst == me, (dst + 1) % n, dst)
+
+
+def expected_issue_per_round(spec: ArrivalSpec, rate_milli: int) -> float:
+    """Host-side expectation of issues/round/node for open-loop kinds
+    (mean over a full burst/ramp cycle), used by tests and the load
+    suite's offered-load axis."""
+    cap = 1000.0 * spec.max_issue
+    if spec.kind == POISSON:
+        return min(float(rate_milli), cap) / 1000.0
+    if spec.kind == ONOFF:
+        cyc = spec.on_rounds + spec.off_rounds
+        on = min(rate_milli * spec.burst_milli_scale / 1000.0, cap)
+        return on * spec.on_rounds / cyc / 1000.0
+    if spec.kind == DIURNAL:
+        # triangle has mean scale 1000 (approximately, up to integer
+        # quantization) -> same mean as POISSON.
+        return min(float(rate_milli), cap) / 1000.0
+    raise ValueError("expected_issue_per_round is open-loop only")

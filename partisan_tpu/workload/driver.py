@@ -1,0 +1,279 @@
+"""The compiled traffic generator: an :class:`qos.rpc.Rpc` subclass
+whose TICK is the load driver — every node is simultaneously an RPC
+client (arrival process + admission control + promise ring) and server
+(the inherited ``lax.switch`` function table), so offered load scales
+with N exactly like the serving fabric under test.
+
+Per-node tick pipeline (all shard-local arithmetic — the sharded
+dataplane's 2-collective budget holds with the workload plane on):
+
+  1. retransmit: age the promise ring through the QoS exponential-
+     backoff timer (qos/ack.retransmit_backoff, Config retransmit_*
+     knobs); due slots re-emit their ``rpc_req`` (counted wl_retries),
+     slots past the give-up threshold are dead-lettered — freed and
+     counted (wl_dead_lettered), never retried silently.  Retransmitted
+     requests keep their ORIGINAL birth round (the promise ring, not the
+     wire, carries the birth), so retries lengthen — never reset — the
+     measured latency.
+  2. arrivals: the :class:`workload.arrivals.ArrivalSpec` decides how
+     many of the ``A`` issue slots want to fire (open-loop thinning at
+     ``wl_rate_milli`` — a STATE column, so one compiled step serves a
+     whole offered-load sweep — or closed-loop outstanding top-up).
+  3. admission (workload/shed.py): token bucket + outstanding cap when
+     the Config shed knobs engage; refusals count wl_shed.
+  4. issue: admitted slots allocate a promise (birth = current round),
+     pick a destination (uniform or Zipf), and emit ``rpc_req``; ring-
+     full losses count call_dropped exactly like ctl-injected calls.
+
+Completion latency is recorded by the inherited ``handle_rpc_reply``
+(qos/rpc.py): total completions = sum of the histogram, so there is no
+separate wl_completed counter to drift out of sync.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import Config
+from ..ops import ring
+from ..qos import ack
+from ..qos.rpc import Rpc
+from . import arrivals as arr
+from . import latency, shed
+
+
+@struct.dataclass
+class WlRow:
+    """Superset of RpcRow's field names (the inherited Rpc handlers
+    ``row.replace(...)`` only fields they know, so they run unchanged on
+    this row) plus the driver's issue/retransmit/shed state."""
+    # --- RpcRow fields (qos/rpc.py) ---
+    next_ref: jax.Array
+    prom_valid: jax.Array
+    prom_ref: jax.Array
+    prom_result: jax.Array
+    prom_done: jax.Array
+    call_dropped: jax.Array
+    prom_birth: jax.Array
+    lat_hist: jax.Array
+    lat_sum: jax.Array
+    slo_ok: jax.Array
+    slo_violated: jax.Array
+    # --- retransmission state (echo of the acked layers' ring) ---
+    prom_dst: jax.Array      # [P] where the request went
+    prom_fn: jax.Array       # [P]
+    prom_arg: jax.Array      # [P]
+    prom_age: jax.Array      # [P]
+    prom_attempt: jax.Array  # [P]
+    # --- driver state ---
+    wl_rate_milli: jax.Array    # scalar — offered rate (state => sweepable)
+    wl_tokens_milli: jax.Array  # scalar — shed token bucket
+    wl_issued: jax.Array        # scalar — admitted AND ring-allocated
+    wl_shed: jax.Array          # scalar — refused at admission
+    wl_retries: jax.Array       # scalar — rpc_req retransmissions
+    wl_dead_lettered: jax.Array  # scalar — promises abandoned at give-up
+
+
+class WorkloadRpc(Rpc):
+    """RPC + compiled load generator (ISSUE 8 tentpole).
+
+    ``spec`` fixes the arrival process shape at trace time;
+    ``rate_milli`` seeds the per-node offered rate (milli-requests per
+    round, mutable in state via :meth:`set_rate`).  Works standalone
+    over full mesh routing or stacked over a membership layer via
+    ``models.stack.Lifted`` — destinations are node ids, which the
+    engine routes point-to-point either way.
+    """
+
+    def __init__(self, cfg: Config,
+                 fns: Sequence[Callable[[jax.Array], jax.Array]] = (),
+                 promise_cap: int = 16,
+                 spec: arr.ArrivalSpec = arr.ArrivalSpec(),
+                 rate_milli: int = 1000):
+        super().__init__(cfg, fns, promise_cap)
+        self.spec = spec.validate()
+        self.A = spec.max_issue
+        self.rate_milli = int(rate_milli)
+        self.tick_emit_cap = self.P + self.A
+        # issue burst + per-promise retransmit pressure
+        self.autotune_emit_hint = 2 * (self.P + self.A)
+        self.round_counter_names = (
+            "wl_issued", "wl_shed", "wl_retries", "wl_dead_lettered",
+            "wl_outstanding", "rpc_call_dropped", "rpc_slo_ok",
+            "rpc_slo_violated") + latency.family_names("rpc_latency")
+
+    def init(self, cfg: Config, key: jax.Array) -> WlRow:
+        base = super().init(cfg, key)
+        n, P = cfg.n_nodes, self.P
+        # four DISTINCT buffers — reusing one array object for several
+        # donated leaves trips XLA's double-donation check
+        def z():
+            return jnp.zeros((n,), jnp.int32)
+        return WlRow(
+            **{f: getattr(base, f) for f in (
+                "next_ref", "prom_valid", "prom_ref", "prom_result",
+                "prom_done", "call_dropped", "prom_birth", "lat_hist",
+                "lat_sum", "slo_ok", "slo_violated")},
+            prom_dst=jnp.full((n, P), -1, jnp.int32),
+            prom_fn=jnp.zeros((n, P), jnp.int32),
+            prom_arg=jnp.zeros((n, P), jnp.int32),
+            prom_age=jnp.zeros((n, P), jnp.int32),
+            prom_attempt=jnp.zeros((n, P), jnp.int32),
+            wl_rate_milli=jnp.full((n,), self.rate_milli, jnp.int32),
+            wl_tokens_milli=jnp.full(
+                (n,), cfg.shed_token_burst_milli, jnp.int32),
+            wl_issued=z(), wl_shed=z(), wl_retries=z(),
+            wl_dead_lettered=z(),
+        )
+
+    # ------------------------------------------------------------- verbs
+
+    def handle_ctl_call(self, cfg, me, row: WlRow, m, key):
+        """Host-injected calls also arm the retransmit state (the base
+        handler only parks the promise)."""
+        ok0, slot = ring.alloc(row.prom_valid)
+        dst = m.data["peer"]
+        ok = ok0 & (dst >= 0)
+        row, em = super().handle_ctl_call(cfg, me, row, m, key)
+        wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+        row = row.replace(
+            prom_dst=wr(row.prom_dst, dst),
+            prom_fn=wr(row.prom_fn, m.data["fn"]),
+            prom_arg=wr(row.prom_arg, m.data["arg"]),
+            prom_age=wr(row.prom_age, 0),
+            prom_attempt=wr(row.prom_attempt, 0))
+        return row, em
+
+    # -------------------------------------------------------------- tick
+
+    def tick(self, cfg, me, row: WlRow, rnd, key):
+        P, A = self.P, self.A
+        # 1. retransmit / dead-letter over the promise ring
+        valid, age, attempt, due, dead = ack.retransmit_backoff(
+            row.prom_valid, row.prom_age, row.prom_attempt, me,
+            **ack.backoff_kw(cfg))
+        re_em = self.emit(
+            jnp.where(due, row.prom_dst, -1), self.typ("rpc_req"),
+            cap=P, ref=row.prom_ref, fn=row.prom_fn, arg=row.prom_arg)
+        row = row.replace(
+            prom_valid=valid, prom_age=age, prom_attempt=attempt,
+            wl_retries=row.wl_retries
+            + jnp.sum(due.astype(jnp.int32)),
+            wl_dead_lettered=row.wl_dead_lettered + dead)
+
+        # 2. arrivals
+        k_issue, k_dst = jax.random.split(key)
+        outstanding = jnp.sum(row.prom_valid.astype(jnp.int32))
+        want = arr.issue_mask(self.spec, row.wl_rate_milli, rnd,
+                              outstanding, k_issue)
+
+        # 3. admission control (Config knobs; rate 0 = bucket bypass)
+        use_shed = (cfg.shed_token_rate_milli > 0
+                    or cfg.shed_max_outstanding > 0)
+        if use_shed:
+            if cfg.shed_token_rate_milli > 0:
+                tokens = shed.refill(row.wl_tokens_milli,
+                                     cfg.shed_token_rate_milli,
+                                     cfg.shed_token_burst_milli)
+            else:
+                tokens = jnp.int32(1000 * A)  # never the binding limit
+            adm, tokens_out, shed_n = shed.admit(
+                tokens, want, outstanding, cfg.shed_max_outstanding)
+            if cfg.shed_token_rate_milli > 0:
+                row = row.replace(wl_tokens_milli=tokens_out)
+            row = row.replace(wl_shed=row.wl_shed + shed_n)
+        else:
+            adm = want
+
+        # 4. issue admitted slots (static unroll over A; sequential refs)
+        dsts = arr.pick_dsts(self.spec, me, cfg.n_nodes, k_dst)
+        pv, pref, pdone, pbirth = (row.prom_valid, row.prom_ref,
+                                   row.prom_done, row.prom_birth)
+        pdst, pfn, parg = row.prom_dst, row.prom_fn, row.prom_arg
+        page, patt = row.prom_age, row.prom_attempt
+        ref0 = row.next_ref
+        out_dst, out_ref = [], []
+        issued = jnp.int32(0)
+        dropped = jnp.int32(0)
+        for i in range(A):
+            ok, slot = ring.alloc(pv)
+            ok = ok & adm[i]
+            wr = lambda a, v: ring.masked_set(a, slot, ok, v)
+            ref_i = ref0 + i
+            pv = wr(pv, True)
+            pref = wr(pref, ref_i)
+            pdone = wr(pdone, False)
+            pbirth = wr(pbirth, rnd)
+            pdst = wr(pdst, dsts[i])
+            pfn = wr(pfn, 0)
+            parg = wr(parg, rnd)
+            page = wr(page, 0)
+            patt = wr(patt, 0)
+            out_dst.append(jnp.where(ok, dsts[i], -1))
+            out_ref.append(ref_i)
+            issued = issued + ok.astype(jnp.int32)
+            dropped = dropped + (adm[i] & ~ok).astype(jnp.int32)
+        # arg = birth round: the server's identity fn echoes it back, so
+        # a host observer can recompute every latency sample from the
+        # reply wire alone (the parity test's ground truth).
+        issue_em = self.emit(
+            jnp.stack(out_dst), self.typ("rpc_req"), cap=A,
+            ref=jnp.stack(out_ref), fn=0, arg=rnd)
+        row = row.replace(
+            next_ref=ref0 + A,
+            prom_valid=pv, prom_ref=pref, prom_done=pdone,
+            prom_birth=pbirth, prom_dst=pdst, prom_fn=pfn,
+            prom_arg=parg, prom_age=page, prom_attempt=patt,
+            wl_issued=row.wl_issued + issued,
+            call_dropped=row.call_dropped + dropped)
+        return row, self.merge(re_em, issue_em, cap=self.tick_emit_cap)
+
+    # ----------------------------------------------------------- metrics
+
+    def health_counters(self, state: WlRow):
+        out = dict(super().health_counters(state))
+        out.update(self._wl_counters(state))
+        return out
+
+    def _wl_counters(self, state: WlRow) -> Dict[str, jax.Array]:
+        return {
+            "wl_issued": jnp.sum(state.wl_issued),
+            "wl_shed": jnp.sum(state.wl_shed),
+            "wl_retries": jnp.sum(state.wl_retries),
+            "wl_dead_lettered": jnp.sum(state.wl_dead_lettered),
+            "wl_outstanding": jnp.sum(
+                state.prom_valid.astype(jnp.int32)),
+        }
+
+    def round_counters(self, state: WlRow) -> Dict[str, jax.Array]:
+        """In-scan per-round tap (engine metrics / the dataplane's
+        stacked psum): same names as health_counters, shard-local sums
+        of cumulative per-node counters."""
+        return dict(self.health_counters(state))
+
+    # ------------------------------------------------------ host helpers
+
+    def set_rate(self, state: WlRow, rate_milli: int) -> WlRow:
+        """Rewrite the offered rate IN STATE — no recompile: the sweep
+        reuses one compiled scan across every load point."""
+        return state.replace(wl_rate_milli=jnp.full_like(
+            state.wl_rate_milli, jnp.int32(rate_milli)))
+
+    def reset_stats(self, state: WlRow, burst_milli: int) -> WlRow:
+        """Zero the measurement plane (histogram + counters) between
+        sweep points; the promise ring and refs carry over, so back-to-
+        back windows measure steady state, not cold start."""
+        z = jnp.zeros_like(state.wl_issued)
+        return state.replace(
+            lat_hist=jnp.zeros_like(state.lat_hist),
+            lat_sum=jnp.zeros_like(state.lat_sum),
+            slo_ok=jnp.zeros_like(state.slo_ok),
+            slo_violated=jnp.zeros_like(state.slo_violated),
+            call_dropped=z, wl_issued=z, wl_shed=z, wl_retries=z,
+            wl_dead_lettered=z,
+            wl_tokens_milli=jnp.full_like(
+                state.wl_tokens_milli, jnp.int32(burst_milli)))

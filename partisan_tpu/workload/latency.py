@@ -1,0 +1,184 @@
+"""Log2-bucketed request-latency histograms, carried in the scan state.
+
+The device cannot afford per-request host transfers, so latency folds
+into a fixed ``[K]`` bucket-counter vector per node (telemetry-ring
+style: cumulative device counters, one host transfer per window, host
+folds to quantiles).  Bucketing is INTEGER arithmetic only — bucket ``i``
+holds latencies in ``(2^(i-1), 2^i]`` rounds (bucket 0: ``<= 1``; the
+last bucket is the ``+Inf`` overflow) — so the device counts bit-match
+:func:`host_bucket_index` exactly, which is what the parity test pins
+(no float ``log2`` whose rounding could diverge between XLA and numpy).
+
+Naming convention for the telemetry ring / Prometheus plane: a histogram
+family ``fam`` occupies ``K + 1`` ring columns —
+``fam__bucket_<bound>`` (per-bucket counts, bound = the bucket's
+inclusive upper edge in rounds, ``inf`` for the overflow bucket) and
+``fam__sum`` (sum of observed latencies).  The columns are CUMULATIVE
+device counters and therefore export with GAUGE kind (the PR-4 rule:
+a Prometheus sink accumulates COUNTER rows as deltas, which would
+double-count a cumulative series); :class:`telemetry.sinks.
+PrometheusSink` recognizes the ``__bucket_`` pattern and renders the
+family as a native ``# TYPE ... histogram`` with cumulative ``le``
+buckets plus ``_sum``/``_count``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry.registry import GAUGE, MetricSpec
+
+# K buckets: upper edges 2^0 .. 2^(K-2) rounds, then +Inf.  2^14 = 16384
+# rounds covers every soak horizon in the repo; anything slower is tail
+# enough that "overflow" is the right answer.
+N_BUCKETS = 16
+BUCKET_EDGES: Tuple[int, ...] = tuple(2 ** i for i in range(N_BUCKETS - 1))
+
+
+def bucket_label(i: int) -> str:
+    """Stable name fragment for bucket ``i`` (its upper edge, in rounds)."""
+    return str(BUCKET_EDGES[i]) if i < N_BUCKETS - 1 else "inf"
+
+
+BUCKET_NAMES: Tuple[str, ...] = tuple(
+    bucket_label(i) for i in range(N_BUCKETS))
+
+
+# ----------------------------------------------------------------- device
+
+def bucket_index(lat: jax.Array) -> jax.Array:
+    """int32 bucket index for latency ``lat`` (rounds) — pure integer
+    comparisons against the static edge table, jit/vmap-safe."""
+    edges = jnp.asarray(BUCKET_EDGES, jnp.int32)
+    lat = jnp.asarray(lat, jnp.int32)
+    return jnp.sum(lat[..., None] > edges, axis=-1).astype(jnp.int32)
+
+
+def observe(hist: jax.Array, lat_sum: jax.Array, lat: jax.Array,
+            ok: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Fold ONE latency sample into a node's ``[K]`` bucket row (masked:
+    ``ok`` False leaves both untouched).  Runs per node under the
+    engine's vmap."""
+    okx = jnp.asarray(ok, bool)
+    hist = hist.at[bucket_index(lat)].add(okx.astype(hist.dtype))
+    lat_sum = lat_sum + jnp.where(okx, jnp.asarray(lat, lat_sum.dtype), 0)
+    return hist, lat_sum
+
+
+def slo_observe(slo_ok: jax.Array, slo_violated: jax.Array,
+                lat: jax.Array, ok: jax.Array, deadline: int
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Exact SLO accounting at completion time: ``deadline`` is in rounds
+    (Config.slo_deadline_rounds); counted device-side so the verdict does
+    not depend on the deadline landing on a bucket edge."""
+    okx = jnp.asarray(ok, bool)
+    good = okx & (jnp.asarray(lat, jnp.int32) <= jnp.int32(deadline))
+    return (slo_ok + good.astype(slo_ok.dtype),
+            slo_violated + (okx & ~good).astype(slo_violated.dtype))
+
+
+def hist_counters(family: str, hist: jax.Array, lat_sum: jax.Array
+                  ) -> Dict[str, jax.Array]:
+    """Registry-named scalar taps for a ``[N, K]`` per-node histogram —
+    per-bucket totals summed over (shard-local) nodes plus the latency
+    sum.  Shard-local arithmetic: under the dataplane these rows ride
+    the single stacked metric psum."""
+    tot = jnp.sum(jnp.asarray(hist, jnp.int32), axis=0)
+    out = {f"{family}__bucket_{BUCKET_NAMES[i]}": tot[i]
+           for i in range(N_BUCKETS)}
+    out[f"{family}__sum"] = jnp.sum(lat_sum).astype(jnp.int32)
+    return out
+
+
+def family_names(family: str) -> Tuple[str, ...]:
+    """The ring-column names :func:`hist_counters` emits, in order."""
+    return tuple(f"{family}__bucket_{b}" for b in BUCKET_NAMES) \
+        + (f"{family}__sum",)
+
+
+def latency_specs(family: str, help_text: str = "") -> Tuple[MetricSpec, ...]:
+    """MetricSpecs for one histogram family (GAUGE kind — cumulative
+    device counters; the Prometheus sink renders the family as a native
+    histogram from the ``__bucket_`` naming)."""
+    h = help_text or f"Request latency histogram family {family}."
+    specs = [MetricSpec(f"{family}__bucket_{b}", GAUGE,
+                        f"{h} Cumulative count of completions with "
+                        f"latency <= {b} rounds bucket edge "
+                        f"(per-bucket, non-cumulative column).")
+             for b in BUCKET_NAMES]
+    specs.append(MetricSpec(f"{family}__sum", GAUGE,
+                            f"{h} Sum of observed latencies (rounds)."))
+    return tuple(specs)
+
+
+# ------------------------------------------------------------- host twin
+
+def host_bucket_index(lat) -> np.ndarray:
+    """Bit-exact numpy twin of :func:`bucket_index`."""
+    edges = np.asarray(BUCKET_EDGES, np.int32)
+    lat = np.asarray(lat, np.int32)
+    return np.sum(lat[..., None] > edges, axis=-1).astype(np.int32)
+
+
+def host_hist(lats: Sequence[int]) -> np.ndarray:
+    """[K] int32 histogram of latency samples, host-exact."""
+    out = np.zeros((N_BUCKETS,), np.int32)
+    if len(lats):
+        np.add.at(out, host_bucket_index(np.asarray(list(lats))), 1)
+    return out
+
+
+# ------------------------------------------------------------ host folds
+
+def quantile_bound(hist, q: float) -> float:
+    """Upper-bound estimate of the ``q`` quantile from bucket counts:
+    the upper edge (rounds) of the first bucket at which the cumulative
+    count reaches ``ceil(q * total)``; ``inf`` when it lands in the
+    overflow bucket, ``0.0`` on an empty histogram."""
+    h = np.asarray(hist, np.float64)
+    total = h.sum()
+    if total <= 0:
+        return 0.0
+    k = max(1, int(math.ceil(q * total)))
+    idx = int(np.searchsorted(np.cumsum(h), k, side="left"))
+    if idx >= N_BUCKETS - 1:
+        return float("inf")
+    return float(BUCKET_EDGES[idx])
+
+
+def fold_quantiles(hist) -> Dict[str, float]:
+    """The window fold the load suite / chaos soak report: p50/p95/p99
+    upper bounds in rounds."""
+    return {"p50": quantile_bound(hist, 0.50),
+            "p95": quantile_bound(hist, 0.95),
+            "p99": quantile_bound(hist, 0.99)}
+
+
+def hist_from_row(row: Dict[str, float], family: str) -> np.ndarray:
+    """Recover the [K] bucket vector from one flushed ring row (or any
+    name->value mapping carrying the family's columns)."""
+    return np.asarray(
+        [row.get(f"{family}__bucket_{b}", 0.0) for b in BUCKET_NAMES],
+        np.float64)
+
+
+def window_delta(rows: List[Dict[str, float]], family: str,
+                 start_round: int = -1) -> np.ndarray:
+    """Bucket-count DELTA over a flushed window: last row minus the last
+    row at/before ``start_round`` (the columns are cumulative device
+    counters).  ``start_round < 0`` folds from zero (whole run)."""
+    if not rows:
+        return np.zeros((N_BUCKETS,), np.float64)
+    end = hist_from_row(rows[-1], family)
+    if start_round < 0:
+        return end
+    base = np.zeros((N_BUCKETS,), np.float64)
+    for r in rows:
+        if int(r.get("round", -1)) <= start_round:
+            base = hist_from_row(r, family)
+    return end - base

@@ -1,0 +1,82 @@
+"""SLO-driven admission control: per-node token bucket + queue-depth cap.
+
+Overload in a round-synchronous gossip fabric shows up as inbox
+saturation several hops from the client — by the time `inbox_overflow`
+counts losses, latency has already blown past any deadline.  The shed
+plane refuses work at ADMISSION instead: each node holds an integer
+token bucket (milli-tokens, refilled `shed_token_rate_milli` per round,
+capped at `shed_token_burst_milli`) and a promise-outstanding cap
+(`shed_max_outstanding`).  A request the arrival process wants to issue
+is admitted only if a full token is available AND the cap has room;
+refusals increment `wl_shed` — shed work is COUNTED, never silent,
+which is the graceful-degradation contract the load suite asserts
+(p99 held within SLO past the knee, sheds visible in the bench rows).
+
+Pure shard-local integer arithmetic — no collectives, so the sharded
+dataplane's 2-collective budget is untouched with shedding enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def refill(tokens_milli: jax.Array, rate_milli: int,
+           burst_milli: int) -> jax.Array:
+    """One round of token refill (saturating at the burst cap)."""
+    return jnp.minimum(
+        jnp.asarray(tokens_milli, jnp.int32) + jnp.int32(rate_milli),
+        jnp.int32(burst_milli))
+
+
+def admit(tokens_milli: jax.Array, want: jax.Array,
+          outstanding: jax.Array, max_outstanding: int
+          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Admission decision for one node's ``[A]`` wanted-issue mask.
+
+    Returns ``(admitted [A] bool, tokens_milli', shed_count)``.  Slots
+    are considered in order: slot ``i`` is admitted iff a full token
+    (1000 milli) remains after funding the slots admitted before it
+    and, when ``max_outstanding > 0``, the outstanding depth including
+    those slots stays below the cap.  Tokens are only charged for
+    ADMITTED slots (a depth-capped refusal does not burn a token).
+    ``max_outstanding == 0`` disables the depth cap (Config default).
+    ``A`` is small and static, so the sequential dependency unrolls —
+    still pure per-node arithmetic under the engine's vmap.
+    """
+    want = jnp.asarray(want, bool)
+    tokens = jnp.asarray(tokens_milli, jnp.int32)
+    depth = jnp.asarray(outstanding, jnp.int32)
+    shed = jnp.int32(0)
+    oks = []
+    for i in range(want.shape[0]):
+        fits = want[i] & (tokens >= 1000)
+        if max_outstanding > 0:
+            fits = fits & (depth < jnp.int32(max_outstanding))
+        oks.append(fits)
+        tokens = tokens - jnp.where(fits, jnp.int32(1000), jnp.int32(0))
+        depth = depth + fits.astype(jnp.int32)
+        shed = shed + (want[i] & ~fits).astype(jnp.int32)
+    return jnp.stack(oks), tokens, shed
+
+
+def host_admit(tokens_milli: int, want, outstanding: int,
+               max_outstanding: int):
+    """Plain-Python twin of :func:`admit` for conservation tests."""
+    ok, toks, shed, depth = [], int(tokens_milli), 0, int(outstanding)
+    for w in list(want):
+        if not w:
+            ok.append(False)
+            continue
+        fits = toks >= 1000 and (
+            max_outstanding <= 0 or depth < max_outstanding)
+        ok.append(fits)
+        if fits:
+            toks -= 1000
+            depth += 1
+        else:
+            shed += 1
+    return ok, toks, shed

@@ -217,6 +217,85 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
     return row
 
 
+def run_workload_cell(*, n: int, rounds: int, seed: int, window: int,
+                      heal_margin: int, rate_milli: int = 1000,
+                      out: dict = None) -> dict:
+    """The ISSUE-8 workload arm: a partition_heal cell with app-level
+    RPC traffic riding the overlay, asserting the latency plane RECOVERS
+    after the heal — the post-heal window's p99 (folded from the in-scan
+    histogram deltas) must come back inside the SLO deadline while the
+    sheds/retries/dead-letters that got the fabric through the partition
+    stay counted in the row."""
+    from partisan_tpu.models.stack import Lifted, Stacked
+    from partisan_tpu.workload import arrivals, latency
+    from partisan_tpu.workload.driver import WorkloadRpc
+
+    sched = _mix_partition_heal(n, rounds)
+    heal_rnd = sched.last_heal_round()
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5,
+                    seed=seed,
+                    retransmit_interval=4, retransmit_backoff_factor=2,
+                    retransmit_max_attempts=3, slo_deadline_rounds=16)
+    drv = WorkloadRpc(cfg, promise_cap=16,
+                      spec=arrivals.ArrivalSpec(
+                          kind=arrivals.POISSON, max_issue=4),
+                      rate_milli=rate_milli)
+    proto = Stacked(HyParView(cfg), Lifted(drv))
+    world = ps.cluster(pt.init_world(cfg, proto), proto,
+                       [(i, (i - 1) // 2) for i in range(1, n)])
+    registry = health.workload_registry()
+    sink = _Rows()
+    t0 = time.perf_counter()
+    world, _ = telemetry.run_with_telemetry(
+        cfg, proto, rounds, window=window, registry=registry,
+        sinks=[sink], world=world, step_kw={"chaos": sched})
+    dt = time.perf_counter() - t0
+    if out is not None:
+        out["world"], out["cfg"] = world, cfg
+
+    rows = [r for r in sink.rows if "health_reach_frac" in r]
+    conv = health.converged_round(rows, after=heal_rnd)
+    converged = conv is not None and (conv - heal_rnd) <= heal_margin
+    # latency folds from the cumulative in-scan histogram: the partition
+    # window (fault start -> heal) vs the recovery window (the tail
+    # after the overlay had heal_margin rounds to re-knit)
+    recov_start = min(heal_rnd + heal_margin, rounds - 2)
+    hist_part = latency.window_delta(rows, "rpc_latency",
+                                     start_round=rounds // 4) \
+        - latency.window_delta(rows, "rpc_latency", start_round=heal_rnd)
+    hist_recov = latency.window_delta(rows, "rpc_latency",
+                                      start_round=recov_start)
+    p99_recov = latency.quantile_bound(hist_recov, 0.99)
+    recovered = (hist_recov.sum() > 0
+                 and p99_recov <= cfg.slo_deadline_rounds)
+    last = rows[-1] if rows else {}
+    row = {
+        "bench": "chaos_soak_workload",
+        "mix": "partition_heal",
+        "seed": seed, "n_nodes": n, "rounds": rounds,
+        "rate_milli": rate_milli,
+        "heal_round": heal_rnd, "converged_round": conv,
+        "heal_margin": heal_margin, "converged": bool(converged),
+        "slo_deadline_rounds": cfg.slo_deadline_rounds,
+        "completions_partition": int(hist_part.sum()),
+        "p99_partition": latency.quantile_bound(
+            np.maximum(hist_part, 0), 0.99),
+        "completions_recovery": int(hist_recov.sum()),
+        "p99_recovery": p99_recov,
+        "p99_recovered": bool(recovered),
+        "wl_issued": last.get("wl_issued"),
+        "wl_shed": last.get("wl_shed"),
+        "wl_retries": last.get("wl_retries"),
+        "wl_dead_lettered": last.get("wl_dead_lettered"),
+        "rpc_call_dropped": last.get("rpc_call_dropped"),
+        "rpc_slo_ok": last.get("rpc_slo_ok"),
+        "rpc_slo_violated": last.get("rpc_slo_violated"),
+        "wall_s": round(dt, 2),
+        "rounds_per_sec": round(rounds / dt, 2) if dt > 0 else None,
+    }
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=4096)
@@ -237,6 +316,14 @@ def main(argv=None) -> int:
     ap.add_argument("--resume", action="store_true",
                     help="restore the --checkpoint ledger and continue "
                          "from the first unfinished cell")
+    ap.add_argument("--workload", action="store_true",
+                    help="run the ISSUE-8 workload arm instead of the "
+                         "membership campaign: partition_heal cells "
+                         "with compiled RPC traffic, asserting p99 "
+                         "recovery after the heal")
+    ap.add_argument("--rate-milli", type=int, default=1000,
+                    help="workload arm offered load "
+                         "(milli-requests/round/node)")
     ap.add_argument("--replay", metavar="FILE", default=None,
                     help="re-execute a chaos counterexample JSON "
                          "(verify.explorer / scripts/chaos_explore.py) "
@@ -274,6 +361,32 @@ def main(argv=None) -> int:
     for m in mixes:
         if m not in MIXES:
             ap.error(f"unknown mix {m!r}; have {sorted(MIXES)}")
+
+    if args.workload:
+        rows = []
+        for seed in seeds:
+            row = run_workload_cell(n=args.n, rounds=args.rounds,
+                                    seed=seed, window=args.window,
+                                    heal_margin=args.heal_margin,
+                                    rate_milli=args.rate_milli)
+            rows.append(row)
+            ok = row["converged"] and row["p99_recovered"]
+            print(f"{'PASS' if ok else 'FAIL'} workload seed={seed}: "
+                  f"heal@{row['heal_round']} "
+                  f"converged@{row['converged_round']} "
+                  f"p99_recovery={row['p99_recovery']} "
+                  f"(partition p99={row['p99_partition']}, "
+                  f"shed={row['wl_shed']}, retries={row['wl_retries']}, "
+                  f"dead_lettered={row['wl_dead_lettered']}, "
+                  f"{row['rounds_per_sec']} r/s)")
+        failures = sum(1 for r in rows
+                       if not (r["converged"] and r["p99_recovered"]))
+        with open(args.out, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"\n{len(rows)} workload cells -> {args.out}; "
+              f"{failures} failed p99-recovery-after-heal")
+        return 1 if failures else 0
 
     rows = []
     completed = []  # [mix, seed] pairs, campaign order

@@ -1,0 +1,273 @@
+"""Capacity bench (ISSUE 8): sweep offered load through the workload
+plane, locate the saturation knee, and show SLO-driven shedding holding
+p99 past it.
+
+Each sweep point runs ONE jitted scan — the offered rate is a STATE
+column (``WlRow.wl_rate_milli``), so a single compiled program serves
+every load point; only the shedding arm (different Config knobs) and
+the sharded demo compile separately.  Measurements are window DELTAS of
+the cumulative in-scan counters (the per-round stacked metrics carry
+the full ``rpc_latency`` bucket family), so no mid-scan host resets are
+needed: rounds ``[warm, T)`` of each scan are the measurement window.
+
+Arms:
+  * ``engine``       — unsharded ``engine.make_step``,
+    ``Stacked(HyParView, Lifted(WorkloadRpc))`` at ``--n`` (default
+    4096): the committed BENCH artifact's knee + p99-vs-load curve.
+  * ``engine_shed``  — same, with the admission-control token bucket
+    engaged (``--shed-rate`` milli-tokens/round/node): past the knee,
+    p99 stays within the SLO and refusals are COUNTED in ``wl_shed``.
+  * ``sharded``      — the shard_map dataplane on the 8-device virtual
+    mesh (smaller N; asserts the 2-collective budget workload-on).
+
+Usage:
+    python scripts/load_suite.py                       # full bench
+        [--n 4096] [--rates 1000,2000,3000,4000,6000,8000]
+        [--rounds 32] [--warm 8] [--shed-rate 4000]
+        [--sharded-n 512] [--skip-sharded] [--out BENCH_load.jsonl]
+    python scripts/load_suite.py --smoke               # tiny tier-1 cell
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+_cache = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import peer_service as ps  # noqa: E402
+from partisan_tpu.models.hyparview import HyParView  # noqa: E402
+from partisan_tpu.models.stack import Lifted, Stacked  # noqa: E402
+from partisan_tpu.workload import arrivals, latency  # noqa: E402
+from partisan_tpu.workload.driver import WorkloadRpc  # noqa: E402
+
+PROMISE_CAP = 16
+MAX_ISSUE = 8
+
+
+def make_cfg(n: int, shed_rate: int = 0, seed: int = 1) -> pt.Config:
+    return pt.Config(
+        n_nodes=n, seed=seed,
+        # a retransmit interval above the 2-round RTT, exponential
+        # backoff, bounded attempts: retries self-heal losses without a
+        # same-round retransmit storm
+        retransmit_interval=4, retransmit_backoff_factor=2,
+        retransmit_max_attempts=3,
+        slo_deadline_rounds=16,
+        shed_token_rate_milli=shed_rate,
+        shed_token_burst_milli=4 * max(shed_rate, 1000),
+    )
+
+
+def build(cfg: pt.Config, rate0: int):
+    """Stacked(HyParView, Lifted(WorkloadRpc)) world, overlay pre-joined
+    via the binary-tree contact pattern (scripts/chaos_soak.py)."""
+    n = cfg.n_nodes
+    spec = arrivals.ArrivalSpec(kind=arrivals.POISSON,
+                                max_issue=MAX_ISSUE)
+    drv = WorkloadRpc(cfg, promise_cap=PROMISE_CAP, spec=spec,
+                      rate_milli=rate0)
+    proto = Stacked(HyParView(cfg), Lifted(drv))
+    world = ps.cluster(pt.init_world(cfg, proto), proto,
+                       [(i, (i - 1) // 2) for i in range(1, n)])
+    return proto, drv, world
+
+
+def set_rate(world, drv, rate_milli: int):
+    up = drv.set_rate(world.state.upper, rate_milli)
+    return world.replace(state=world.state.replace(upper=up))
+
+
+def measure(ms, n: int, rounds: int, warm: int, slo: int) -> dict:
+    """Fold one scan's stacked per-round metrics ([T] cumulative device
+    counters) into the measurement-window deltas + quantiles."""
+    def col(name, idx):
+        return float(np.asarray(ms[name])[idx])
+
+    def delta(name):
+        return col(name, rounds - 1) - col(name, warm - 1)
+
+    hist = np.asarray(
+        [delta(f"rpc_latency__bucket_{b}") for b in latency.BUCKET_NAMES])
+    completions = float(hist.sum())
+    win = rounds - warm
+    q = latency.fold_quantiles(hist)
+    slo_ok, slo_bad = delta("rpc_slo_ok"), delta("rpc_slo_violated")
+    return {
+        "completions": int(completions),
+        "throughput_per_node": completions / (n * win),
+        "p50": q["p50"], "p95": q["p95"], "p99": q["p99"],
+        "lat_mean": (delta("rpc_latency__sum") / completions
+                     if completions else None),
+        "slo_ok": int(slo_ok), "slo_violated": int(slo_bad),
+        "goodput_frac": (slo_ok / (slo_ok + slo_bad)
+                         if slo_ok + slo_bad else None),
+        "issued": int(delta("wl_issued")),
+        "shed": int(delta("wl_shed")),
+        "retries": int(delta("wl_retries")),
+        "dead_lettered": int(delta("wl_dead_lettered")),
+        "call_dropped": int(delta("rpc_call_dropped")),
+        "outstanding_end": int(col("wl_outstanding", rounds - 1)),
+    }
+
+
+def sweep(arm: str, cfg: pt.Config, rates, rounds: int, warm: int,
+          sharded: bool = False) -> list:
+    n = cfg.n_nodes
+    proto, drv, world = build(cfg, rates[0])
+    if sharded:
+        from partisan_tpu.parallel import mesh as pmesh
+        from partisan_tpu.parallel.dataplane import (make_sharded_step,
+                                                     place_world)
+        mesh = pmesh.make_mesh()
+        world = place_world(world, mesh)
+        step = make_sharded_step(cfg, proto, mesh, donate=False)
+        comp = step.lower(world).compile()
+        st = pmesh.assert_collective_budget(
+            comp, max_collectives=2, max_bytes=32 * 1024 * 1024,
+            forbid=("all-gather",))
+        print(f"[{arm}] collective budget workload-on: {st['counts']}")
+    else:
+        step = pt.make_step(cfg, proto, donate=False)
+
+    @jax.jit
+    def run_scan(w):
+        return jax.lax.scan(lambda wc, _: step(wc), w, None,
+                            length=rounds)
+
+    rows = []
+    for rate in rates:
+        world = set_rate(world, drv, rate)
+        t0 = time.perf_counter()
+        world, ms = run_scan(world)
+        jax.block_until_ready(world.rnd)
+        dt = time.perf_counter() - t0
+        row = {"bench": "load_suite", "arm": arm, "n_nodes": n,
+               "rate_milli": rate, "offered_per_node": rate / 1000.0,
+               "rounds": rounds, "warm": warm,
+               "slo_deadline_rounds": cfg.slo_deadline_rounds,
+               "shed_token_rate_milli": cfg.shed_token_rate_milli,
+               **measure(ms, n, rounds, warm, cfg.slo_deadline_rounds),
+               "wall_s": round(dt, 2),
+               "rounds_per_sec": round(rounds / dt, 2)}
+        rows.append(row)
+        print(f"[{arm}] rate={rate/1000.0:.1f}/node/rnd "
+              f"tput={row['throughput_per_node']:.2f} "
+              f"p50={row['p50']} p99={row['p99']} "
+              f"shed={row['shed']} retries={row['retries']} "
+              f"({row['rounds_per_sec']} r/s)")
+    return rows
+
+
+def find_knee(rows, util: float = 0.85):
+    """The saturation knee: the last offered rate the fabric still
+    serves at >= ``util`` of offered (completions track arrivals), and
+    the first rate whose p99 blows past the SLO deadline."""
+    knee = None
+    p99_blowup = None
+    for r in rows:
+        offered = r["offered_per_node"]
+        if r["throughput_per_node"] >= util * offered:
+            knee = r["rate_milli"]
+        if p99_blowup is None and (
+                math.isinf(r["p99"])
+                or r["p99"] > r["slo_deadline_rounds"]):
+            p99_blowup = r["rate_milli"]
+    return knee, p99_blowup
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--rates", default="1000,2000,3000,4000,6000,8000")
+    ap.add_argument("--rounds", type=int, default=32)
+    ap.add_argument("--warm", type=int, default=8)
+    ap.add_argument("--shed-rate", type=int, default=4000)
+    ap.add_argument("--sharded-n", type=int, default=512)
+    ap.add_argument("--skip-sharded", action="store_true")
+    ap.add_argument("--skip-shed", action="store_true")
+    ap.add_argument("--out", default="BENCH_load.jsonl")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny cell (n=64, 2 rates) — the tier-1 / "
+                         "suite_matrix smoke configuration")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.n, args.rounds, args.warm = 64, 16, 4
+        args.rates = "2000,8000"
+        args.sharded_n = 64
+        if args.out == "BENCH_load.jsonl":
+            args.out = "/tmp/BENCH_load_smoke.jsonl"
+
+    rates = [int(r) for r in args.rates.split(",") if r]
+    assert args.warm >= 1 and args.rounds > args.warm
+
+    all_rows = []
+    t0 = time.perf_counter()
+
+    base = make_cfg(args.n)
+    all_rows += sweep("engine", base, rates, args.rounds, args.warm)
+    knee, p99_blowup = find_knee(all_rows)
+    print(f"[engine] knee={knee} p99_blowup={p99_blowup}")
+
+    shed_rows = []
+    if not args.skip_shed:
+        shed_cfg = make_cfg(args.n, shed_rate=args.shed_rate)
+        shed_rows = sweep("engine_shed", shed_cfg, rates, args.rounds,
+                          args.warm)
+        all_rows += shed_rows
+
+    if not args.skip_sharded:
+        all_rows += sweep("sharded", make_cfg(args.sharded_n),
+                          rates[:4], args.rounds, args.warm,
+                          sharded=True)
+
+    # the graceful-degradation verdict: past the knee, the shed arm
+    # keeps p99 within the SLO while counting refusals
+    past_knee = [r for r in shed_rows
+                 if knee is not None and r["rate_milli"] > knee]
+    shed_holds = bool(past_knee) and all(
+        not math.isinf(r["p99"])
+        and r["p99"] <= r["slo_deadline_rounds"]
+        and r["shed"] > 0 for r in past_knee)
+    summary = {"bench": "load_suite_summary", "n_nodes": args.n,
+               "knee_rate_milli": knee,
+               "p99_blowup_rate_milli": p99_blowup,
+               "shed_rate_milli": (None if args.skip_shed
+                                   else args.shed_rate),
+               "shed_holds_slo_past_knee": (None if not past_knee
+                                            else shed_holds),
+               "total_wall_s": round(time.perf_counter() - t0, 1)}
+    all_rows.append(summary)
+    print(f"summary: {summary}")
+
+    with open(args.out, "w") as f:
+        for row in all_rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"{len(all_rows)} rows -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -726,6 +726,86 @@ def chaos_soak_smoke():
         assert row["postmortem"] is None, row
 
 
+def latency_parity_test():
+    """ISSUE 8 tentpole contract: the device-side latency histogram of
+    a 30-round closed-loop RPC cell (N=64) bit-matches a host observer
+    that recomputes every sample from the reply wire (the identity
+    server echoes the birth round), and the 8-device sharded run is
+    bit-identical to the unsharded one inside the 2-collective budget.
+    Same program shapes as tests/test_workload.py, shared via the
+    persistent compile cache."""
+    from partisan_tpu.parallel.dataplane import (make_sharded_step,
+                                                 place_world)
+    from partisan_tpu.parallel.mesh import (assert_collective_budget,
+                                            make_mesh)
+    from partisan_tpu.workload import arrivals, latency
+    from partisan_tpu.workload.driver import WorkloadRpc
+    cfg = pt.Config(n_nodes=64, inbox_cap=16, seed=5,
+                    retransmit_interval=100, slo_deadline_rounds=4)
+    proto = WorkloadRpc(cfg, promise_cap=8,
+                        spec=arrivals.ArrivalSpec(
+                            kind=arrivals.CLOSED, closed_target=2,
+                            max_issue=4))
+    rounds, reply_t = 30, proto.typ("rpc_reply")
+    world = pt.init_world(cfg, proto)
+    step = pt.make_step(cfg, proto, donate=False)
+    seen, host_lats = set(), []
+    for t in range(rounds):
+        world, m = step(world)
+        assert int(m["inbox_overflow"]) == 0
+        if t == rounds - 1:
+            break  # in-flight replies after the last step never deliver
+        ms = world.msgs
+        ok = np.asarray(ms.valid) & (np.asarray(ms.typ) == reply_t)
+        dst, born = np.asarray(ms.dst), np.asarray(ms.born)
+        ref = np.asarray(ms.data["ref"])
+        res = np.asarray(ms.data["result"])
+        for i in np.nonzero(ok)[0]:
+            k = (int(dst[i]), int(ref[i]))
+            if k not in seen:
+                seen.add(k)
+                host_lats.append(int(born[i]) + 1 + cfg.ingress_delay
+                                 + cfg.egress_delay - int(res[i]))
+    dev = np.asarray(jnp.sum(world.state.lat_hist, axis=0))
+    assert len(host_lats) > 500
+    assert (dev == latency.host_hist(host_lats)).all(), (dev, host_lats)
+    # sharded twin: bit-identical histogram, budget held workload-on
+    mesh = make_mesh()
+    w2 = place_world(pt.init_world(cfg, proto), mesh)
+    sstep = make_sharded_step(cfg, proto, mesh, donate=False)
+    st = assert_collective_budget(
+        sstep.lower(w2).compile(), max_collectives=2,
+        max_bytes=32 * 1024 * 1024, forbid=("all-gather",))
+    assert st["counts"]["all-to-all"] == 1
+    for _ in range(rounds):
+        w2, _ = sstep(w2)
+    assert (np.asarray(jnp.sum(w2.state.lat_hist, axis=0)) == dev).all()
+
+
+def load_suite_smoke():
+    """ISSUE 8 bench-harness smoke: one tiny single-arm load_suite
+    sweep through the real CLI — the window-delta measurement, knee
+    fold and JSONL schema must hold end to end."""
+    import importlib.util
+    import json
+    import tempfile
+    spec = importlib.util.spec_from_file_location(
+        "load_suite", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "load_suite.py"))
+    ls = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ls)
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "bench.jsonl")
+        rc = ls.main(["--n", "16", "--rates", "1000", "--rounds", "6",
+                      "--warm", "2", "--skip-sharded", "--skip-shed",
+                      "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            rows = [json.loads(line) for line in f]
+    assert rows[-1]["bench"] == "load_suite_summary"
+    assert rows[0]["arm"] == "engine" and rows[0]["completions"] > 0
+
+
 def explorer_parity_test():
     """ISSUE 7 tentpole contract: a B=1 execution through the batched
     fault-space explorer (vmapped scan over a traced chaos table) is
@@ -1363,6 +1443,14 @@ def build_matrix():
         chaos_parity_test)
     add("robustness/chaos", "chaos_soak_smoke", "hyparview", "engine",
         chaos_soak_smoke)
+
+    # ISSUE 8: the device-side workload plane — latency-histogram
+    # parity on both execution paths and the capacity-bench harness
+    # smoke (full offered-load sweeps live in scripts/load_suite.py)
+    add("workload/load", "latency_parity_test", "full", "engine",
+        latency_parity_test)
+    add("workload/load", "load_suite_smoke", "hyparview", "engine",
+        load_suite_smoke)
 
     # ISSUE 7: the batched fault-space explorer — B=1 vmapped/static
     # bit-identity and the find -> shrink -> replay campaign smoke
