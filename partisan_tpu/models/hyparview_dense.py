@@ -48,8 +48,12 @@ view-size distributions against the engine path at N=64-256.
 
 Scale: state is [N, A+P] int32; the only superlinear cost is three
 N-element sorts per round.  N=2^16 fits one chip comfortably; beyond
-that shard the node axis (parallel/mesh.py) — gathers become
-collective-permutes, the sorts become sharded sorts.
+that, parallel/dense_dataplane.py shards the node axis explicitly
+(ISSUE 9): the cross-row gathers of this round become one bucketed
+mail exchange (a single lax.all_to_all per round) and the three global
+sorts become ONE per-shard sort over the received mail
+(ops/shard_exchange.route_select), under an asserted <= 1 all-to-all +
+<= 2 all-reduce, 0 all-gather collective budget.
 """
 
 from __future__ import annotations
@@ -102,47 +106,58 @@ def dense_init(cfg: Config, seeds_per_node: int = 2) -> DenseHvState:
     )
 
 
-def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
-                   ) -> jax.Array:
-    """Route per-node proposals to their targets without scatter
-    conflicts: node i proposes to ``targets[i]`` (−1 = none); each target
-    learns up to ``c`` proposers, ties broken (near-)uniformly at
-    random.  Returns ``[n, c]`` proposer ids (−1 pad).  One sort + one
-    searchsorted + one scatter — the ops/msg.build_inbox recipe with the
-    inbox collapsed to ids, O(n log n), no [n, n] anything.
+# reverse_select moved to ops/shard_exchange.py (ISSUE 9): the sharded
+# dense dataplane reuses it shard-locally (its index space is whatever
+# the caller says, so it never knew about N being global), and ops/
+# cannot import models/.  Re-exported here so every existing caller and
+# test keeps its import path.
+from ..ops.shard_exchange import reverse_select  # noqa: E402,F401
 
-    The sort is a SINGLE uint32 key (target id in the high bits, random
-    tiebreak in the low) with an index payload: the earlier
-    ``lexsort((r, sk))`` was a two-key variadic sort, whose TPU lowering
-    cost ~10x a single-key payload sort and dominated the 2^16 dense
-    round (promotion+shuffle each carry one reverse_select;
-    scripts/profile_dense.py / profile_merge.py — the same lowering
-    cliff lax.top_k hits).  Tiebreak width shrinks as n grows (14 bits
-    at 2^16); within a target's ~c-proposer bucket, low-bit collisions
-    merely make a rare tie deterministic."""
-    m = targets.shape[0]
-    assert n < (1 << 27), "packed reverse_select key needs n < 2^27"
-    bits = 31 - max(n.bit_length(), 1)
-    valid = (targets >= 0) & (targets < n)
-    sk = jnp.where(valid, targets, n).astype(jnp.uint32)
-    r = _mix(jnp.arange(m, dtype=jnp.uint32) ^ salt)
-    packed = (sk << bits) | (r >> (32 - bits))
-    sp, order = jax.lax.sort(
-        (packed, jnp.arange(m, dtype=jnp.int32)), dimension=0, num_keys=1)
-    st = (sp >> bits).astype(jnp.int32)
-    # rank within each target's bucket WITHOUT searchsorted (whose TPU
-    # lowering costs ~8 ms alone at [2^16] — scripts/profile_ops.py):
-    # bucket starts are where the sorted target changes; a running max
-    # of start indices gives each element its bucket's start
-    i = jnp.arange(m, dtype=jnp.int32)
+
+def bulk_passive_merge(active, passive, cands, ids, key):
+    """Fold [N, K] candidate peers into the [N, P] passive views in
+    ONE fused op (add_to_passive_view :1422-1448: not me, not in
+    either view, random-evict when full).  A sequence of K
+    random-evict inserts ends at a random-ish subset of the union;
+    this computes that subset directly — random rank over the
+    deduplicated union, keep P — instead of ~6K scatter/gather
+    kernels (the N=2^16 round was launch-bound on exactly those;
+    the distributional parity tests cover the substitution).
+
+    Two structural choices are chip-measured (scripts/
+    profile_dense.py + profile_merge.py, N=2^16): dedup is ONE
+    value-sort + adjacent-compare (the earlier [N, W, W] pairwise
+    compare and this sort cost the same, but the sort composes with
+    the next point), and the random-P-of-union selection is a
+    two-operand ``lax.sort`` keyed by negated priority — NOT
+    ``lax.top_k``, whose lowering at [N, 62] -> 30 ran the whole
+    merge at 45 merges/s vs 536 for the payload sort (12x;
+    ``approx_max_k`` and a packed single-operand uint32 sort both
+    hit the same slow path).  The kept subset is exact and
+    distribution-identical: descending priority order, first P.
+
+    Row-independent, so the sharded dense round (parallel/
+    dense_dataplane.py) calls it on LOCAL rows with GLOBAL ``ids`` —
+    hence ids is a parameter, not a closure capture."""
+    n = active.shape[0]
+    cat = jnp.concatenate([passive, cands], axis=1)       # [N, W]
+    ok = (cat >= 0) & (cat != ids[:, None])
+    ok &= ~jnp.any(cat[:, :, None] == active[:, None, :], axis=-1)
+    big = jnp.int32(1) << 30
+    sv = jnp.sort(jnp.where(ok, cat, big), axis=1)        # [N, W]
     first = jnp.concatenate(
-        [jnp.ones((1,), bool), st[1:] != st[:-1]])
-    pos = i - jax.lax.cummax(jnp.where(first, i, 0))
-    ok = (st < n) & (pos < c)
-    flat = jnp.where(ok, st * c + jnp.clip(pos, 0, c - 1), n * c)
-    out = jnp.full((n * c + 1,), -1, jnp.int32)
-    out = out.at[flat].set(order)
-    return out[: n * c].reshape((n, c))
+        [jnp.ones((n, 1), bool), sv[:, 1:] != sv[:, :-1]], axis=1)
+    ok2 = (sv < big) & first
+    s32 = jax.random.bits(key, (), jnp.uint32)
+    w = sv.shape[1]
+    assert w <= 256, "merge priority counters pack the slot in 8 bits"
+    ctr = ((jnp.arange(n, dtype=jnp.uint32)[:, None] << 8)
+           | jnp.arange(w, dtype=jnp.uint32)[None, :])
+    pri = jnp.where(ok2, (_mix(ctr ^ s32) >> 8).astype(jnp.float32),
+                    -1.0)
+    _, out = jax.lax.sort((-pri, jnp.where(ok2, sv, -1)),
+                          dimension=1, num_keys=1)
+    return out[:, : passive.shape[1]]
 
 
 def refuse_tpu_shape_bug(n_nodes: int, what: str,
@@ -269,46 +284,6 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                    | jnp.arange(w, dtype=jnp.uint32)[None, :])
             return _mix(ctr ^ s32)
         return rbits
-
-    def bulk_passive_merge(active, passive, cands, key):
-        """Fold [N, K] candidate peers into the [N, P] passive views in
-        ONE fused op (add_to_passive_view :1422-1448: not me, not in
-        either view, random-evict when full).  A sequence of K
-        random-evict inserts ends at a random-ish subset of the union;
-        this computes that subset directly — random rank over the
-        deduplicated union, keep P — instead of ~6K scatter/gather
-        kernels (the N=2^16 round was launch-bound on exactly those;
-        the distributional parity tests cover the substitution).
-
-        Two structural choices are chip-measured (scripts/
-        profile_dense.py + profile_merge.py, N=2^16): dedup is ONE
-        value-sort + adjacent-compare (the earlier [N, W, W] pairwise
-        compare and this sort cost the same, but the sort composes with
-        the next point), and the random-P-of-union selection is a
-        two-operand ``lax.sort`` keyed by negated priority — NOT
-        ``lax.top_k``, whose lowering at [N, 62] -> 30 ran the whole
-        merge at 45 merges/s vs 536 for the payload sort (12x;
-        ``approx_max_k`` and a packed single-operand uint32 sort both
-        hit the same slow path).  The kept subset is exact and
-        distribution-identical: descending priority order, first P."""
-        cat = jnp.concatenate([passive, cands], axis=1)       # [N, W]
-        ok = (cat >= 0) & (cat != ids[:, None])
-        ok &= ~jnp.any(cat[:, :, None] == active[:, None, :], axis=-1)
-        big = jnp.int32(1) << 30
-        sv = jnp.sort(jnp.where(ok, cat, big), axis=1)        # [N, W]
-        first = jnp.concatenate(
-            [jnp.ones((N, 1), bool), sv[:, 1:] != sv[:, :-1]], axis=1)
-        ok2 = (sv < big) & first
-        s32 = jax.random.bits(key, (), jnp.uint32)
-        w = sv.shape[1]
-        assert w <= 256, "merge priority counters pack the slot in 8 bits"
-        ctr = ((jnp.arange(N, dtype=jnp.uint32)[:, None] << 8)
-               | jnp.arange(w, dtype=jnp.uint32)[None, :])
-        pri = jnp.where(ok2, (_mix(ctr ^ s32) >> 8).astype(jnp.float32),
-                        -1.0)
-        _, out = jax.lax.sort((-pri, jnp.where(ok2, sv, -1)),
-                              dimension=1, num_keys=1)
-        return out[:, : passive.shape[1]]
 
     def step(state: DenseHvState) -> DenseHvState:
         key = jax.random.fold_in(
@@ -519,7 +494,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
         if "merge" not in skip and demote:
             passive = bulk_passive_merge(
                 active, passive, jnp.concatenate(demote, axis=1),
-                jax.random.fold_in(key, 50))
+                ids, jax.random.fold_in(key, 50))
 
         return DenseHvState(active=active, passive=passive, alive=alive,
                             rnd=state.rnd + 1,

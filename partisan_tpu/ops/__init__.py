@@ -1,1 +1,1 @@
-from . import bitset, graph, msg, padded_set
+from . import bitset, graph, msg, padded_set, shard_exchange

@@ -1,0 +1,161 @@
+"""Sharded routing primitives for the explicit-SPMD dense dataplane
+(ISSUE 9) — the PR-2 exchange recipe plus the dense models' sort-based
+router, packaged shard-local so `parallel/dense_dataplane.py` can run a
+dense gossip round under the hard collective budget (<= 1 all-to-all +
+<= 2 all-reduce, 0 all-gathers).
+
+Three pieces:
+
+  reverse_select    the dense models' proposal router (moved here from
+                    models/hyparview_dense.py, which re-exports it):
+                    ONE single-key uint32 payload sort that routes
+                    per-row proposals to their targets with a per-target
+                    cap.  Shard-agnostic — it only sees a local index
+                    space — which is exactly why the sharded round can
+                    reuse it: the global N-element sorts of the
+                    unsharded round become per-shard sorts over the
+                    received mail.
+  bucket_exchange   the bucketed packed-int32 `lax.all_to_all` of the
+                    PR-2 sparse dataplane, generalized to a [M, C] int32
+                    mail matrix: rows bucket by destination shard
+                    (argsort + searchsorted, no scatter conflicts),
+                    head-cap overflow is COUNTED (never silent, SURVEY
+                    §7.3), and the single all_to_all moves every bucket
+                    in one collective.
+  route_select      the "counting routing where the key space is the
+                    node id" replacement for the unsharded round's three
+                    global sorts: ONE reverse_select over the combined
+                    (kind, local-destination) key space routes an entire
+                    received mailbox to per-(kind, node) slots — one
+                    local sort per round total, not one per phase.
+
+No imports from parallel/ or models/ (this sits below both): callers
+pass the mesh axis NAME, so the module stays import-cycle-free.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .bitset import mix32 as _mix
+
+
+def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
+                   ) -> jax.Array:
+    """Route per-node proposals to their targets without scatter
+    conflicts: node i proposes to ``targets[i]`` (−1 = none); each target
+    learns up to ``c`` proposers, ties broken (near-)uniformly at
+    random.  Returns ``[n, c]`` proposer ids (−1 pad).  One sort + one
+    searchsorted + one scatter — the ops/msg.build_inbox recipe with the
+    inbox collapsed to ids, O(n log n), no [n, n] anything.
+
+    The sort is a SINGLE uint32 key (target id in the high bits, random
+    tiebreak in the low) with an index payload: the earlier
+    ``lexsort((r, sk))`` was a two-key variadic sort, whose TPU lowering
+    cost ~10x a single-key payload sort and dominated the 2^16 dense
+    round (promotion+shuffle each carry one reverse_select;
+    scripts/profile_dense.py / profile_merge.py — the same lowering
+    cliff lax.top_k hits).  Tiebreak width shrinks as n grows (14 bits
+    at 2^16); within a target's ~c-proposer bucket, low-bit collisions
+    merely make a rare tie deterministic."""
+    m = targets.shape[0]
+    assert n < (1 << 27), "packed reverse_select key needs n < 2^27"
+    bits = 31 - max(n.bit_length(), 1)
+    valid = (targets >= 0) & (targets < n)
+    sk = jnp.where(valid, targets, n).astype(jnp.uint32)
+    r = _mix(jnp.arange(m, dtype=jnp.uint32) ^ salt)
+    packed = (sk << bits) | (r >> (32 - bits))
+    sp, order = jax.lax.sort(
+        (packed, jnp.arange(m, dtype=jnp.int32)), dimension=0, num_keys=1)
+    st = (sp >> bits).astype(jnp.int32)
+    # rank within each target's bucket WITHOUT searchsorted (whose TPU
+    # lowering costs ~8 ms alone at [2^16] — scripts/profile_ops.py):
+    # bucket starts are where the sorted target changes; a running max
+    # of start indices gives each element its bucket's start
+    i = jnp.arange(m, dtype=jnp.int32)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), st[1:] != st[:-1]])
+    pos = i - jax.lax.cummax(jnp.where(first, i, 0))
+    ok = (st < n) & (pos < c)
+    flat = jnp.where(ok, st * c + jnp.clip(pos, 0, c - 1), n * c)
+    out = jnp.full((n * c + 1,), -1, jnp.int32)
+    out = out.at[flat].set(order)
+    return out[: n * c].reshape((n, c))
+
+
+def default_bucket_cap(out_rows: int, n_shards: int) -> int:
+    """Per-(sender, receiver) bucket cap: 2x the uniform share of the
+    sender's outbox, floored at 16 — random destinations concentrate
+    ~Binomial(out_rows, 1/D), so 2x the mean keeps overflow (which is
+    counted, not silent) negligible at every scale the bench sweeps."""
+    return max(16, -(-2 * out_rows // n_shards))
+
+
+def bucket_exchange(mail: jax.Array, n_loc: int, n_shards: int,
+                    bucket_cap: int, axis: str
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Move a shard-local mail matrix to its destination shards in ONE
+    ``lax.all_to_all`` (the PR-2 dataplane exchange, mail-matrix
+    shaped).  ``mail`` is ``[M, C]`` int32 with column 0 = valid flag
+    and column 1 = GLOBAL destination node id; rows bucket by
+    ``dst // n_loc``.  Runs inside shard_map over ``axis``.
+
+    Returns ``(recv [n_shards * bucket_cap, C], dropped scalar)``:
+    ``recv`` is sender-shard-major (shard k's bucket at rows
+    ``[k*B, (k+1)*B)``), empty slots all-zero (valid column 0);
+    ``dropped`` counts rows head-capped out of a full bucket — the
+    caller accumulates it (never silent)."""
+    m = mail.shape[0]
+    d, b = n_shards, bucket_cap
+    valid = mail[:, 0] != 0
+    dst = mail[:, 1]
+    shard = jnp.where(valid, jnp.clip(dst, 0, d * n_loc - 1) // n_loc, d)
+    order = jnp.argsort(shard, stable=True)
+    sk = shard[order]
+    starts = jnp.searchsorted(sk, jnp.arange(d, dtype=sk.dtype))
+    pos = (jnp.arange(m, dtype=jnp.int32)
+           - starts[jnp.clip(sk, 0, d - 1)].astype(jnp.int32))
+    ok = (sk < d) & (pos < b)
+    dropped = jnp.sum((sk < d) & ~ok).astype(jnp.int32)
+    tgt = jnp.where(ok, sk * b + jnp.clip(pos, 0, b - 1), d * b)
+    buck = jnp.zeros((d * b + 1, mail.shape[1]), jnp.int32)
+    buck = buck.at[tgt].set(mail[order])[: d * b]
+    recv = jax.lax.all_to_all(
+        buck.reshape(d, b, mail.shape[1]), axis,
+        split_axis=0, concat_axis=0).reshape(d * b, mail.shape[1])
+    return recv, dropped
+
+
+def route_select(kind: jax.Array, dst_local: jax.Array, valid: jax.Array,
+                 n_kinds: int, n_loc: int, cap: int, salt: jax.Array
+                 ) -> jax.Array:
+    """Route an entire received mailbox to per-(kind, local node) slots
+    with ONE shard-local sort: the combined key space ``kind * n_loc +
+    dst_local`` collapses what the unsharded round did with one global
+    N-element sort PER PHASE into a single per-shard sort per round.
+    Returns ``[n_kinds, n_loc, cap]`` row indices into the mailbox (−1
+    pad); per-kind caps below ``cap`` are taken by slicing columns.
+    Excess rows simply don't appear — callers count them as drops by
+    comparing against the kept-row total."""
+    tgt = jnp.where(valid & (kind >= 0) & (kind < n_kinds),
+                    kind * n_loc + dst_local, -1)
+    sel = reverse_select(tgt, salt, n_kinds * n_loc, cap)
+    return sel.reshape(n_kinds, n_loc, cap)
+
+
+def take_rows(mat: jax.Array, idx: jax.Array) -> jax.Array:
+    """``mat[idx]`` rows with ``idx < 0`` yielding an all −1 row — the
+    models' ``_gather_rows`` for arbitrary-rank ``idx``."""
+    r = mat.shape[0]
+    rows = mat[jnp.clip(idx, 0, r - 1)]
+    return jnp.where((idx >= 0)[..., None], rows, -1)
+
+
+def take_vals(vec: jax.Array, idx: jax.Array) -> jax.Array:
+    """``vec[idx]`` with ``idx < 0`` yielding −1 (scalar column form of
+    :func:`take_rows`)."""
+    r = vec.shape[0]
+    return jnp.where(idx >= 0, vec[jnp.clip(idx, 0, r - 1)], -1)

@@ -1,0 +1,1012 @@
+"""Explicit-SPMD dense dataplane (ISSUE 9 tentpole) — the dense gossip
+rounds of models/{hyparview,scamp,plumtree}_dense re-expressed as
+shard-local arithmetic plus a HARD collective budget:
+
+    <= 1 all-to-all  +  <= 2 all-reduce/collective-permute,  0 all-gathers
+
+per round, asserted by ``mesh.assert_collective_budget`` (vs the 19
+all-gathers + 16 collective-permutes + 5 all-reduces XLA's implicit
+pjit partitioning emits for the same round — MULTICHIP_r06.json).
+
+The structural move is the same one the PR-2 sparse dataplane made,
+applied to the dense round's cross-row reads: every place the unsharded
+round GATHERS another node's row (the repair mutuality check, the
+promotion accept/readback pair, the shuffle walk hops, SCAMP's walker
+table, plumtree's digest) becomes MAIL — a fixed-layout int32 outbox
+carried in the state, moved by ONE bucketed ``lax.all_to_all``
+(ops/shard_exchange.bucket_exchange) at the top of the next round, and
+routed to its destination rows by ONE shard-local sort over the
+combined (kind, local-node) key space (ops/shard_exchange.route_select,
+replacing the unsharded round's three global N-element sorts).  Every
+multi-step interaction pipelines across rounds with a uniform 1-round
+mail latency — which is the latency model the dense round already
+claims for itself ("the message delay of the reference, without the
+message", hyparview_dense.py repair notes).
+
+Mail rows are ``[valid, dst, src, kind, part, p0..p9]`` int32
+(MAIL_COLS = 15); ``part`` is the sender's partition id stamped at
+emission — the receive side drops cross-partition and dead-destination
+rows, which makes the verify-plane semantics (faults.inject_partition /
+chaos node events) hold without any cross-shard read.  The outbox
+layout is STATIC (a fixed slot block per emission site, invalid rows
+flagged off), so every program variant — flat, staggered heavy/light,
+churned, chaos-folded — shares one state shape and composes under
+``dense_cadence.block_scan``.
+
+Protocol re-expression per model (distributional parity vs the
+unsharded round is the bar — SURVEY §7.3 "two RNG semantics" — pinned
+at N=256 on the 8-device CPU mesh in tests/test_dense_dataplane.py):
+
+  hyparview  promotion PROPOSE/ACCEPT mail replaces reverse_select's
+             global routing + acceptance readback; evictions emit
+             DISCONNECT; the shuffle walk carries (origin, ttl, sample)
+             one hop per round; the repair mutuality gather is replaced
+             by KEEPALIVE mail on ``cfg.keepalive_interval`` cadence +
+             a per-slot ``astamp`` TTL (``cfg.keepalive_ttl``) — the
+             exact failure-detection shape config.py already documents
+             for the engine path ("dead/one-sided active edges are
+             detected by keepalive expiry").
+  scamp      walkers live IN the mail (no [N, C] walker table): JOIN
+             mail spawns the fan at the contact, WALK mail hops with
+             the keep-coin applied at each holder, KEEP-NOTIFY mail
+             fills the subject's in_view.  The cross-shard stale sweep
+             (``last_reset`` gather) is intentionally NOT carried — it
+             exists to garbage-collect entries referencing RESTARTED
+             peers, and restart-in-place churn keeps those ids live;
+             the named limitation is documented here rather than paid
+             for with a second collective.
+  plumtree   the per-round digest gather becomes a seq field on
+             KEEPALIVE mail (pushed every round in plumtree mode);
+             delivery = the parent's received seq, grafting picks the
+             freshest received source.  Fused into the hyparview round:
+             same outbox, same single exchange, budget unchanged.
+
+Telemetry rides along shard-locally: received mail rows decode into a
+synthetic :class:`~partisan_tpu.ops.msg.Msgs` wire (typ = mail kind) so
+the PR-3 flight recorder's ``FlightSpec`` typ/node masks apply
+unchanged, and a ``counters=`` hook (the PR-8 round-counter tap shape)
+appends caller-defined per-round reductions to the ONE metrics psum.
+
+Known distributional deltas vs the unsharded round (accepted and
+counted, never silent): bucket/route overflow drops (``state.dropped``),
+mail addressed to a dead/cross-partition destination, the unsharded
+promotion's dead-candidate passive drop (no synchronous aliveness
+probe exists here — dead candidates age out via the keepalive TTL),
+and in-flight mail addressed to a node that restarted mid-flight.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..ops import padded_set as ps
+from ..ops.bitset import mix32 as _mix
+from ..ops.msg import Msgs
+from ..ops.shard_exchange import (bucket_exchange, default_bucket_cap,
+                                  route_select, take_rows, take_vals)
+from ..models.hyparview_dense import (DenseHvState, bulk_passive_merge,
+                                      dense_init, launch_cap_for)
+from ..models.scamp_dense import DenseScampState, walker_caps
+from ..models.plumtree_dense import PtDense
+from ..models import dense_cadence
+from ..telemetry.flight import (FlightRing, FlightSpec, flight_record,
+                                flight_partition_specs)
+from .mesh import NODE_AXIS
+
+# ---- mail layout: [valid, dst, src, kind, part, p0..p9] ----------------
+N_PAYLOAD = 10
+MAIL_COLS = 5 + N_PAYLOAD
+
+# hyparview/plumtree mail kinds (the FlightSpec typ space of the round)
+K_KEEPALIVE = 0   # p0 = sender's plumtree seq (0 in plain hyparview)
+K_PROPOSE = 1     # p0 = proposer-isolated priority bit
+K_ACCEPT = 2      # "your proposal to me succeeded"
+K_DISCONNECT = 3  # explicit eviction notice
+K_SHUF = 4        # p0 = origin, p1 = ttl, p2..p9 = 8-id sample
+K_SHUF_REPLY = 5  # p2..p9 = 8-id sample back to the origin
+HV_KINDS = 6
+
+# scamp mail kinds
+S_WALK = 0        # p0 = subject, p1 = age
+S_NOTIFY = 1      # src = holder that admitted dst's subscription
+S_JOIN = 2        # src = (re)subscriber, dst = contact
+SCAMP_KINDS = 3
+
+_SEL_CAP_HV = None   # filled per-cfg: max over per-kind receive caps
+
+
+def hv_mail_slots(cfg: Config) -> int:
+    """Static outbox rows per node per round (hyparview/plumtree):
+    A keepalives + 1 propose + 2 accept-replies + 2 evict-disconnects
+    from proposal handling + 2 from accept handling + 1 shuffle init +
+    2 shuffle forwards + 2 shuffle replies."""
+    return cfg.max_active_size + 12
+
+
+def scamp_mail_slots(cfg: Config) -> int:
+    """1 join + 2*C spawn fan + 6 walk forwards + 6 keep-notifies."""
+    _, c = walker_caps(cfg)
+    return 1 + 2 * c + 12
+
+
+# ---- state ------------------------------------------------------------
+
+@struct.dataclass
+class ShardedDenseHv:
+    """Sharded hyparview state: the unsharded planes + the keepalive
+    stamp plane + the mail outbox.  Every [N, ...] plane shards on
+    axis 0 over the mesh; ``dropped`` is one cumulative overflow
+    counter per shard (bucket head-caps + route-cap misses)."""
+    active: jax.Array     # [N, A]
+    passive: jax.Array    # [N, P]
+    astamp: jax.Array     # [N, A] round of last keepalive per slot
+    alive: jax.Array      # [N] bool
+    partition: jax.Array  # [N] int32 (0 = unpartitioned)
+    mail: jax.Array       # [N * hv_mail_slots, MAIL_COLS] outbox
+    dropped: jax.Array    # [n_shards] int32, cumulative
+    rnd: jax.Array        # scalar int32
+
+
+@struct.dataclass
+class ShardedDensePt:
+    """Plumtree fused over the sharded hyparview round — the broadcast
+    planes of models/plumtree_dense.PtDense, sharded."""
+    hv: ShardedDenseHv
+    seq: jax.Array        # [N] highest delivered broadcast seq
+    parent: jax.Array     # [N] eager parent (-1 = none / root)
+    pstale: jax.Array     # [N] rounds behind without parent delivery
+
+
+@struct.dataclass
+class ShardedDenseScamp:
+    """Sharded SCAMP state.  NOTE the deliberate omissions vs
+    DenseScampState: no walker table (walkers live in the mail), no
+    last_reset/pstamp/ivstamp planes (the cross-shard stale sweep is
+    the one unsharded phase NOT carried over — see the module
+    docstring's named limitation)."""
+    partial: jax.Array        # [N, P]
+    in_view: jax.Array        # [N, P]
+    alive: jax.Array          # [N] bool
+    partition: jax.Array      # [N] int32
+    last_join: jax.Array      # [N] round of last (re)subscribe
+    insert_dropped: jax.Array   # [N] keeps refused by a full view
+    walk_expired: jax.Array     # [N] walks dead of old age
+    walk_truncated: jax.Array   # [N] join-fan copies lost to the cap
+    in_view_dropped: jax.Array  # [N] notify inserts lost to a full view
+    mail: jax.Array           # [N * scamp_mail_slots, MAIL_COLS]
+    dropped: jax.Array        # [n_shards] int32, cumulative
+    rnd: jax.Array            # scalar int32
+
+
+# ---- init / placement / readback --------------------------------------
+
+def sharded_dense_init(cfg: Config, n_shards: int,
+                       seeds_per_node: int = 2) -> ShardedDenseHv:
+    """The unsharded bootstrap (dense_init) + empty mail/stamp planes."""
+    n = cfg.n_nodes
+    assert n % n_shards == 0, (n, n_shards)
+    base = dense_init(cfg, seeds_per_node)
+    return ShardedDenseHv(
+        active=base.active, passive=base.passive,
+        astamp=jnp.zeros((n, cfg.max_active_size), jnp.int32),
+        alive=base.alive,
+        partition=jnp.zeros((n,), jnp.int32),
+        mail=jnp.zeros((n * hv_mail_slots(cfg), MAIL_COLS), jnp.int32),
+        dropped=jnp.zeros((n_shards,), jnp.int32),
+        rnd=jnp.int32(0))
+
+
+def sharded_pt_init(cfg: Config, n_shards: int) -> ShardedDensePt:
+    n = cfg.n_nodes
+    return ShardedDensePt(
+        hv=sharded_dense_init(cfg, n_shards),
+        seq=jnp.zeros((n,), jnp.int32),
+        parent=jnp.full((n,), -1, jnp.int32),
+        pstale=jnp.zeros((n,), jnp.int32))
+
+
+def sharded_scamp_init(cfg: Config, n_shards: int) -> ShardedDenseScamp:
+    """Every node starts unsubscribed with ``last_join`` backdated, so
+    round 0 re-subscribes the whole population through the normal JOIN
+    mail path — the bootstrap IS the join protocol here, no special
+    contact-table init."""
+    n = cfg.n_nodes
+    assert n % n_shards == 0, (n, n_shards)
+    p, _ = walker_caps(cfg)
+    z = lambda: jnp.zeros((n,), jnp.int32)  # noqa: E731
+    return ShardedDenseScamp(
+        partial=jnp.full((n, p), -1, jnp.int32),
+        in_view=jnp.full((n, p), -1, jnp.int32),
+        alive=jnp.ones((n,), bool),
+        partition=z(), last_join=jnp.full((n,), -(1 << 20), jnp.int32),
+        insert_dropped=z(), walk_expired=z(), walk_truncated=z(),
+        in_view_dropped=z(),
+        mail=jnp.zeros((n * scamp_mail_slots(cfg), MAIL_COLS), jnp.int32),
+        dropped=jnp.zeros((n_shards,), jnp.int32),
+        rnd=jnp.int32(0))
+
+
+def _spec_of(x):
+    return P(NODE_AXIS) if getattr(x, "ndim", 0) >= 1 else P()
+
+
+def place_sharded(state, mesh):
+    """device_put every [N, ...] plane sharded on the node axis."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, _spec_of(x))),
+        state)
+
+
+def to_dense(st: ShardedDenseHv) -> DenseHvState:
+    """Host-side readback into the unsharded state type, so the
+    existing health surface (hyparview_dense.connectivity) runs
+    unchanged on sharded runs."""
+    g = lambda x: jnp.asarray(jax.device_get(x))  # noqa: E731
+    return DenseHvState(active=g(st.active), passive=g(st.passive),
+                        alive=g(st.alive), rnd=g(st.rnd),
+                        partition=g(st.partition))
+
+
+def to_dense_scamp(st: ShardedDenseScamp, cfg: Config) -> DenseScampState:
+    """Readback for models/scamp_dense.scamp_health: walker planes are
+    empty by construction (walkers live in the mail) and the sweep
+    stamp planes are zeros (the sweep is not carried — module
+    docstring)."""
+    g = lambda x: jnp.asarray(jax.device_get(x))  # noqa: E731
+    n = st.partial.shape[0]
+    p, c = walker_caps(cfg)
+    return DenseScampState(
+        partial=g(st.partial), in_view=g(st.in_view),
+        walk_pos=jnp.full((n, c), -1, jnp.int32),
+        walk_age=jnp.zeros((n, c), jnp.int32),
+        alive=g(st.alive),
+        insert_dropped=g(st.insert_dropped),
+        walk_expired=g(st.walk_expired),
+        walk_truncated=g(st.walk_truncated),
+        in_view_dropped=g(st.in_view_dropped),
+        last_reset=jnp.full((n,), -(10 ** 6), jnp.int32),
+        pstamp=jnp.zeros((n, p), jnp.int32),
+        ivstamp=jnp.zeros((n, p), jnp.int32),
+        rnd=g(st.rnd))
+
+
+def to_pt_dense(st: ShardedDensePt) -> PtDense:
+    g = lambda x: jnp.asarray(jax.device_get(x))  # noqa: E731
+    return PtDense(seq=g(st.seq), parent=g(st.parent), stale=g(st.pstale))
+
+
+# ---- shared round machinery -------------------------------------------
+
+def _round_prng(seed_tag: int, cfg: Config, rnd, gids):
+    """(s32, rbits): scalar-salted uint32s and per-(node, slot) bits,
+    derived from GLOBAL node ids so shard count never changes a node's
+    coin flips — hyparview_dense.make_rbits with the ids passed in."""
+    key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed ^ seed_tag), rnd)
+
+    def s32(salt: int):
+        return jax.random.bits(jax.random.fold_in(key, salt), (),
+                               jnp.uint32)
+
+    def rbits(salt: int, w: int):
+        assert w <= 256, "rbits packs the slot in 8 bits"
+        ctr = ((gids.astype(jnp.uint32)[:, None] << 8)
+               | jnp.arange(w, dtype=jnp.uint32)[None, :])
+        return _mix(ctr ^ s32(salt))
+    return s32, rbits
+
+
+def _emit(blocks, n_loc, gids, alive, part, dst, kind, pay=None):
+    """Append one static outbox block: ``dst`` [n_loc] or [n_loc, b]
+    GLOBAL destination ids (−1 = no mail), ``pay`` [n_loc, b, k<=10]
+    int32 payload columns.  Dead senders emit nothing."""
+    d = dst[:, None] if dst.ndim == 1 else dst
+    b = d.shape[1]
+    v = (d >= 0) & alive[:, None]
+    hdr = jnp.stack([
+        v.astype(jnp.int32),
+        jnp.where(v, d, 0),
+        jnp.broadcast_to(gids[:, None], (n_loc, b)),
+        jnp.full((n_loc, b), kind, jnp.int32),
+        jnp.broadcast_to(part[:, None], (n_loc, b)),
+    ], axis=2)
+    p = jnp.zeros((n_loc, b, N_PAYLOAD), jnp.int32)
+    if pay is not None:
+        p = p.at[:, :, : pay.shape[2]].set(pay.astype(jnp.int32))
+    blocks.append(jnp.concatenate([hdr, p], axis=2))
+
+
+def _flight_tap(fring, flight, keep, rsrc, rdst, rkind, rp, rnd):
+    """Decode received mail into a synthetic Msgs wire so the PR-3
+    flight recorder applies unchanged (typ = mail kind; the payload
+    columns feed wire_hash).  Shard-local, zero collectives."""
+    m = Msgs(valid=keep, src=rsrc, dst=rdst, typ=rkind,
+             channel=jnp.zeros_like(rsrc), lane=jnp.zeros_like(rsrc),
+             delay=jnp.zeros_like(rsrc),
+             born=jnp.full_like(rsrc, rnd),
+             data={"payload": rp})
+    return flight_record(fring, flight, m, rnd)
+
+
+def _psum_metrics(names, vals):
+    tot = jax.lax.psum(jnp.stack([v.astype(jnp.int32) for v in vals]),
+                       NODE_AXIS)
+    return {k: tot[i] for i, k in enumerate(names)}
+
+
+def _interpose_unsupported(interpose):
+    if interpose is not None:
+        raise ValueError(
+            "interpose= is not supported by the sharded dense round: "
+            "the unsharded hooks see whole-[N] destination vectors, "
+            "which do not exist on any shard.  Use chaos= (message/"
+            "node fault schedules run shard-local) or the unsharded "
+            "make_dense_round for interposition experiments.")
+
+
+# ---- hyparview / plumtree round ---------------------------------------
+
+def make_sharded_dense_round(
+    cfg: Config,
+    mesh,
+    *,
+    model: str = "hyparview",
+    churn: float = 0.0,
+    skip: frozenset = frozenset(),
+    phase_window: int = 1,
+    shuffle_window: Optional[int] = None,
+    resub_policy=None,
+    chaos=None,
+    flight: Optional[FlightSpec] = None,
+    counters: Optional[Dict[str, Callable]] = None,
+    bucket_cap: Optional[int] = None,
+    interpose=None,
+    root: int = 0,
+    broadcast_interval: int = 5,
+    graft_timeout: int = 1,
+):
+    """Compile one sharded dense round: ``state -> (state, metrics)``
+    (``(state, ring) -> (state, ring, metrics)`` with ``flight=``).
+
+    ``model`` is "hyparview", "plumtree" (the broadcast fold fused over
+    the hyparview round — ShardedDensePt state) or "scamp"
+    (ShardedDenseScamp).  ``skip`` suppresses phase EMISSIONS (the
+    outbox layout stays static so every variant shares one state
+    shape): {"promotion", "shuffle", "repair", "merge"} for hyparview,
+    {"resub"} for scamp.  ``counters`` is the PR-8 round-counter tap:
+    a dict name -> fn(local_planes_dict) -> scalar, appended to the
+    single metrics psum.  ``chaos`` is a verify.chaos schedule whose
+    node events fold shard-locally; ``flight`` a telemetry FlightSpec
+    recording received mail as synthetic wire rows (typ = mail kind).
+
+    Budget: exactly ONE all-to-all (the mail exchange) + ONE all-reduce
+    (the stacked metrics psum) — asserted in tests via
+    mesh.assert_collective_budget(max_counts=...)."""
+    _interpose_unsupported(interpose)
+    if model == "scamp":
+        return _make_sharded_scamp_round(
+            cfg, mesh, churn=churn, skip=skip, resub_policy=resub_policy,
+            chaos=chaos, flight=flight, counters=counters,
+            bucket_cap=bucket_cap)
+    assert model in ("hyparview", "plumtree"), model
+    pt = model == "plumtree"
+    assert skip <= {"promotion", "shuffle", "repair", "merge"}, skip
+
+    n = cfg.n_nodes
+    d = len(mesh.devices.flat)
+    assert n % d == 0, (n, d)
+    n_loc = n // d
+    a_cap = cfg.max_active_size
+    p_cap = cfg.max_passive_size
+    slots = hv_mail_slots(cfg)
+    b_cap = bucket_cap or default_bucket_cap(slots * n_loc, d)
+    sel_cap = max(a_cap, 2)
+    s_win = shuffle_window if shuffle_window is not None else phase_window
+    ctr_names = tuple(sorted(counters)) if counters else ()
+
+    def body_hv(st: ShardedDenseHv, pt_planes, fring):
+        base = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_loc
+        gids = base + jnp.arange(n_loc, dtype=jnp.int32)
+        rnd = st.rnd
+        s32, rbits = _round_prng(0xD5DA7A, cfg, rnd, gids)
+        active, passive, astamp = st.active, st.passive, st.astamp
+        alive, part = st.alive, st.partition
+        if pt:
+            seq, parent, pstale = pt_planes
+
+        # ---- chaos node plane + churn (shard-local folds) ----
+        if chaos is not None:
+            from ..verify.chaos import apply_chaos_nodes
+            alive, part = apply_chaos_nodes(chaos, rnd, alive, part, gids)
+        if churn > 0.0:
+            thresh = jnp.uint32(int(churn * (2 ** 32)))
+            reset = (rbits(0, 1)[:, 0] < thresh) & alive
+            contact = (_mix(gids.astype(jnp.uint32) ^ s32(1))
+                       % jnp.uint32(n)).astype(jnp.int32)
+            contact = jnp.where(contact == gids, (contact + 1) % n,
+                                contact)
+            active = jnp.where(reset[:, None], -1, active)
+            astamp = jnp.where(reset[:, None], 0, astamp)
+            passive = jnp.where(reset[:, None], -1, passive)
+            passive = passive.at[:, 0].set(
+                jnp.where(reset, contact, passive[:, 0]))
+
+        # ---- deliver last round's mail: THE one all-to-all ----
+        recv, xdrop = bucket_exchange(st.mail, n_loc, d, b_cap, NODE_AXIS)
+        rvalid = recv[:, 0] != 0
+        rdst, rsrc, rkind, rpart = (recv[:, 1], recv[:, 2], recv[:, 3],
+                                    recv[:, 4])
+        rp = recv[:, 5:]
+        dstl = jnp.clip(rdst - base, 0, n_loc - 1)
+        # receive-side fault plane: dead / cross-partition dst drops
+        keep = (rvalid & alive[:, None][dstl, 0]
+                & (part[:, None][dstl, 0] == rpart))
+        if flight is not None:
+            fring = _flight_tap(fring, flight, keep, rsrc, rdst, rkind,
+                                rp, rnd)
+
+        # ---- ONE local sort routes the whole inbox ----
+        sel = route_select(rkind, dstl, keep, HV_KINDS, n_loc, sel_cap,
+                           s32(2))
+        kept = jnp.sum(keep)
+        routed = jnp.sum(sel >= 0)
+
+        blocks = []
+        emit = functools.partial(_emit, blocks, n_loc, gids)
+        demote = []
+
+        # KEEPALIVE: refresh the per-slot stamp (failure detection)
+        ka = sel[K_KEEPALIVE]                     # [n_loc, sel_cap]
+        ka_src = take_vals(rsrc, ka)
+        hit = ((active[:, :, None] == ka_src[:, None, :])
+               & (active >= 0)[:, :, None] & (ka_src >= 0)[:, None, :])
+        astamp = jnp.where(jnp.any(hit, axis=2), rnd, astamp)
+        if pt:
+            ka_seq = take_vals(rp[:, 0], ka)      # −1 on empty slots
+
+        # DISCONNECT: explicit eviction notice — drop + demote
+        for j in range(2):
+            sj = take_vals(rsrc, sel[K_DISCONNECT][:, j])
+            hitj = (active == sj[:, None]) & (sj >= 0)[:, None]
+            demote.append(jnp.where(jnp.any(hitj, axis=1), sj, -1)[:, None])
+            active = jnp.where(hitj, -1, active)
+
+        # ACCEPT: my proposal succeeded — add the target two-sided
+        for j in range(2):
+            sj = take_vals(rsrc, sel[K_ACCEPT][:, j])
+            active, ev, _ = jax.vmap(ps.insert_evict_bits)(
+                active, sj, rbits(5 + j, 1)[:, 0])
+            astamp = jnp.where((active == sj[:, None]) & (sj >= 0)[:, None],
+                               rnd, astamp)
+            demote.append(ev[:, None])
+            emit(alive, part, ev, K_DISCONNECT)
+
+        # PROPOSE: accept when there is room or the proposer is isolated
+        # (priority HIGH forces a random eviction — :1466-1512)
+        for j in range(2):
+            idx = sel[K_PROPOSE][:, j]
+            pj = take_vals(rsrc, idx)
+            high = take_vals(rp[:, 0], idx) > 0
+            room = jnp.sum(active >= 0, axis=1) < a_cap
+            aj = (pj >= 0) & alive & (room | high)
+            active, ev, _ = jax.vmap(ps.insert_evict_bits)(
+                active, jnp.where(aj, pj, -1), rbits(7 + j, 1)[:, 0])
+            astamp = jnp.where((active == pj[:, None]) & aj[:, None],
+                               rnd, astamp)
+            demote.append(ev[:, None])
+            emit(alive, part, jnp.where(aj, pj, -1), K_ACCEPT)
+            emit(alive, part, ev, K_DISCONNECT)
+
+        # my own shuffle sample: me ++ k_a active ++ k_p passive
+        my_samp = jnp.concatenate([
+            gids[:, None],
+            jax.vmap(ps.random_k_bits, in_axes=(0, 0, None))(
+                active, rbits(11, a_cap), cfg.shuffle_k_active),
+            jax.vmap(ps.random_k_bits, in_axes=(0, 0, None))(
+                passive, rbits(12, p_cap), cfg.shuffle_k_passive),
+        ], axis=1)                                 # [n_loc, 8]
+
+        # SHUF: one walk hop per round, carried (origin, ttl, sample)
+        for j in range(2):
+            idx = sel[K_SHUF][:, j]
+            origin = take_vals(rp[:, 0], idx)
+            ttl = take_vals(rp[:, 1], idx)
+            samp_in = take_rows(rp, idx)[:, 2:10]  # [n_loc, 8]
+            excl = jnp.stack([gids, origin], axis=1)
+            fwd = jax.vmap(
+                lambda s, b, e: ps.random_member_bits(s, b, exclude=e)
+            )(active, rbits(13 + j, a_cap), excl)
+            okr = idx >= 0
+            can_fwd = okr & (ttl > 0) & (fwd >= 0)
+            emit(alive, part, jnp.where(can_fwd, fwd, -1), K_SHUF,
+                 pay=jnp.concatenate([
+                     origin[:, None], (ttl - 1)[:, None], samp_in],
+                     axis=1)[:, None, :])
+            acc = okr & ~can_fwd
+            demote.append(jnp.where(acc[:, None], samp_in, -1))
+            emit(alive, part, jnp.where(acc, origin, -1), K_SHUF_REPLY,
+                 pay=jnp.concatenate([
+                     jnp.zeros((n_loc, 2), jnp.int32), my_samp],
+                     axis=1)[:, None, :])
+
+        # SHUF_REPLY: origin folds the endpoint's sample
+        for j in range(2):
+            demote.append(take_rows(rp, sel[K_SHUF_REPLY][:, j])[:, 2:10])
+
+        # ---- repair: dead-row clear + keepalive-TTL prune (the mail
+        # analog of the mutuality gather: a dead or one-sided edge stops
+        # producing keepalives and ages out — config.py's documented
+        # detection shape) ----
+        if "repair" not in skip:
+            active = jnp.where(alive[:, None], active, -1)
+            ttl_stale = ((active >= 0)
+                         & ((rnd - astamp) > jnp.int32(cfg.keepalive_ttl)))
+            demote.append(jnp.where(ttl_stale, active, -1))
+            active = jnp.where(ttl_stale, -1, active)
+
+        # ---- isolation re-subscribe (every round, like the unsharded
+        # round; resub_policy is the chaos-aware gate) ----
+        lonely = (alive & (jnp.sum(active >= 0, axis=1) == 0)
+                  & (jnp.sum(passive >= 0, axis=1) == 0))
+        if resub_policy is not None:
+            lonely = lonely & resub_policy(lonely, rnd)
+        fresh = (_mix(gids.astype(jnp.uint32) ^ s32(40))
+                 % jnp.uint32(n)).astype(jnp.int32)
+        fresh = jnp.where(fresh == gids, (fresh + 1) % n, fresh)
+        passive = passive.at[:, 0].set(
+            jnp.where(lonely, fresh, passive[:, 0]))
+
+        def due_in_window(interval, window):
+            x = (rnd + gids) % interval
+            return ((interval - x) % interval) < window
+
+        # ---- promotion initiation ----
+        sizes = jnp.sum(active >= 0, axis=1)
+        isolated = sizes == 0
+        due = due_in_window(cfg.random_promotion_interval,
+                            phase_window) | isolated
+        cand = jax.vmap(ps.random_member_bits)(passive, rbits(3, p_cap))
+        cand = jnp.where(jax.vmap(ps.contains)(active, cand), -1, cand)
+        propose = alive & due & (sizes < a_cap) & (cand >= 0)
+        if "promotion" in skip:
+            propose = propose & False
+        emit(alive, part, jnp.where(propose, cand, -1), K_PROPOSE,
+             pay=isolated.astype(jnp.int32)[:, None, None])
+
+        # ---- shuffle initiation: first hop of the walk ----
+        due_s = alive & due_in_window(cfg.shuffle_interval, s_win)
+        t0 = jax.vmap(ps.random_member_bits)(active, rbits(30, a_cap))
+        go = due_s & (t0 >= 0)
+        if "shuffle" in skip:
+            go = go & False
+        emit(alive, part, jnp.where(go, t0, -1), K_SHUF,
+             pay=jnp.concatenate([
+                 gids[:, None],
+                 jnp.full((n_loc, 1), cfg.arwl - 1, jnp.int32),
+                 my_samp], axis=1)[:, None, :])
+
+        # ---- plumtree fold (digest/deliver/graft off keepalive mail) --
+        pt_metrics = []
+        if pt:
+            bump = ((broadcast_interval > 0)
+                    & ((rnd % max(broadcast_interval, 1)) == 0))
+            seq = jnp.where((gids == root) & bump, seq + 1, seq)
+            known = jnp.max(jnp.where(ka_seq >= 0, ka_seq, -1), axis=1)
+            pmask = ((ka_src == parent[:, None])
+                     & (parent >= 0)[:, None] & (ka_seq >= 0))
+            p_seq = jnp.max(jnp.where(pmask, ka_seq, -1), axis=1)
+            delivered = p_seq > seq
+            seq = jnp.maximum(seq, p_seq)
+            parent_ok = (parent >= 0) & jnp.any(
+                active == parent[:, None], axis=1)
+            behind = known > seq
+            pstale = jnp.where(behind & ~delivered, pstale + 1, 0)
+            need = ((behind & (pstale >= graft_timeout))
+                    | (behind & ~parent_ok))
+            score = jnp.where(
+                ka_seq >= 0,
+                ka_seq * 8 + (rbits(60, sel_cap) >> 29).astype(jnp.int32),
+                -(1 << 30))
+            pick = jnp.argmax(score, axis=1)
+            cand_p = jnp.take_along_axis(ka_src, pick[:, None],
+                                         axis=1)[:, 0]
+            grafted = need & (cand_p >= 0) & (gids != root)
+            parent = jnp.where(grafted, cand_p, parent)
+            parent = jnp.where(gids == root, -1, parent)
+            pt_metrics = [jnp.sum(behind), jnp.sum(grafted)]
+
+        # ---- keepalive emission (every round in plumtree mode: the
+        # seq digest rides it) ----
+        if pt:
+            ka_due = jnp.ones((n_loc,), bool)
+            ka_pay = jnp.broadcast_to(seq[:, None, None],
+                                      (n_loc, a_cap, 1))
+        else:
+            ka_due = ((rnd + gids) % cfg.keepalive_interval) == 0
+            ka_pay = None
+        emit(alive, part, jnp.where(ka_due[:, None], active, -1),
+             K_KEEPALIVE, pay=ka_pay)
+
+        # ---- single fused passive merge ----
+        if "merge" not in skip:
+            passive = bulk_passive_merge(
+                active, passive, jnp.concatenate(demote, axis=1), gids,
+                jax.random.fold_in(
+                    jax.random.fold_in(
+                        jax.random.PRNGKey(cfg.seed ^ 0xD5DA7A), rnd),
+                    50))
+
+        mail = jnp.concatenate(blocks, axis=1)
+        assert mail.shape[1] == slots, (mail.shape, slots)
+        mail = mail.reshape(n_loc * slots, MAIL_COLS)
+        sent = jnp.sum(mail[:, 0])
+        sel_drop = kept - routed
+
+        names = ["mail_sent", "mail_processed", "mail_dropped", "live",
+                 "lonely"]
+        vals = [sent, routed, xdrop + sel_drop, jnp.sum(alive),
+                jnp.sum(lonely)]
+        if pt:
+            names += ["pt_behind", "pt_grafts"]
+            vals += pt_metrics
+        if counters:
+            planes = {"active": active, "passive": passive,
+                      "alive": alive, "gids": gids, "rnd": rnd}
+            for k in ctr_names:
+                names.append(k)
+                vals.append(counters[k](planes))
+        metrics = _psum_metrics(names, vals)
+
+        st2 = ShardedDenseHv(
+            active=active, passive=passive, astamp=astamp, alive=alive,
+            partition=part, mail=mail,
+            dropped=st.dropped + xdrop + sel_drop, rnd=rnd + 1)
+        pt2 = (seq, parent, pstale) if pt else None
+        return st2, pt2, fring, metrics
+
+    metric_names = ["mail_sent", "mail_processed", "mail_dropped",
+                    "live", "lonely"]
+    if pt:
+        metric_names += ["pt_behind", "pt_grafts"]
+    metric_names += list(ctr_names)
+    metric_specs = {k: P() for k in metric_names}
+    fr_specs = flight_partition_specs(NODE_AXIS)
+
+    if pt:
+        if flight is not None:
+            @jax.jit
+            def step(st: ShardedDensePt, fring: FlightRing):
+                specs = jax.tree_util.tree_map(_spec_of, st)
+
+                def b(s, fr):
+                    hv2, pt2, fr2, m = body_hv(s.hv,
+                                               (s.seq, s.parent, s.pstale),
+                                               fr)
+                    return (ShardedDensePt(hv=hv2, seq=pt2[0],
+                                           parent=pt2[1], pstale=pt2[2]),
+                            fr2, m)
+                return shard_map(b, mesh=mesh, in_specs=(specs, fr_specs),
+                                 out_specs=(specs, fr_specs, metric_specs),
+                                 check_rep=False)(st, fring)
+            return step
+
+        @jax.jit
+        def step(st: ShardedDensePt):
+            specs = jax.tree_util.tree_map(_spec_of, st)
+
+            def b(s):
+                hv2, pt2, _, m = body_hv(s.hv,
+                                         (s.seq, s.parent, s.pstale),
+                                         None)
+                return (ShardedDensePt(hv=hv2, seq=pt2[0], parent=pt2[1],
+                                       pstale=pt2[2]), m)
+            return shard_map(b, mesh=mesh, in_specs=(specs,),
+                             out_specs=(specs, metric_specs),
+                             check_rep=False)(st)
+        return step
+
+    if flight is not None:
+        @jax.jit
+        def step(st: ShardedDenseHv, fring: FlightRing):
+            specs = jax.tree_util.tree_map(_spec_of, st)
+
+            def b(s, fr):
+                s2, _, fr2, m = body_hv(s, None, fr)
+                return s2, fr2, m
+            return shard_map(b, mesh=mesh, in_specs=(specs, fr_specs),
+                             out_specs=(specs, fr_specs, metric_specs),
+                             check_rep=False)(st, fring)
+        return step
+
+    @jax.jit
+    def step(st: ShardedDenseHv):
+        specs = jax.tree_util.tree_map(_spec_of, st)
+
+        def b(s):
+            s2, _, _, m = body_hv(s, None, None)
+            return s2, m
+        return shard_map(b, mesh=mesh, in_specs=(specs,),
+                         out_specs=(specs, metric_specs),
+                         check_rep=False)(st)
+    return step
+
+
+# ---- scamp round -------------------------------------------------------
+
+def _make_sharded_scamp_round(cfg: Config, mesh, *, churn=0.0,
+                              skip=frozenset(), resub_policy=None,
+                              chaos=None, flight=None, counters=None,
+                              bucket_cap=None, max_age: int = 64,
+                              join_patience: int = 12):
+    """SCAMP with walkers IN the mail.  ``join_patience`` rounds must
+    pass after a (re)subscribe before an empty view re-subscribes again
+    — the in-flight-walker guard the unsharded round read off its
+    walker table, expressed as a local timer."""
+    assert skip <= {"resub"}, skip
+    n = cfg.n_nodes
+    d = len(mesh.devices.flat)
+    assert n % d == 0, (n, d)
+    n_loc = n // d
+    p_cap, c_cap = walker_caps(cfg)
+    slots = scamp_mail_slots(cfg)
+    b_cap = bucket_cap or default_bucket_cap(slots * n_loc, d)
+    sel_cap = 6
+    ctr_names = tuple(sorted(counters)) if counters else ()
+
+    def body(st: ShardedDenseScamp, fring):
+        base = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_loc
+        gids = base + jnp.arange(n_loc, dtype=jnp.int32)
+        rnd = st.rnd
+        s32, rbits = _round_prng(0x5CADA7, cfg, rnd, gids)
+        partial, in_view = st.partial, st.in_view
+        alive, part, last_join = st.alive, st.partition, st.last_join
+        ins_drop, wexp, wtrunc, ivdrop = (
+            st.insert_dropped, st.walk_expired, st.walk_truncated,
+            st.in_view_dropped)
+
+        if chaos is not None:
+            from ..verify.chaos import apply_chaos_nodes
+            alive, part = apply_chaos_nodes(chaos, rnd, alive, part, gids)
+        if churn > 0.0:
+            thresh = jnp.uint32(int(churn * (2 ** 32)))
+            reset = (rbits(0, 1)[:, 0] < thresh) & alive
+            partial = jnp.where(reset[:, None], -1, partial)
+            in_view = jnp.where(reset[:, None], -1, in_view)
+            # backdate so the resub fold below re-joins immediately
+            last_join = jnp.where(reset, rnd - join_patience, last_join)
+
+        recv, xdrop = bucket_exchange(st.mail, n_loc, d, b_cap, NODE_AXIS)
+        rvalid = recv[:, 0] != 0
+        rdst, rsrc, rkind, rpart = (recv[:, 1], recv[:, 2], recv[:, 3],
+                                    recv[:, 4])
+        rp = recv[:, 5:]
+        dstl = jnp.clip(rdst - base, 0, n_loc - 1)
+        keep = (rvalid & alive[:, None][dstl, 0]
+                & (part[:, None][dstl, 0] == rpart))
+        if flight is not None:
+            fring = _flight_tap(fring, flight, keep, rsrc, rdst, rkind,
+                                rp, rnd)
+
+        sel = route_select(rkind, dstl, keep, SCAMP_KINDS, n_loc,
+                           sel_cap, s32(2))
+        kept = jnp.sum(keep)
+        routed = jnp.sum(sel >= 0)
+
+        blocks = []
+        emit = functools.partial(_emit, blocks, n_loc, gids)
+
+        # NOTIFY: a holder admitted my subscription -> my in_view
+        for j in range(4):
+            hj = take_vals(rsrc, sel[S_NOTIFY][:, j])
+            want = (hj >= 0) & ~jax.vmap(ps.contains)(in_view, hj)
+            in_view, _, ins = jax.vmap(
+                lambda s, x: ps.insert_evict(s, x, None))(in_view, hj)
+            ivdrop = ivdrop + (want & ~ins).astype(jnp.int32)
+        # route cap spill (sel rows beyond 4) counts via mail_dropped
+
+        # WALK: keep-coin at the holder, else hop (walker = the mail)
+        exact = getattr(cfg, "scamp_exact_keep_probability", True)
+        for j in range(6):
+            idx = sel[S_WALK][:, j]
+            subj = take_vals(rp[:, 0], idx)
+            age = take_vals(rp[:, 1], idx)
+            okr = (idx >= 0) & alive & (subj >= 0)
+            size_p = jnp.sum(partial >= 0, axis=1)
+            if exact:
+                pnum = 1.0 / (1.0 + size_p.astype(jnp.float32))
+            else:
+                pnum = jnp.full((n_loc,), 0.4, jnp.float32)
+            coin = ((rbits(20 + j, 1)[:, 0] >> 8).astype(jnp.float32)
+                    * (1.0 / (1 << 24))) < pnum
+            # an empty view always keeps (v2: the contact itself)
+            keepw = okr & (coin | (size_p == 0)) & (subj != gids)
+            present = jax.vmap(ps.contains)(partial, subj)
+            partial, _, ins = jax.vmap(
+                lambda s, x: ps.insert_evict(s, x, None))(
+                partial, jnp.where(keepw & ~present, subj, -1))
+            admitted = keepw & ~present & ins
+            full_drop = keepw & ~present & ~ins
+            ins_drop = ins_drop + full_drop.astype(jnp.int32)
+            emit(alive, part, jnp.where(admitted, subj, -1), S_NOTIFY)
+            # forward / retry / expire
+            fwd_needed = okr & ~admitted
+            age2 = age + 1
+            die = fwd_needed & (age2 > max_age)
+            wexp = wexp + die.astype(jnp.int32)
+            tgt = jax.vmap(ps.random_member_bits)(partial,
+                                                  rbits(26 + j, p_cap))
+            tgt = jnp.where(tgt >= 0, tgt, gids)   # hold at self
+            tgt = jnp.where(full_drop, gids, tgt)  # retry next round
+            emit(alive, part, jnp.where(fwd_needed & ~die, tgt, -1),
+                 S_WALK,
+                 pay=jnp.stack([subj, age2], axis=1)[:, None, :])
+
+        # JOIN: spawn the walk fan at the contact (one copy per view
+        # member + c extras, truncated to the walker cap, counted)
+        for j in range(2):
+            idx = sel[S_JOIN][:, j]
+            subj = take_vals(rsrc, idx)
+            okj = (idx >= 0) & alive & (subj >= 0)
+            size_p = jnp.sum(partial >= 0, axis=1)
+            extras = jax.vmap(ps.random_k_bits, in_axes=(0, 0, None))(
+                partial, rbits(32 + j, p_cap), cfg.scamp_c)
+            mf = jax.vmap(ps.members_first)(
+                jnp.concatenate([partial, extras], axis=1))
+            wtrunc = wtrunc + jnp.where(
+                okj, jnp.sum(mf[:, c_cap:] >= 0, axis=1), 0)
+            fan = jnp.where(okj[:, None], mf[:, :c_cap], -1)
+            # empty contact view: the walker stays at the contact
+            fan = fan.at[:, 0].set(
+                jnp.where(okj & (size_p == 0), gids, fan[:, 0]))
+            emit(alive, part, fan, S_WALK,
+                 pay=jnp.concatenate([
+                     jnp.broadcast_to(subj[:, None, None],
+                                      (n_loc, c_cap, 1)),
+                     jnp.zeros((n_loc, c_cap, 1), jnp.int32)], axis=2))
+
+        # ---- (re)subscribe: empty view + patience elapsed ----
+        lonely = (alive & (jnp.sum(partial >= 0, axis=1) == 0)
+                  & ((rnd - last_join) >= join_patience))
+        if "resub" in skip:
+            lonely = lonely & False
+        if resub_policy is not None:
+            lonely = lonely & resub_policy(lonely, rnd)
+        contact = (_mix(gids.astype(jnp.uint32) ^ s32(40))
+                   % jnp.uint32(n)).astype(jnp.int32)
+        contact = jnp.where(contact == gids, (contact + 1) % n, contact)
+        partial = partial.at[:, 0].set(
+            jnp.where(lonely, contact, partial[:, 0]))
+        last_join = jnp.where(lonely, rnd, last_join)
+        emit(alive, part, jnp.where(lonely, contact, -1), S_JOIN)
+
+        # dead rows keep no views (restart-in-place rebuilds via churn)
+        partial = jnp.where(alive[:, None], partial, -1)
+        in_view = jnp.where(alive[:, None], in_view, -1)
+
+        mail = jnp.concatenate(blocks, axis=1)
+        assert mail.shape[1] == slots, (mail.shape, slots)
+        mail = mail.reshape(n_loc * slots, MAIL_COLS)
+        sent = jnp.sum(mail[:, 0])
+        sel_drop = kept - routed
+
+        names = ["mail_sent", "mail_processed", "mail_dropped", "live",
+                 "resubs"]
+        vals = [sent, routed, xdrop + sel_drop, jnp.sum(alive),
+                jnp.sum(lonely)]
+        if counters:
+            planes = {"partial": partial, "in_view": in_view,
+                      "alive": alive, "gids": gids, "rnd": rnd}
+            for k in ctr_names:
+                names.append(k)
+                vals.append(counters[k](planes))
+        metrics = _psum_metrics(names, vals)
+
+        st2 = ShardedDenseScamp(
+            partial=partial, in_view=in_view, alive=alive, partition=part,
+            last_join=last_join, insert_dropped=ins_drop,
+            walk_expired=wexp, walk_truncated=wtrunc,
+            in_view_dropped=ivdrop, mail=mail,
+            dropped=st.dropped + xdrop + sel_drop, rnd=rnd + 1)
+        return st2, fring, metrics
+
+    metric_names = (["mail_sent", "mail_processed", "mail_dropped",
+                     "live", "resubs"] + list(ctr_names))
+    metric_specs = {k: P() for k in metric_names}
+    fr_specs = flight_partition_specs(NODE_AXIS)
+
+    if flight is not None:
+        @jax.jit
+        def step(st: ShardedDenseScamp, fring: FlightRing):
+            specs = jax.tree_util.tree_map(_spec_of, st)
+            return shard_map(body, mesh=mesh, in_specs=(specs, fr_specs),
+                             out_specs=(specs, fr_specs, metric_specs),
+                             check_rep=False)(st, fring)
+        return step
+
+    @jax.jit
+    def step(st: ShardedDenseScamp):
+        specs = jax.tree_util.tree_map(_spec_of, st)
+
+        def b(s):
+            s2, _, m = body(s, None)
+            return s2, m
+        return shard_map(b, mesh=mesh, in_specs=(specs,),
+                         out_specs=(specs, metric_specs),
+                         check_rep=False)(st)
+    return step
+
+
+# ---- runners -----------------------------------------------------------
+
+def run_sharded(step, state, n_rounds: int):
+    """Whole-launch-on-device scan over a metrics-returning sharded
+    step (flight-less programs)."""
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(st, k):
+        def b(s, _):
+            s2, _m = step(s)
+            return s2, None
+        out, _ = jax.lax.scan(b, st, None, length=k)
+        return out
+    return run(state, n_rounds)
+
+
+def run_sharded_chunked(step, state, n_rounds: int,
+                        cfg: Config):
+    """Launch-capped host loop (the TPU worker-fault medicine of the
+    unsharded runners — launch_cap_for): per-LAUNCH scan lengths stay
+    under the validated caps; chunk boundaries are bit-invariant
+    because the state carries everything, pinned in tests."""
+    cap = launch_cap_for(cfg.n_nodes)
+    done = 0
+    while done < n_rounds:
+        k = min(cap, n_rounds - done)
+        state = run_sharded(step, state, k)
+        done += k
+    return state
+
+
+def run_sharded_staggered(cfg: Config, mesh, state, n_blocks: int,
+                          *, model: str = "hyparview", churn: float = 0.0,
+                          k: int = 5, **kw):
+    """Phase-staggered cadence over the sharded round via
+    dense_cadence.block_scan.  hyparview/plumtree: one 2k block is
+    [promo+shuffle heavy, light x k-1, promo heavy, light x k-1] with
+    due windows widened to k / 2k (the unsharded staggered program's
+    shape); LIGHT rounds still run the full mail plane — delivery,
+    keepalives, repair — because in-flight walks hop via mail every
+    round here.  scamp: [heavy, light x k-1] where light only skips the
+    re-subscribe fold; at k=1 the block reduces to exactly the flat
+    program (bit-parity, pinned in tests)."""
+    if model == "scamp":
+        heavy = _make_sharded_scamp_round(cfg, mesh, churn=churn, **kw)
+        light = _make_sharded_scamp_round(cfg, mesh, churn=churn,
+                                          skip=frozenset({"resub"}), **kw)
+        segments = [(dense_cadence.as_body(lambda s: heavy(s)[0]), 1),
+                    (dense_cadence.as_body(lambda s: light(s)[0]), k - 1)]
+    else:
+        assert cfg.random_promotion_interval >= k, (
+            "stagger coarser than the promotion interval")
+        assert cfg.shuffle_interval >= 2 * k, (
+            "stagger coarser than the shuffle interval")
+        mk = functools.partial(make_sharded_dense_round, cfg, mesh,
+                               model=model, churn=churn, **kw)
+        hps = mk(phase_window=k, shuffle_window=2 * k)
+        hp = mk(phase_window=k, skip=frozenset({"shuffle"}))
+        light = mk(skip=frozenset({"promotion", "shuffle"}))
+        segments = [(dense_cadence.as_body(lambda s: hps(s)[0]), 1),
+                    (dense_cadence.as_body(lambda s: light(s)[0]), k - 1),
+                    (dense_cadence.as_body(lambda s: hp(s)[0]), 1),
+                    (dense_cadence.as_body(lambda s: light(s)[0]), k - 1)]
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def run(st, nb):
+        return dense_cadence.block_scan(segments, st, nb)
+    return run(state, n_blocks)

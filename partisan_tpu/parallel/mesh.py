@@ -15,7 +15,7 @@ emitted; routing moves them).  Scalars (round counter) are replicated.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import numpy as np
@@ -129,7 +129,9 @@ def collective_stats(compiled: Any) -> dict:
 
 def assert_collective_budget(compiled: Any, *, max_collectives: int,
                              max_bytes: int,
-                             forbid: Sequence[str] = ()) -> dict:
+                             forbid: Sequence[str] = (),
+                             max_counts: Optional[Dict[str, int]] = None
+                             ) -> dict:
     """The hard per-round communication budget of the explicit dataplane
     (ISSUE 2): the compiled round may contain at most
     ``max_collectives`` cross-device collectives totalling at most
@@ -140,7 +142,12 @@ def assert_collective_budget(compiled: Any, *, max_collectives: int,
     converts multi-chip perf from "hope XLA infers it" into an asserted
     contract — a regression that grows a third collective or re-gathers
     a state plane fails the comms quality gate outright
-    (tests/test_mesh.py)."""
+    (tests/test_mesh.py).
+
+    ``max_counts`` adds PER-KIND caps on top of the total (ISSUE 9: the
+    dense sharded round pins <= 1 all-to-all + <= 2 all-reduce/
+    collective-permute explicitly, not just a total) — kinds absent
+    from the dict are bounded only by ``max_collectives``/``forbid``."""
     st = collective_stats(compiled)
     n = sum(st["counts"].values())
     assert n <= max_collectives, (
@@ -149,6 +156,10 @@ def assert_collective_budget(compiled: Any, *, max_collectives: int,
     for op in forbid:
         assert st["counts"].get(op, 0) == 0, (
             f"forbidden collective {op} present", st["counts"])
+    for op, cap in (max_counts or {}).items():
+        assert st["counts"].get(op, 0) <= cap, (
+            f"per-kind collective budget blown: {op} x "
+            f"{st['counts'].get(op, 0)} > {cap} allowed", st["counts"])
     total = sum(st["total_bytes"].values())
     assert total <= max_bytes, (
         f"collective byte ceiling blown: {total} > {max_bytes}",

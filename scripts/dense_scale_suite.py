@@ -1,0 +1,177 @@
+"""ISSUE 9 scale suite: explicit-SPMD vs implicit (GSPMD auto-
+partitioned) dense rounds across N.
+
+Two arms per (model, N):
+
+* ``implicit`` — the unsharded round (models/hyparview_dense.py /
+  scamp_dense.py) jitted over state placed with ``node_sharding``:
+  XLA's partitioner inserts whatever collectives it likes (19
+  all-gathers per HyParView round at the seed).
+* ``explicit`` — the manual-SPMD round (parallel/dense_dataplane.py):
+  one bucketed all-to-all + one metrics all-reduce per round, budget
+  asserted at compile time.
+
+One JSON line per (model, N, arm) is appended to
+``BENCH_dense_scale.jsonl``; rows also land in ``results.csv``.  Runs
+that die (OOM / worker fault at the largest N) are ANNOTATED as rows
+with an ``error`` field, not dropped — a missing row reads as "not
+attempted", which is the wrong record.  Off-TPU runs carry
+``cpu_fallback: true``.
+
+Usage:
+  python scripts/dense_scale_suite.py                  # 2^16 + 2^18
+  python scripts/dense_scale_suite.py --n 1048576      # add 2^20
+  python scripts/dense_scale_suite.py --smoke          # CI: N=4096, one window
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# rounds shrink with N: the point is rounds/sec at scale, not a soak
+ROUNDS = {4096: 40, 1 << 16: 40, 1 << 18: 12, 1 << 20: 4}
+
+
+def _cfg(model: str, n: int) -> pt.Config:
+    if model == "hyparview":
+        return pt.Config(n_nodes=n, shuffle_interval=4,
+                         random_promotion_interval=2)
+    return pt.Config(n_nodes=n)
+
+
+def _counts(stats) -> dict:
+    return {k: v for k, v in stats["counts"].items() if v}
+
+
+def run_implicit(model: str, n: int, rounds: int, mesh, churn: float):
+    from partisan_tpu.parallel.mesh import collective_stats, node_sharding
+    cfg = _cfg(model, n)
+    if model == "hyparview":
+        from partisan_tpu.models.hyparview_dense import (dense_init,
+                                                         make_dense_round,
+                                                         run_dense)
+        s0 = dense_init(cfg)
+        run = lambda s: run_dense(s, rounds, cfg, churn)  # noqa: E731
+        step = make_dense_round(cfg, churn)
+    else:
+        from partisan_tpu.models.scamp_dense import (dense_scamp_init,
+                                                     make_dense_scamp_round,
+                                                     run_dense_scamp)
+        s0 = dense_scamp_init(cfg)
+        run = lambda s: run_dense_scamp(s, rounds, cfg, churn)  # noqa: E731
+        step = make_dense_scamp_round(cfg, churn)
+    st = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, node_sharding(mesh, x)), s0)
+    comms = _counts(collective_stats(jax.jit(step).lower(st).compile()))
+    jax.block_until_ready(run(st))  # warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(st))
+    return time.perf_counter() - t0, comms
+
+
+def run_explicit(model: str, n: int, rounds: int, mesh, churn: float):
+    from partisan_tpu.parallel import dense_dataplane as dd
+    from partisan_tpu.parallel.mesh import assert_collective_budget
+    cfg = _cfg(model, n)
+    n_dev = len(mesh.devices.flat)
+    step = dd.make_sharded_dense_round(cfg, mesh, model=model, churn=churn)
+    init = (dd.sharded_dense_init if model == "hyparview"
+            else dd.sharded_scamp_init)
+    st = dd.place_sharded(init(cfg, n_dev), mesh)
+    stats = assert_collective_budget(
+        step.lower(st).compile(), max_collectives=3, max_bytes=1 << 40,
+        forbid=("all-gather",),
+        max_counts={"all-to-all": 1, "all-reduce": 2,
+                    "collective-permute": 2})
+    jax.block_until_ready(dd.run_sharded_chunked(step, st, rounds, cfg))
+    t0 = time.perf_counter()
+    jax.block_until_ready(dd.run_sharded_chunked(step, st, rounds, cfg))
+    return time.perf_counter() - t0, _counts(stats)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, nargs="*", default=[1 << 16, 1 << 18])
+    ap.add_argument("--models", nargs="*", default=["hyparview", "scamp"])
+    ap.add_argument("--arms", nargs="*", default=["implicit", "explicit"])
+    ap.add_argument("--churn", type=float, default=0.01)
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="override the per-N round count (slow boxes)")
+    ap.add_argument("--arm-timeout", type=int, default=None,
+                    help="wall ceiling per arm in seconds; a breach is "
+                         "recorded as an annotated error row (SIGALRM — "
+                         "an externally killed run leaves no record)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI row: N=4096, one window, both arms")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "BENCH_dense_scale.jsonl"))
+    ap.add_argument("--csv", default=os.path.join(REPO, "results.csv"))
+    args = ap.parse_args()
+    if args.smoke:
+        args.n, args.models = [4096], ["hyparview"]
+
+    from partisan_tpu.parallel.mesh import make_mesh
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_devices=n_dev)
+    platform = jax.devices()[0].platform
+    fallback = platform != "tpu"
+
+    for model in args.models:
+        for n in args.n:
+            rounds = args.rounds or ROUNDS.get(n, max(4, (1 << 22) // n))
+            for arm in args.arms:
+                row = {"config": f"dense_scale_{model}_{n}_{arm}",
+                       "model": model, "n_nodes": n, "arm": arm,
+                       "rounds": rounds, "n_devices": n_dev,
+                       "platform": platform, "cpu_fallback": fallback,
+                       "churn": args.churn}
+                fn = run_implicit if arm == "implicit" else run_explicit
+                if args.arm_timeout:
+                    def _alarm(signum, frame):
+                        raise TimeoutError(
+                            f"arm exceeded --arm-timeout="
+                            f"{args.arm_timeout}s wall ceiling")
+                    signal.signal(signal.SIGALRM, _alarm)
+                    signal.alarm(args.arm_timeout)
+                try:
+                    secs, comms = fn(model, n, rounds, mesh, args.churn)
+                    row["seconds"] = round(secs, 4)
+                    row["rounds_per_sec"] = round(rounds / secs, 4)
+                    row["collectives_per_round"] = comms
+                except Exception as e:  # noqa: BLE001 — annotate, don't drop
+                    traceback.print_exc()
+                    row["error"] = f"{type(e).__name__}: {e}"[:300]
+                finally:
+                    if args.arm_timeout:
+                        signal.alarm(0)
+                with open(args.out, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+                if "error" not in row and not args.smoke:
+                    comms_s = "+".join(f"{k}:{v}" for k, v in
+                                       sorted(row["collectives_per_round"]
+                                              .items()))
+                    with open(args.csv, "a") as f:
+                        f.write(f"{row['config']}_{platform},{n},{rounds},"
+                                f"{row['seconds']},{row['rounds_per_sec']},"
+                                f"\"arm={arm},collectives={comms_s},"
+                                f"fallback={fallback}\"\n")
+                print("bench:", json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

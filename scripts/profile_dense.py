@@ -7,6 +7,12 @@ times each variant as a whole-run scan — single jit calls through the
 TPU tunnel carry ~100 ms dispatch latency and measure nothing.
 
 Usage: python scripts/profile_dense.py [--n 65536] [--rounds 300]
+
+``--sharded`` profiles the explicit-SPMD round (ISSUE 9,
+parallel/dense_dataplane) instead: times the shard_map round over the
+available device mesh and prints the per-round collective table from
+mesh.collective_stats — the implicit lowering's 19 all-gathers vs the
+explicit round's single bucketed all-to-all.
 """
 
 from __future__ import annotations
@@ -48,13 +54,45 @@ def timed(tag, cfg, rounds, churn, skip=frozenset()):
     print(f"{tag:24s} {statistics.median(rates):8.1f} rounds/s")
 
 
+def profile_sharded(cfg, rounds, churn):
+    from partisan_tpu.parallel import dense_dataplane as dd
+    from partisan_tpu.parallel.mesh import collective_stats, make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_devices=n_dev)
+    step = dd.make_sharded_dense_round(cfg, mesh, churn=churn)
+    st = dd.place_sharded(dd.sharded_dense_init(cfg, n_dev), mesh)
+
+    stats = collective_stats(step.lower(st).compile())
+    print(f"per-round collectives (explicit SPMD, {n_dev} devices):")
+    print(f"  {'op':20s} {'count':>5s} {'bytes':>12s}")
+    for op, n in sorted(stats["counts"].items()):
+        print(f"  {op:20s} {n:5d} {stats['total_bytes'].get(op, 0):12d}")
+
+    dd.run_sharded(step, st, 8).active.block_until_ready()  # warm scan
+    rates = []
+    for t in range(3):
+        w0 = dd.place_sharded(
+            dd.sharded_dense_init(cfg.replace(seed=31 + t), n_dev), mesh)
+        t0 = time.perf_counter()
+        dd.run_sharded(step, w0, rounds).active.block_until_ready()
+        rates.append(rounds / (time.perf_counter() - t0))
+    print(f"{'sharded_full':24s} {statistics.median(rates):8.1f} rounds/s")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1 << 16)
     ap.add_argument("--rounds", type=int, default=300)
+    ap.add_argument("--sharded", action="store_true",
+                    help="profile the explicit-SPMD round instead")
     args = ap.parse_args()
     cfg = pt.Config(n_nodes=args.n, shuffle_interval=4,
                     random_promotion_interval=2)
+
+    if args.sharded:
+        profile_sharded(cfg, args.rounds, 0.01)
+        return
 
     timed("full", cfg, args.rounds, 0.01)
     timed("no_churn", cfg, args.rounds, 0.0)

@@ -806,6 +806,72 @@ def load_suite_smoke():
     assert rows[0]["arm"] == "engine" and rows[0]["completions"] > 0
 
 
+def dense_budget_test():
+    """ISSUE 9 tentpole contract: every explicit-SPMD dense round
+    (hyparview / scamp / plumtree, parallel/dense_dataplane.py)
+    compiles to exactly ONE bucketed all-to-all + ONE metrics
+    all-reduce and ZERO all-gathers on the 8-device mesh — the
+    regression pin for the collective budget (the implicit GSPMD
+    lowering of the same round emits 19 all-gathers).  Same program
+    shapes as tests/test_dense_dataplane.py, shared via the persistent
+    compile cache."""
+    from partisan_tpu.parallel import dense_dataplane as dd
+    from partisan_tpu.parallel.mesh import (assert_collective_budget,
+                                            make_mesh)
+    mesh = make_mesh(n_devices=8)
+    budget = dict(max_collectives=3, max_bytes=64 << 20,
+                  forbid=("all-gather",),
+                  max_counts={"all-to-all": 1, "all-reduce": 2,
+                              "collective-permute": 2})
+    hv_cfg = pt.Config(n_nodes=256, shuffle_interval=4,
+                       random_promotion_interval=2)
+    sc_cfg = pt.Config(n_nodes=256)
+    cases = (
+        ("hyparview", hv_cfg, dd.sharded_dense_init, {}),
+        ("scamp", sc_cfg, dd.sharded_scamp_init, {"churn": 0.01}),
+        ("plumtree", hv_cfg, dd.sharded_pt_init,
+         {"broadcast_interval": 5}),
+    )
+    for model, cfg, init, kw in cases:
+        step = dd.make_sharded_dense_round(cfg, mesh, model=model, **kw)
+        st = dd.place_sharded(init(cfg, 8), mesh)
+        stats = assert_collective_budget(step.lower(st).compile(), **budget)
+        assert stats["counts"]["all-gather"] == 0, model
+        assert stats["counts"]["all-to-all"] == 1, model
+        assert stats["counts"]["all-reduce"] == 1, model
+
+
+def dense_scale_smoke():
+    """ISSUE 9 bench-harness smoke: one N=4096 window of the
+    implicit-vs-explicit scale suite through the real CLI — both arms
+    must run, report rounds/sec and carry their per-round collective
+    tables in the JSONL schema (full sweeps live in
+    scripts/dense_scale_suite.py -> BENCH_dense_scale.jsonl)."""
+    import json
+    import subprocess
+    import tempfile
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dense_scale_suite.py")
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "bench.jsonl")
+        csvp = os.path.join(td, "results.csv")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        rc = subprocess.run(
+            [sys.executable, script, "--smoke", "--out", out,
+             "--csv", csvp], env=env, timeout=560).returncode
+        assert rc == 0
+        with open(out) as f:
+            rows = [json.loads(line) for line in f]
+    arms = {r["arm"]: r for r in rows}
+    assert set(arms) == {"implicit", "explicit"}
+    for r in rows:
+        assert "error" not in r, r
+        assert r["rounds_per_sec"] > 0
+    assert arms["explicit"]["collectives_per_round"].get("all-gather", 0) == 0
+    assert arms["explicit"]["collectives_per_round"]["all-to-all"] == 1
+
+
 def explorer_parity_test():
     """ISSUE 7 tentpole contract: a B=1 execution through the batched
     fault-space explorer (vmapped scan over a traced chaos table) is
@@ -1459,6 +1525,14 @@ def build_matrix():
         "engine", explorer_parity_test)
     add("robustness/explore", "explore_smoke", "hyparview", "engine",
         explore_smoke)
+
+    # ISSUE 9: the explicit-SPMD dense dataplane — the per-model
+    # collective-budget pin and one implicit-vs-explicit bench window
+    # (full N sweeps live in scripts/dense_scale_suite.py)
+    add("perf/dense", "dense_budget_test", "hyparview", "engine",
+        dense_budget_test)
+    add("perf/dense", "dense_scale_smoke", "hyparview", "engine",
+        dense_scale_smoke)
 
     return M
 

@@ -54,10 +54,14 @@ _RUN_LAST = ("tests/test_explorer.py", "TestScheduleValidation",
 # tier 2: the ISSUE-8 workload plane is newer still — after everything,
 # including the explorer tier, so timeout truncation eats newest-first
 _RUN_LAST_2 = ("tests/test_workload.py",)
+# tier 3: the ISSUE-9 explicit-SPMD dense dataplane is the newest of all
+_RUN_LAST_3 = ("tests/test_dense_dataplane.py",)
 
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_3):
+            return 3
         if any(k in it.nodeid for k in _RUN_LAST_2):
             return 2
         if any(k in it.nodeid for k in _RUN_LAST):
