@@ -31,6 +31,12 @@ partisan_peer_service_manager.erl:30-67); every reply is ``ok``,
                               ring-overwritten records (never silent)
   {crash, [Node]} / {recover, [Node]}
   {partition, [[Node]]} / resolve_partition
+  {set_knob, Name, Value} / {clear_knob, Name}
+                              runtime controller-setpoint override (the
+                              partisan_config:set/2 analog) for sessions
+                              started with {adaptive, true}; applies at
+                              the window boundary (commands land between
+                              advance frames)
   {checkpoint, Path} / {restore, Path}
   health                      {ok, Map} of metrics.world_health
   stop                        close the session and exit
@@ -144,6 +150,7 @@ class Session:
         self.step = None
         self.dp = None                       # DataPlane layer (if enabled)
         self.pt = None                       # Plumtree layer (if enabled)
+        self.ctl = None                      # ControlSpec (adaptive mode)
         self._hooks: Dict[str, Any] = {}     # interposition funs
         self.pending_fwds: list = []         # queued {forward,...} records
         self.recv_cursors: Dict[int, int] = {}
@@ -159,7 +166,7 @@ class Session:
             overrides[str(k)] = v
         bridge = {k: overrides.pop(k) for k in
                   ("data_plane", "payload_words", "store_cap", "ring_cap",
-                   "plumtree", "pt_keys")
+                   "plumtree", "pt_keys", "adaptive")
                   if k in overrides}
         # hyparview reservation props: {reservable, true} enables the
         # per-tag reserved-slot machinery; {tags, [T0, T1, ...]} is the
@@ -196,6 +203,24 @@ class Session:
         # these are their own full protocols — no data plane stacking
         if str(manager) in _NO_DATA_PLANE:
             bridge["data_plane"] = False
+        # {adaptive, true}: the session drives its own compiled traffic
+        # (AdaptiveWorkloadRpc) and an admission AIMD closes the loop on
+        # SLO violations — no host forward/recv surface, so no data plane
+        self.ctl = None
+        if bridge.get("adaptive", False):
+            bridge["data_plane"] = False
+            from ..control.plane import ControlSpec, Controller
+            from ..models.stack import Lifted
+            from ..workload.driver import AdaptiveWorkloadRpc
+            init_rate = self.cfg.shed_token_rate_milli or 4000
+            self.proto = Stacked(self.proto,
+                                 Lifted(AdaptiveWorkloadRpc(self.cfg)))
+            self.ctl = ControlSpec((Controller(
+                name="admit", metric="rpc_slo_violated",
+                actuator="wl.shed_rate_milli", kind="aimd",
+                init=init_rate, target_milli=0, sense=1, delta=True,
+                alpha_milli=400, add=200, mult_milli=900,
+                lo=500, hi=max(4 * init_rate, 8000)),))
         if bridge.get("data_plane", True):
             from ..models.dataplane import DataPlane
             self.dp = DataPlane(
@@ -208,7 +233,11 @@ class Session:
             self.dp = None
         self._hooks = {}
         self.world = init_world(self.cfg, self.proto)
-        self.step = make_step(self.cfg, self.proto, donate=False)
+        if self.ctl is not None:
+            from ..control.plane import attach_plane
+            self.world = attach_plane(self.world, self.ctl)
+        self.step = make_step(self.cfg, self.proto, donate=False,
+                              control=self.ctl)
         # a re-start is a fresh world: session-side cursors and queued
         # forwards from the previous world must not leak into it (same
         # stale-cursor hazard cmd_restore documents)
@@ -312,6 +341,31 @@ class Session:
 
     def cmd_resolve_partition(self) -> Any:
         self.world = faults.resolve_partition(self.world)
+        return Atom("ok")
+
+    # --------------------------------------------- adaptive control knobs
+    # ({adaptive, true} start prop; the partisan_config:set/2 analog over
+    # the port.  Commands land between advance frames, so the pin applies
+    # exactly at a window boundary — never mid-scan.)
+
+    def _need_ctl(self):
+        if self.ctl is None:
+            raise ValueError("session not started with {adaptive, true}")
+
+    def cmd_set_knob(self, name, value) -> Any:
+        """{set_knob, Name, Value}: pin controller ``Name``'s setpoint to
+        ``Value`` until {clear_knob, Name}.  Unknown knob names reply the
+        spec's named error listing the known knobs."""
+        from ..peer_service import set_knob
+        self._need_ctl()
+        self.world = set_knob(self.world, self.ctl, _as_str(name),
+                              int(value))
+        return Atom("ok")
+
+    def cmd_clear_knob(self, name) -> Any:
+        from ..peer_service import clear_knob
+        self._need_ctl()
+        self.world = clear_knob(self.world, self.ctl, _as_str(name))
         return Atom("ok")
 
     # -------------------------- HyParView-protocol partition + reserve
@@ -521,7 +575,7 @@ class Session:
         else:
             return (Atom("error"), Atom("unknown_verb"))
         self.step = make_step(self.cfg, self.proto, donate=False,
-                              **self._hooks)
+                              control=self.ctl, **self._hooks)
         return Atom("ok")
 
     # --------------------------------------------------- plumtree surface

@@ -147,7 +147,8 @@ def load(path: str, template: World, cfg: Optional[Config] = None,
 
 
 def load_sharded(path: str, cfg: Config, proto: Any, mesh,
-                 out_cap: Optional[int] = None
+                 out_cap: Optional[int] = None,
+                 control: Optional[Any] = None
                  ) -> Tuple[World, Dict[str, Any]]:
     """Restore a checkpoint straight onto the explicit dataplane: builds
     the template with the mesh-rounded buffer capacity
@@ -160,12 +161,23 @@ def load_sharded(path: str, cfg: Config, proto: Any, mesh,
     Note: the checkpoint must have been saved from a world built with
     the SAME rounded capacity (``init_sharded_world`` or
     ``init_world(out_cap=sharded_out_cap(...))``); a plain unsharded
-    capacity shows up as a clear ``msgs`` leaf-shape error."""
+    capacity shows up as a clear ``msgs`` leaf-shape error.
+
+    ``control`` (a :class:`control.plane.ControlSpec`) declares that the
+    checkpoint carries an ISSUE-10 ControlPlane in ``World.aux``: the
+    template gets a fresh plane attached so the saved controller state
+    validates leaf-by-leaf (named ``.aux`` shape/dtype errors on spec
+    drift) and restores REPLICATED across the mesh (``place_world``'s
+    aux special-case) — kill-and-resume continues the controller
+    trajectory bit-identically."""
     from .engine import init_world
     from .parallel.dataplane import place_sharded_world, sharded_out_cap
     D = int(mesh.devices.size)
     template = init_world(
         cfg, proto, out_cap=sharded_out_cap(cfg, proto, D, out_cap))
+    if control is not None:
+        from .control.plane import attach_plane
+        template = attach_plane(template, control)
     world, manifest = load(path, template, cfg=cfg, proto=proto)
     return place_sharded_world(world, cfg, mesh), manifest
 

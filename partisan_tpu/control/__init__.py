@@ -1,0 +1,37 @@
+"""Adaptive control plane: in-scan closed-loop controllers (ISSUE 10).
+
+Controllers live *inside* the compiled round step.  They read the
+per-round global metrics the engine already produces (unsharded: local
+counters; sharded: the ONE stacked psum both dataplanes already emit —
+zero added collectives), update integer milli-unit state (EWMA error
+filter + AIMD / additive-step laws), and write setpoints back into
+protocol state through ``apply_setpoints`` actuator hooks.
+
+The ``ControlPlane`` pytree rides in ``World.aux`` (replicated across
+shards), so it persists through ``lax.scan``, checkpoints with the
+world, and resumes bit-identically.
+"""
+
+from .controllers import (  # noqa: F401
+    ERR_CLAMP,
+    aimd_step,
+    additive_step,
+    ewma_filter,
+    host_aimd_step,
+    host_additive_step,
+    host_ewma_filter,
+)
+from .plane import (  # noqa: F401
+    AIMD,
+    STEP,
+    Controller,
+    ControlPlane,
+    ControlSpec,
+    attach_plane,
+    control_specs,
+    host_update_plane,
+    plane_metrics,
+    setpoint_values,
+    update_plane,
+    validate_control,
+)

@@ -1,0 +1,101 @@
+"""Integer milli-unit controller primitives with bit-matching host twins.
+
+All device updates are int32 arithmetic in **milli-units** (1000 = 1.0)
+using floor division, which jnp and plain Python ints agree on for
+negative operands — so each primitive has a host twin in plain Python
+that reproduces the device update bit-for-bit (the repo convention from
+``workload/latency`` and ``workload/shed``).
+
+Overflow contract: error inputs are clamped to ``±ERR_CLAMP`` (2^20
+milli) before filtering, so ``alpha_milli * (err - filt)`` stays within
+``1000 * 2^21 < 2^31`` and never wraps.  Setpoint laws require
+``hi * mult_milli < 2^31`` from the caller (validated by ControlSpec).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# error values (milli) are clamped here before entering the filter; the
+# filter output then stays inside the clamp hull, so the multiply below
+# is wrap-free: 1000 * (2 * ERR_CLAMP) = 2.09e9 < 2^31 - 1.
+ERR_CLAMP = 1 << 20
+
+
+# ------------------------------------------------------------------ device
+
+def clamp_err(err):
+    """Clamp a milli-unit error signal into the overflow-safe band."""
+    return jnp.clip(jnp.asarray(err, jnp.int32), -ERR_CLAMP, ERR_CLAMP)
+
+
+def ewma_filter(filt, err, alpha_milli):
+    """One EWMA step: filt' = filt + alpha * (err - filt) / 1000.
+
+    ``alpha_milli`` in [0, 1000]; 1000 tracks the raw error, smaller
+    values smooth harder.  Floor division throughout.
+    """
+    filt = jnp.asarray(filt, jnp.int32)
+    err = clamp_err(err)
+    return filt + (jnp.int32(alpha_milli) * (err - filt)) // 1000
+
+
+def aimd_step(sp, decrease, *, add, mult_milli, lo, hi):
+    """AIMD law (Chiu–Jain): additive move when healthy, multiplicative
+    move on violation.
+
+    ``decrease`` is the boolean violation signal (filtered error > 0).
+    ``add`` is signed and in setpoint units, ``mult_milli`` is the
+    multiplicative factor in milli (900 = x0.9 shrink for admission;
+    2000 = x2 growth for a backoff interval).  Result clipped to
+    [lo, hi].
+    """
+    sp = jnp.asarray(sp, jnp.int32)
+    gentle = sp + jnp.int32(add)
+    hard = (sp * jnp.int32(mult_milli)) // 1000
+    return jnp.clip(jnp.where(decrease, hard, gentle), lo, hi)
+
+
+def additive_step(sp, err, *, step, deadband_milli, lo, hi):
+    """Additive step with hysteresis deadband.
+
+    Positive filtered error (above target, after ``sense``) drives the
+    setpoint DOWN by ``step``; error below ``-deadband_milli`` drives it
+    UP; inside the deadband the setpoint holds — the hysteresis that
+    stops limit-cycling on a noisy signal.
+    """
+    sp = jnp.asarray(sp, jnp.int32)
+    err = jnp.asarray(err, jnp.int32)
+    down = err > jnp.int32(deadband_milli)
+    up = err < -jnp.int32(deadband_milli)
+    delta = jnp.where(down, -jnp.int32(step),
+                      jnp.where(up, jnp.int32(step), jnp.int32(0)))
+    return jnp.clip(sp + delta, lo, hi)
+
+
+# ------------------------------------------------------------ host twins
+
+def host_clamp_err(err):
+    return max(-ERR_CLAMP, min(ERR_CLAMP, int(err)))
+
+
+def host_ewma_filter(filt, err, alpha_milli):
+    err = host_clamp_err(err)
+    return int(filt) + (int(alpha_milli) * (err - int(filt))) // 1000
+
+
+def host_aimd_step(sp, decrease, *, add, mult_milli, lo, hi):
+    sp = int(sp)
+    nxt = (sp * int(mult_milli)) // 1000 if decrease else sp + int(add)
+    return max(int(lo), min(int(hi), nxt))
+
+
+def host_additive_step(sp, err, *, step, deadband_milli, lo, hi):
+    sp, err = int(sp), int(err)
+    if err > int(deadband_milli):
+        nxt = sp - int(step)
+    elif err < -int(deadband_milli):
+        nxt = sp + int(step)
+    else:
+        nxt = sp
+    return max(int(lo), min(int(hi), nxt))

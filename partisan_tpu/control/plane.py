@@ -1,0 +1,296 @@
+"""ControlPlane: [n_ctl] controller state carried in ``World.aux``.
+
+A ``ControlSpec`` is a static tuple of ``Controller`` descriptors; the
+runtime state is a ``ControlPlane`` pytree of five [n_ctl] int32/bool
+vectors (setpoint, filtered error, previous raw input, host override
+value, host override flag).  ``update_plane`` runs once per round inside
+the compiled step, AFTER the round metrics are built:
+
+  unsharded:  metrics are local counters — already global.
+  sharded:    metrics come from the ONE stacked psum the dataplanes
+              already emit, so every shard sees identical global values
+              and updates its replicated plane copy identically.  Zero
+              added collectives; sharded == unsharded trajectories are
+              bit-identical.
+
+The plane occupies ``World.aux`` (see ``attach_plane``).  This is
+mutually exclusive with the verify/faults and verify/model_checker
+harnesses, which use ``aux`` as their omission-schedule dict — those
+are standalone exploration drivers, never combined with controllers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .controllers import (
+    ERR_CLAMP,
+    aimd_step,
+    additive_step,
+    ewma_filter,
+    host_aimd_step,
+    host_additive_step,
+    host_ewma_filter,
+)
+
+AIMD = "aimd"
+STEP = "step"
+
+# raw per-round inputs are clamped here before the x1000 scale so the
+# milli conversion cannot wrap: 1000 * 2e6 = 2e9 < 2^31 - 1.
+_IN_CLAMP = 2_000_000
+
+
+@struct.dataclass
+class ControlPlane:
+    """Runtime controller state, one slot per controller."""
+    setpoint: jax.Array     # [n_ctl] int32, actuator units
+    filt: jax.Array         # [n_ctl] int32, filtered error (milli)
+    prev: jax.Array         # [n_ctl] int32, previous raw metric sample
+    override: jax.Array     # [n_ctl] int32, host-pinned value
+    override_on: jax.Array  # [n_ctl] bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Controller:
+    """Static description of one closed loop.
+
+    ``metric`` names a per-round step-metrics key (engine counter, chaos
+    counter, or a protocol round counter).  ``actuator`` names the knob
+    the setpoint drives (``wl.*`` / ``ack.*`` protocol hooks, ``dense.*``
+    dataplane cadence) — empty string for an observe-only loop.  The
+    error signal is ``sense * (1000 * x - target_milli)`` where ``x`` is
+    the raw sample (or its per-round delta when ``delta`` — the right
+    mode for cumulative counters).
+    """
+    name: str
+    metric: str
+    actuator: str = ""
+    kind: str = AIMD
+    init: int = 0            # initial setpoint, actuator units
+    target_milli: int = 0
+    sense: int = 1           # +1: big metric == violation; -1: inverted
+    delta: bool = True       # difference cumulative inputs per round
+    alpha_milli: int = 1000  # EWMA gain; 1000 = unfiltered
+    add: int = 0             # AIMD additive move (signed, setpoint units)
+    mult_milli: int = 900    # AIMD multiplicative move (milli)
+    step: int = 0            # additive-step move (setpoint units)
+    deadband_milli: int = 0  # additive-step hysteresis half-width
+    lo: int = 0
+    hi: int = 1 << 20
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlSpec:
+    """The static controller set; index order is the plane's slot order."""
+    controllers: Tuple[Controller, ...]
+
+    def __post_init__(self):
+        seen = set()
+        for c in self.controllers:
+            if c.name in seen:
+                raise ValueError(f"duplicate controller name {c.name!r}")
+            seen.add(c.name)
+            if c.kind not in (AIMD, STEP):
+                raise ValueError(
+                    f"controller {c.name!r}: unknown kind {c.kind!r} "
+                    f"(expected {AIMD!r} or {STEP!r})")
+            if c.sense not in (-1, 1):
+                raise ValueError(
+                    f"controller {c.name!r}: sense must be +1 or -1")
+            if not 0 <= c.alpha_milli <= 1000:
+                raise ValueError(
+                    f"controller {c.name!r}: alpha_milli outside [0, 1000]")
+            if not c.lo <= c.hi:
+                raise ValueError(f"controller {c.name!r}: lo > hi")
+            if abs(c.hi) * max(abs(c.mult_milli), 1) >= (1 << 31):
+                raise ValueError(
+                    f"controller {c.name!r}: hi * mult_milli would "
+                    "overflow int32")
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(c.name for c in self.controllers)
+
+    def index(self, name: str) -> int:
+        for i, c in enumerate(self.controllers):
+            if c.name == name:
+                return i
+        raise ValueError(
+            f"unknown control knob {name!r}: known knobs are "
+            f"{list(self.names)}")
+
+    def init_plane(self) -> ControlPlane:
+        n = len(self.controllers)
+        return ControlPlane(
+            setpoint=jnp.asarray([c.init for c in self.controllers],
+                                 jnp.int32),
+            filt=jnp.zeros((n,), jnp.int32),
+            prev=jnp.zeros((n,), jnp.int32),
+            override=jnp.zeros((n,), jnp.int32),
+            override_on=jnp.zeros((n,), bool),
+        )
+
+
+# ------------------------------------------------------------- device side
+
+def update_plane(spec: ControlSpec, plane: ControlPlane,
+                 metrics: Dict[str, jax.Array]) -> ControlPlane:
+    """One control round.  ``metrics`` must hold GLOBAL per-round values
+    (local counters unsharded; post-psum totals sharded)."""
+    sps, filts, prevs = [], [], []
+    for i, c in enumerate(spec.controllers):
+        raw = jnp.asarray(metrics[c.metric], jnp.int32).reshape(())
+        x = raw - plane.prev[i] if c.delta else raw
+        xq = jnp.clip(x, -_IN_CLAMP, _IN_CLAMP)
+        err = jnp.clip(jnp.int32(c.sense) * (1000 * xq
+                                             - jnp.int32(c.target_milli)),
+                       -ERR_CLAMP, ERR_CLAMP)
+        filt = ewma_filter(plane.filt[i], err, c.alpha_milli)
+        if c.kind == AIMD:
+            sp = aimd_step(plane.setpoint[i], filt > 0, add=c.add,
+                           mult_milli=c.mult_milli, lo=c.lo, hi=c.hi)
+        else:
+            sp = additive_step(plane.setpoint[i], filt, step=c.step,
+                               deadband_milli=c.deadband_milli,
+                               lo=c.lo, hi=c.hi)
+        sp = jnp.where(plane.override_on[i], plane.override[i], sp)
+        sps.append(sp)
+        filts.append(filt)
+        prevs.append(raw)
+    return plane.replace(setpoint=jnp.stack(sps).astype(jnp.int32),
+                         filt=jnp.stack(filts).astype(jnp.int32),
+                         prev=jnp.stack(prevs).astype(jnp.int32))
+
+
+def setpoint_values(spec: ControlSpec,
+                    plane: ControlPlane) -> Dict[str, jax.Array]:
+    """Actuator name -> scalar setpoint (skips observe-only loops)."""
+    return {c.actuator: plane.setpoint[i]
+            for i, c in enumerate(spec.controllers) if c.actuator}
+
+
+def plane_metrics(spec: ControlSpec,
+                  plane: ControlPlane) -> Dict[str, jax.Array]:
+    """Per-round gauge exports: setpoint + filtered error per loop."""
+    out = {}
+    for i, c in enumerate(spec.controllers):
+        out[f"ctl_{c.name}__setpoint"] = plane.setpoint[i]
+        out[f"ctl_{c.name}__err_milli"] = plane.filt[i]
+    return out
+
+
+def metric_names(spec: ControlSpec) -> Tuple[str, ...]:
+    names = []
+    for c in spec.controllers:
+        names.append(f"ctl_{c.name}__setpoint")
+        names.append(f"ctl_{c.name}__err_milli")
+    return tuple(names)
+
+
+# --------------------------------------------------------------- host twin
+
+def host_init_plane(spec: ControlSpec) -> Dict[str, list]:
+    n = len(spec.controllers)
+    return {"setpoint": [c.init for c in spec.controllers],
+            "filt": [0] * n, "prev": [0] * n,
+            "override": [0] * n, "override_on": [False] * n}
+
+
+def host_update_plane(spec: ControlSpec, plane: Dict[str, list],
+                      metrics: Dict[str, int]) -> Dict[str, list]:
+    """Plain-Python twin of ``update_plane`` — bit-matches the device."""
+    out = {k: list(v) for k, v in plane.items()}
+    for i, c in enumerate(spec.controllers):
+        raw = int(metrics[c.metric])
+        x = raw - plane["prev"][i] if c.delta else raw
+        xq = max(-_IN_CLAMP, min(_IN_CLAMP, x))
+        err = c.sense * (1000 * xq - c.target_milli)
+        err = max(-ERR_CLAMP, min(ERR_CLAMP, err))
+        filt = host_ewma_filter(plane["filt"][i], err, c.alpha_milli)
+        if c.kind == AIMD:
+            sp = host_aimd_step(plane["setpoint"][i], filt > 0, add=c.add,
+                                mult_milli=c.mult_milli, lo=c.lo, hi=c.hi)
+        else:
+            sp = host_additive_step(plane["setpoint"][i], filt,
+                                    step=c.step,
+                                    deadband_milli=c.deadband_milli,
+                                    lo=c.lo, hi=c.hi)
+        if plane["override_on"][i]:
+            sp = plane["override"][i]
+        out["setpoint"][i] = sp
+        out["filt"][i] = filt
+        out["prev"][i] = raw
+    return out
+
+
+# ------------------------------------------------------------ integration
+
+def attach_plane(world, spec: ControlSpec):
+    """Install a fresh ControlPlane into ``World.aux``.
+
+    Raises if aux is occupied — the fault-exploration harnesses
+    (verify/faults, verify/model_checker) own aux when active, and the
+    two uses are mutually exclusive by design.
+    """
+    if world.aux is not None:
+        raise ValueError(
+            "World.aux is occupied (fault-exploration schedule?); the "
+            "control plane needs exclusive ownership of aux")
+    return world.replace(aux=spec.init_plane())
+
+
+def validate_control(spec: ControlSpec, known_metrics, known_actuators,
+                     *, where: str) -> None:
+    """Build-time check: every loop reads a real metric and drives a
+    real actuator.  Raised at trace time with named detail."""
+    known_metrics = set(known_metrics)
+    known_actuators = set(known_actuators)
+    for c in spec.controllers:
+        if c.metric not in known_metrics:
+            raise ValueError(
+                f"{where}: controller {c.name!r} reads unknown metric "
+                f"{c.metric!r}; available: {sorted(known_metrics)}")
+        if c.actuator and c.actuator not in known_actuators:
+            raise ValueError(
+                f"{where}: controller {c.name!r} drives unknown actuator "
+                f"{c.actuator!r}; available: {sorted(known_actuators)}")
+
+
+def control_specs(spec: ControlSpec):
+    """MetricSpec gauges for the telemetry ring / PrometheusSink."""
+    from ..telemetry.registry import GAUGE, MetricSpec
+    out = []
+    for c in spec.controllers:
+        out.append(MetricSpec(
+            f"ctl_{c.name}__setpoint", GAUGE,
+            f"Controller {c.name}: current setpoint ({c.actuator or 'observe-only'})."))
+        out.append(MetricSpec(
+            f"ctl_{c.name}__err_milli", GAUGE,
+            f"Controller {c.name}: EWMA-filtered error (milli-units)."))
+    return tuple(out)
+
+
+# --------------------------------------------------- host knob overrides
+
+def set_knob(plane: ControlPlane, spec: ControlSpec, name: str,
+             value: int) -> ControlPlane:
+    """Pin controller ``name`` to ``value`` (the partisan_config:set/2
+    analog).  Host-side; apply at a window boundary."""
+    i = spec.index(name)  # named ValueError on unknown knob
+    return plane.replace(
+        setpoint=plane.setpoint.at[i].set(jnp.int32(value)),
+        override=plane.override.at[i].set(jnp.int32(value)),
+        override_on=plane.override_on.at[i].set(True))
+
+
+def clear_knob(plane: ControlPlane, spec: ControlSpec,
+               name: str) -> ControlPlane:
+    """Release a pinned knob; the loop resumes from the pinned value."""
+    i = spec.index(name)
+    return plane.replace(override_on=plane.override_on.at[i].set(False))

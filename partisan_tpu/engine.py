@@ -193,6 +193,22 @@ class ProtocolBase:
         to cumulative global counters)."""
         return {}
 
+    # --- control-plane actuators (ISSUE 10 adaptive control) ---------------
+    # Names of the setpoints a protocol can absorb into its state.  The
+    # control plane (control/plane.py) validates controller actuator
+    # names against this set at build time, then calls apply_setpoints
+    # once per round AFTER the plane update.  Empty default + the
+    # ``control is None`` gate in make_step keep controllers-off
+    # programs byte-identical (same contract as round_counter_names).
+    actuator_names: Tuple[str, ...] = ()
+
+    def apply_setpoints(self, cfg, state, values: Dict[str, jax.Array]):
+        """Broadcast scalar setpoints (actuator name -> replicated int32
+        scalar) into per-node state columns.  Pure shard-local writes:
+        under the sharded dataplanes every shard holds an identical
+        replicated plane, so identical values land on every row."""
+        return state
+
     # --- emission helpers (used inside handlers) ---------------------------
 
     def no_emit(self, cap: Optional[int] = None) -> Msgs:
@@ -597,6 +613,15 @@ def make_round_kernels(cfg: Config, proto: ProtocolBase, n_rows: int):
         C=C, G=G, K=K, E=E, T=T, n_types=n_types)
 
 
+# the per-round metric keys every step program emits (control-plane
+# input validation; the dataplanes share the same base set)
+STEP_METRIC_KEYS: Tuple[str, ...] = (
+    "round", "delivered", "sent", "inbox_overflow", "out_dropped",
+    "routed", "fault_dropped", "inflight", "alive", "unhandled")
+CHAOS_METRIC_KEYS: Tuple[str, ...] = (
+    "chaos_dropped", "chaos_delayed", "chaos_duplicated")
+
+
 def make_step(
     cfg: Config,
     proto: ProtocolBase,
@@ -608,8 +633,18 @@ def make_step(
     capture_wire: bool = False,
     flight: Optional[Any] = None,
     chaos: Optional[Any] = None,
+    control: Optional[Any] = None,
 ) -> Callable[..., Tuple]:
     """Compile one simulation round for `proto`.
+
+    ``control`` (a :class:`control.plane.ControlSpec`) compiles the
+    adaptive control plane into the round: after the metrics dict is
+    built, each controller reads its input metric, updates its EWMA /
+    AIMD / additive-step state, and the new setpoints are written into
+    protocol state through ``proto.apply_setpoints`` — all in-scan.
+    The ControlPlane pytree must already sit in ``world.aux`` (see
+    ``control.plane.attach_plane``).  ``control=None`` (default) traces
+    ZERO extra ops — byte-identical programs, warm-cache safe.
 
     interpose_send/recv are the TPU analog of the reference's interposition
     funs (partisan_pluggable_peer_service_manager.erl:51-58, 640-667): pure
@@ -689,6 +724,15 @@ def make_step(
                 "record its flight trace")
         if not dynamic_chaos:
             chaos.validate(n_nodes=N, n_types=n_types)
+    if control is not None:
+        # lazy import, same pattern as flight/chaos above
+        from .control.plane import (plane_metrics, setpoint_values,
+                                    update_plane, validate_control)
+        known_metrics = set(STEP_METRIC_KEYS) | set(rc_names)
+        if chaos is not None:
+            known_metrics |= set(CHAOS_METRIC_KEYS)
+        validate_control(control, known_metrics, proto.actuator_names,
+                         where="make_step")
 
     def step(world: World, fring=None, chaos_table=None):
         rnd = world.rnd
@@ -845,12 +889,26 @@ def make_step(
             rc = proto.round_counters(state)
             for k in rc_names:
                 metrics[k] = jnp.asarray(rc[k], jnp.int32).reshape(())
+        # adaptive control plane (ISSUE 10): read this round's metrics,
+        # move the setpoints, write them into protocol state for the
+        # NEXT round's tick.  Gated at the Python level: control=None
+        # programs are byte-identical.
+        plane = None
+        if control is not None:
+            plane = update_plane(control, world.aux, metrics)
+            state = proto.apply_setpoints(
+                cfg, state, setpoint_values(control, plane))
+            metrics.update(plane_metrics(control, plane))
         if capture_wire:
             metrics.update(
                 wire_valid=now.valid, wire_src=now.src, wire_dst=now.dst,
                 wire_typ=now.typ, wire_channel=now.channel,
                 wire_hash=msgops.wire_hash(now))
-        new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
+        if control is not None:
+            new_world = world.replace(state=state, msgs=out, rnd=rnd + 1,
+                                      aux=plane)
+        else:
+            new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
         if flight is not None:
             # same capture point as capture_wire (the routed buffer,
             # post fault plane / interposition / lane dispatch), but

@@ -125,6 +125,13 @@ class Lifted(UpperProtocol):
     def round_counters(self, state):
         return self.inner.round_counters(state)
 
+    @property
+    def actuator_names(self) -> Tuple[str, ...]:
+        return tuple(self.inner.actuator_names)
+
+    def apply_setpoints(self, cfg, state, values):
+        return self.inner.apply_setpoints(cfg, state, values)
+
 
 class Stacked(ProtocolBase):
     def __init__(self, lower: ProtocolBase, upper: UpperProtocol):
@@ -215,3 +222,23 @@ class Stacked(ProtocolBase):
         out = dict(self.lower.round_counters(state.lower))
         out.update(self.upper.round_counters(state.upper))
         return out
+
+    @property
+    def actuator_names(self) -> Tuple[str, ...]:
+        return (tuple(self.lower.actuator_names)
+                + tuple(self.upper.actuator_names))
+
+    def apply_setpoints(self, cfg, state: StackState, values):
+        # route each layer only the setpoints it declared — mirrors the
+        # round_counters merge, but split instead of unioned
+        low_names = set(self.lower.actuator_names)
+        up_names = set(self.upper.actuator_names)
+        low_vals = {k: v for k, v in values.items() if k in low_names}
+        up_vals = {k: v for k, v in values.items() if k in up_names}
+        lower = state.lower
+        upper = state.upper
+        if low_vals:
+            lower = self.lower.apply_setpoints(cfg, lower, low_vals)
+        if up_vals:
+            upper = self.upper.apply_setpoints(cfg, upper, up_vals)
+        return state.replace(lower=lower, upper=upper)

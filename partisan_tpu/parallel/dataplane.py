@@ -243,8 +243,18 @@ def make_sharded_step(
     bucket_cap: Optional[int] = None,
     flight=None,
     chaos=None,
+    control=None,
 ) -> Callable[..., Tuple]:
     """Compile one explicitly-sharded simulation round.
+
+    ``control`` (a :class:`control.plane.ControlSpec`) compiles the
+    ISSUE-10 adaptive control plane into the round.  Controller inputs
+    come from the post-psum TOTALS of the one stacked metrics reduce —
+    already global and identical on every shard — so each shard updates
+    its REPLICATED ControlPlane copy (``world.aux``, [n_ctl] leaves,
+    P() specs) identically and the collective budget is UNCHANGED:
+    still 1 all-to-all + 1 all-reduce, 0 all-gathers, controllers on.
+    ``control=None`` traces zero extra ops (byte-identical programs).
 
     Per-round cross-shard traffic: ONE all_to_all of
     ``[D, bucket_cap, F]`` int32 (F = packed field columns + 1 ghost)
@@ -330,6 +340,10 @@ def make_sharded_step(
     if chaos is not None:
         from ..verify.chaos import apply_chaos_msgs, apply_chaos_nodes
         chaos.validate(n_nodes=cfg.n_nodes)
+    if control is not None:
+        from ..control.plane import (metric_names as ctl_metric_names,
+                                     plane_metrics, setpoint_values,
+                                     update_plane, validate_control)
 
     def exchange(now: Msgs, src_part: jax.Array):
         """Bucket the local ready messages by destination shard and
@@ -498,7 +512,20 @@ def make_sharded_step(
         totals = jax.lax.psum(partials, NODE_AXIS)          # ONE psum
         metrics = {"round": rnd}
         metrics.update({k: totals[i] for i, k in enumerate(sum_keys)})
-        new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
+        # -- adaptive control plane (ISSUE 10): inputs are the post-psum
+        #    TOTALS — already global, identical on every shard — so each
+        #    shard updates its replicated plane copy identically (the
+        #    sharded==unsharded trajectory parity).  Shard-local
+        #    arithmetic: ZERO added collectives.
+        if control is not None:
+            plane = update_plane(control, world.aux, metrics)
+            state = proto.apply_setpoints(
+                cfg, state, setpoint_values(control, plane))
+            metrics.update(plane_metrics(control, plane))
+            new_world = world.replace(state=state, msgs=out,
+                                      rnd=rnd + 1, aux=plane)
+        else:
+            new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
         if flight is not None:
             return new_world, fring, metrics
         return new_world, metrics
@@ -509,8 +536,21 @@ def make_sharded_step(
     def spec_of(x):
         return P(NODE_AXIS) if getattr(x, "ndim", 0) >= 1 else P()
 
+    def world_specs(world):
+        specs = jax.tree_util.tree_map(spec_of, world)
+        if control is not None:
+            # the ControlPlane in aux is REPLICATED ([n_ctl] leaves have
+            # no node axis); spec_of would row-shard them
+            specs = specs.replace(aux=jax.tree_util.tree_map(
+                lambda x: P(), world.aux))
+        return specs
+
     metric_specs = {"round": P()}
     metric_specs.update({k: P() for k in sum_keys})
+    if control is not None:
+        validate_control(control, ("round",) + sum_keys,
+                         proto.actuator_names, where="make_sharded_step")
+        metric_specs.update({k: P() for k in ctl_metric_names(control)})
 
     if flight is not None:
         fr_specs = flight_partition_specs(NODE_AXIS)
@@ -518,7 +558,7 @@ def make_sharded_step(
         @functools.partial(jax.jit,
                            donate_argnums=(0, 1) if donate else ())
         def sharded_flight_step(world: World, fring):
-            in_specs = jax.tree_util.tree_map(spec_of, world)
+            in_specs = world_specs(world)
             return shard_map(step_body, mesh=mesh,
                              in_specs=(in_specs, fr_specs),
                              out_specs=(in_specs, fr_specs,
@@ -529,7 +569,7 @@ def make_sharded_step(
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def sharded_step(world: World):
-        in_specs = jax.tree_util.tree_map(spec_of, world)
+        in_specs = world_specs(world)
         return shard_map(step_body, mesh=mesh,
                          in_specs=(in_specs,),
                          out_specs=(in_specs, metric_specs),

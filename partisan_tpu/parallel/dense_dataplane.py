@@ -371,6 +371,7 @@ def make_sharded_dense_round(
     root: int = 0,
     broadcast_interval: int = 5,
     graft_timeout: int = 1,
+    control=None,
 ):
     """Compile one sharded dense round: ``state -> (state, metrics)``
     (``(state, ring) -> (state, ring, metrics)`` with ``flight=``).
@@ -388,8 +389,31 @@ def make_sharded_dense_round(
 
     Budget: exactly ONE all-to-all (the mail exchange) + ONE all-reduce
     (the stacked metrics psum) — asserted in tests via
-    mesh.assert_collective_budget(max_counts=...)."""
+    mesh.assert_collective_budget(max_counts=...).
+
+    ``control`` (a :class:`control.plane.ControlSpec`) compiles the
+    ISSUE-10 adaptive control plane into the round: the heavy-phase
+    cadences become controller-gated ``due_in_window`` variants with
+    TRACED intervals (actuators ``dense.promotion_interval`` /
+    ``dense.shuffle_interval``, consumed by the dataplane itself), and
+    the plane updates from the post-psum dense metric totals — zero
+    added collectives, replicated [n_ctl] plane, bit-identical on every
+    shard.  The step then takes and returns the plane:
+    ``step(st, plane) -> (st, plane, metrics)``.  Hyparview/plumtree
+    non-flight variants only; ``control=None`` (default) compiles
+    byte-identical programs."""
     _interpose_unsupported(interpose)
+    if control is not None and model == "scamp":
+        raise ValueError(
+            "make_sharded_dense_round: control= is not supported for "
+            "model='scamp' (no controller-gated cadence in the walker "
+            "round); use hyparview or plumtree")
+    if control is not None and flight is not None:
+        raise ValueError(
+            "make_sharded_dense_round: control= and flight= cannot "
+            "combine (both change the step arity); record the flight "
+            "trace with controllers off, or pin the setpoints via "
+            "Config instead")
     if model == "scamp":
         return _make_sharded_scamp_round(
             cfg, mesh, churn=churn, skip=skip, resub_policy=resub_policy,
@@ -410,8 +434,12 @@ def make_sharded_dense_round(
     sel_cap = max(a_cap, 2)
     s_win = shuffle_window if shuffle_window is not None else phase_window
     ctr_names = tuple(sorted(counters)) if counters else ()
+    if control is not None:
+        from ..control.plane import (metric_names as ctl_metric_names,
+                                     plane_metrics, setpoint_values,
+                                     update_plane, validate_control)
 
-    def body_hv(st: ShardedDenseHv, pt_planes, fring):
+    def body_hv(st: ShardedDenseHv, pt_planes, fring, plane=None):
         base = jax.lax.axis_index(NODE_AXIS).astype(jnp.int32) * n_loc
         gids = base + jnp.arange(n_loc, dtype=jnp.int32)
         rnd = st.rnd
@@ -567,11 +595,23 @@ def make_sharded_dense_round(
             x = (rnd + gids) % interval
             return ((interval - x) % interval) < window
 
+        # controller-gated cadence (ISSUE 10): the heavy-phase periods
+        # come from LAST round's setpoints — actuation runs one round
+        # behind the signal, like the sparse path's apply_setpoints.
+        # Static Config ints when controllers are off: identical program.
+        iv_promo = cfg.random_promotion_interval
+        iv_shuf = cfg.shuffle_interval
+        if control is not None:
+            spv = setpoint_values(control, plane)
+            if "dense.promotion_interval" in spv:
+                iv_promo = jnp.maximum(spv["dense.promotion_interval"], 1)
+            if "dense.shuffle_interval" in spv:
+                iv_shuf = jnp.maximum(spv["dense.shuffle_interval"], 1)
+
         # ---- promotion initiation ----
         sizes = jnp.sum(active >= 0, axis=1)
         isolated = sizes == 0
-        due = due_in_window(cfg.random_promotion_interval,
-                            phase_window) | isolated
+        due = due_in_window(iv_promo, phase_window) | isolated
         cand = jax.vmap(ps.random_member_bits)(passive, rbits(3, p_cap))
         cand = jnp.where(jax.vmap(ps.contains)(active, cand), -1, cand)
         propose = alive & due & (sizes < a_cap) & (cand >= 0)
@@ -581,7 +621,7 @@ def make_sharded_dense_round(
              pay=isolated.astype(jnp.int32)[:, None, None])
 
         # ---- shuffle initiation: first hop of the walk ----
-        due_s = alive & due_in_window(cfg.shuffle_interval, s_win)
+        due_s = alive & due_in_window(iv_shuf, s_win)
         t0 = jax.vmap(ps.random_member_bits)(active, rbits(30, a_cap))
         go = due_s & (t0 >= 0)
         if "shuffle" in skip:
@@ -663,13 +703,20 @@ def make_sharded_dense_round(
                 names.append(k)
                 vals.append(counters[k](planes))
         metrics = _psum_metrics(names, vals)
+        # -- adaptive control plane: updates from the post-psum totals
+        #    (identical on every shard — replicated plane stays bit-
+        #    identical); zero added collectives
+        plane2 = None
+        if control is not None:
+            plane2 = update_plane(control, plane, metrics)
+            metrics.update(plane_metrics(control, plane2))
 
         st2 = ShardedDenseHv(
             active=active, passive=passive, astamp=astamp, alive=alive,
             partition=part, mail=mail,
             dropped=st.dropped + xdrop + sel_drop, rnd=rnd + 1)
         pt2 = (seq, parent, pstale) if pt else None
-        return st2, pt2, fring, metrics
+        return st2, pt2, fring, metrics, plane2
 
     metric_names = ["mail_sent", "mail_processed", "mail_dropped",
                     "live", "lonely"]
@@ -678,6 +725,45 @@ def make_sharded_dense_round(
     metric_names += list(ctr_names)
     metric_specs = {k: P() for k in metric_names}
     fr_specs = flight_partition_specs(NODE_AXIS)
+    if control is not None:
+        validate_control(control, metric_names,
+                         ("dense.promotion_interval",
+                          "dense.shuffle_interval"),
+                         where="make_sharded_dense_round")
+        metric_specs.update({k: P() for k in ctl_metric_names(control)})
+
+    if control is not None:
+        # step(st, plane) -> (st, plane, metrics): the plane is carried
+        # explicitly (dense state is not a World, there is no aux slot)
+        if pt:
+            @jax.jit
+            def step(st: ShardedDensePt, plane):
+                specs = jax.tree_util.tree_map(_spec_of, st)
+                pspecs = jax.tree_util.tree_map(lambda x: P(), plane)
+
+                def b(s, pl):
+                    hv2, pt2, _, m, pl2 = body_hv(
+                        s.hv, (s.seq, s.parent, s.pstale), None, pl)
+                    return (ShardedDensePt(hv=hv2, seq=pt2[0],
+                                           parent=pt2[1], pstale=pt2[2]),
+                            pl2, m)
+                return shard_map(b, mesh=mesh, in_specs=(specs, pspecs),
+                                 out_specs=(specs, pspecs, metric_specs),
+                                 check_rep=False)(st, plane)
+            return step
+
+        @jax.jit
+        def step(st: ShardedDenseHv, plane):
+            specs = jax.tree_util.tree_map(_spec_of, st)
+            pspecs = jax.tree_util.tree_map(lambda x: P(), plane)
+
+            def b(s, pl):
+                s2, _, _, m, pl2 = body_hv(s, None, None, pl)
+                return s2, pl2, m
+            return shard_map(b, mesh=mesh, in_specs=(specs, pspecs),
+                             out_specs=(specs, pspecs, metric_specs),
+                             check_rep=False)(st, plane)
+        return step
 
     if pt:
         if flight is not None:
@@ -686,9 +772,10 @@ def make_sharded_dense_round(
                 specs = jax.tree_util.tree_map(_spec_of, st)
 
                 def b(s, fr):
-                    hv2, pt2, fr2, m = body_hv(s.hv,
-                                               (s.seq, s.parent, s.pstale),
-                                               fr)
+                    hv2, pt2, fr2, m, _ = body_hv(s.hv,
+                                                  (s.seq, s.parent,
+                                                   s.pstale),
+                                                  fr)
                     return (ShardedDensePt(hv=hv2, seq=pt2[0],
                                            parent=pt2[1], pstale=pt2[2]),
                             fr2, m)
@@ -702,9 +789,9 @@ def make_sharded_dense_round(
             specs = jax.tree_util.tree_map(_spec_of, st)
 
             def b(s):
-                hv2, pt2, _, m = body_hv(s.hv,
-                                         (s.seq, s.parent, s.pstale),
-                                         None)
+                hv2, pt2, _, m, _ = body_hv(s.hv,
+                                            (s.seq, s.parent, s.pstale),
+                                            None)
                 return (ShardedDensePt(hv=hv2, seq=pt2[0], parent=pt2[1],
                                        pstale=pt2[2]), m)
             return shard_map(b, mesh=mesh, in_specs=(specs,),
@@ -718,7 +805,7 @@ def make_sharded_dense_round(
             specs = jax.tree_util.tree_map(_spec_of, st)
 
             def b(s, fr):
-                s2, _, fr2, m = body_hv(s, None, fr)
+                s2, _, fr2, m, _ = body_hv(s, None, fr)
                 return s2, fr2, m
             return shard_map(b, mesh=mesh, in_specs=(specs, fr_specs),
                              out_specs=(specs, fr_specs, metric_specs),
@@ -730,7 +817,7 @@ def make_sharded_dense_round(
         specs = jax.tree_util.tree_map(_spec_of, st)
 
         def b(s):
-            s2, _, _, m = body_hv(s, None, None)
+            s2, _, _, m, _ = body_hv(s, None, None)
             return s2, m
         return shard_map(b, mesh=mesh, in_specs=(specs,),
                          out_specs=(specs, metric_specs),

@@ -60,7 +60,18 @@ def place_world(world: World, mesh: Mesh) -> World:
     """
     def put(leaf):
         return jax.device_put(leaf, node_sharding(mesh, leaf))
-    return jax.tree_util.tree_map(put, world)
+    # World.aux is harness-owned and never node-indexed: the ISSUE-10
+    # ControlPlane carries [n_ctl] vectors that are semantically
+    # REPLICATED (every shard runs the same controller update on the
+    # same post-psum globals), and n_ctl has no divisibility relation to
+    # the mesh — so aux leaves replicate wholesale.
+    aux = world.aux
+    placed = jax.tree_util.tree_map(put, world.replace(aux=None))
+    if aux is not None:
+        aux = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())), aux)
+        placed = placed.replace(aux=aux)
+    return placed
 
 
 _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2,

@@ -178,6 +178,33 @@ def forward_batch(world: World, proto: ProtocolBase, records) -> World:
     return world.replace(msgs=msgs)
 
 
+def set_knob(world: World, control, name: str, value: int) -> World:
+    """Runtime override of a controller setpoint — the
+    ``partisan_config:set/2`` analog (partisan_config.erl set/2).  Pins
+    controller ``name`` (a :class:`control.plane.ControlSpec` entry) to
+    ``value``: the setpoint jumps immediately and the override flag
+    holds it there until :func:`clear_knob`.  Host-side (world in,
+    world out) like every façade verb; apply at a window boundary —
+    the port bridge's ``set_knob`` command routes here.  Unknown knob
+    names raise the spec's named ValueError."""
+    if world.aux is None:
+        raise ValueError(
+            "set_knob: world carries no ControlPlane (attach one with "
+            "control.plane.attach_plane and build the step with "
+            "control=spec)")
+    from .control.plane import set_knob as _set
+    return world.replace(aux=_set(world.aux, control, name, value))
+
+
+def clear_knob(world: World, control, name: str) -> World:
+    """Release a :func:`set_knob` pin; the controller resumes closed-
+    loop from the pinned value."""
+    if world.aux is None:
+        raise ValueError("clear_knob: world carries no ControlPlane")
+    from .control.plane import clear_knob as _clear
+    return world.replace(aux=_clear(world.aux, control, name))
+
+
 def receive_messages(world: World, proto: ProtocolBase, node: int,
                      cursor: int = 0):
     """Drain app messages delivered to ``node`` since ``cursor`` — the

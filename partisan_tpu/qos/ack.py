@@ -17,6 +17,7 @@ test/partisan_SUITE.erl:573).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -134,14 +135,23 @@ def retransmit_backoff(valid: jax.Array, age: jax.Array,
     bit-compatible with its pre-backoff self by default.
     """
     age = jnp.where(valid, age + 1, 0)
+    # ``base`` may be a TRACED per-node scalar (the ISSUE-10 adaptive
+    # retransmit setpoint); the static-int path below traces exactly the
+    # pre-ISSUE-10 ops, so existing programs stay byte-identical.
+    static_base = isinstance(base, (int, np.integer))
     if factor > 1:
         # int32-safe exponent clamp; the cap (if any) is applied after
         expo = jnp.clip(attempt, 0, 20)
-        interval = jnp.int32(base) * jnp.power(jnp.int32(factor), expo)
+        base_i = jnp.int32(base) if static_base \
+            else jnp.asarray(base, jnp.int32)
+        interval = base_i * jnp.power(jnp.int32(factor), expo)
         if max_interval > 0:
             interval = jnp.minimum(interval, jnp.int32(max_interval))
-    else:
+    elif static_base:
         interval = jnp.full_like(age, jnp.int32(base))
+    else:
+        interval = jnp.broadcast_to(jnp.asarray(base, jnp.int32),
+                                    age.shape)
     if jitter > 0:
         slot_ids = jnp.arange(valid.shape[0], dtype=jnp.uint32)
         h = _mix(jnp.uint32(me) * jnp.uint32(0x9E3779B9)
@@ -233,6 +243,90 @@ class AckedDelivery(ProtocolBase):
         return {"ack_outstanding": jnp.sum(state.out_valid),
                 "ack_send_dropped": jnp.sum(state.send_dropped),
                 "ack_dead_lettered": jnp.sum(state.dead_lettered)}
+
+
+# ================= adaptive retransmission (ISSUE 10 control plane) ======
+
+@struct.dataclass
+class AdaptiveAckRow(AckRow):
+    """AckRow + the controller-driven base interval and the two counters
+    the adaptive-retransmit loop feeds on."""
+    rt_base: jax.Array  # [n] retransmit base interval (rounds, >= 1)
+    acked: jax.Array    # [n] cumulative slots cleared by acks
+    retx: jax.Array     # [n] cumulative retransmissions fired
+
+
+class AdaptiveAcked(AckedDelivery):
+    """AckedDelivery whose retransmit base interval is a per-node
+    setpoint (``ack.retransmit_base``) the control plane moves.
+
+    The adaptive-retransmit loop (scripts/control_suite.py chaos arm):
+    during an outage no acks come back, so an AIMD controller on the
+    ``ack_acked`` delta doubles the base toward ``hi`` — retransmissions
+    stop hammering a dead partition; when acks resume the base decays
+    additively back down.  Same at-least-once delivery as the fixed
+    timer (the ring holds every unacked slot either way), strictly fewer
+    wasted emissions.
+    """
+
+    actuator_names = ("ack.retransmit_base",)
+    round_counter_names = ("ack_acked", "ack_retx", "ack_outstanding_now")
+
+    def __init__(self, cfg: Config, ring_cap: int = 8,
+                 retransmit_base: Optional[int] = None):
+        super().__init__(cfg, ring_cap)
+        self.retransmit_base0 = int(
+            cfg.retransmit_interval if retransmit_base is None
+            else retransmit_base)
+
+    def init(self, cfg: Config, key: jax.Array) -> AdaptiveAckRow:
+        base = init_rows(cfg.n_nodes, self.R)
+        n = cfg.n_nodes
+        return AdaptiveAckRow(
+            **{f.name: getattr(base, f.name)
+               for f in dataclasses.fields(AckRow)},
+            rt_base=jnp.full((n,), self.retransmit_base0, jnp.int32),
+            acked=jnp.zeros((n,), jnp.int32),
+            retx=jnp.zeros((n,), jnp.int32))
+
+    def handle_app_ack(self, cfg, me, row: AdaptiveAckRow, m: Msgs, key):
+        hit = row.out_valid & (row.out_seq == m.data["seq"])
+        row = row.replace(
+            out_valid=row.out_valid & ~hit,
+            acked=row.acked + jnp.sum(hit).astype(jnp.int32))
+        return row, self.no_emit()
+
+    def tick(self, cfg, me, row: AdaptiveAckRow, rnd, key):
+        valid, age, attempt, due, dead = retransmit_backoff(
+            row.out_valid, row.out_age, row.out_attempt, me,
+            **backoff_kw(cfg, base=jnp.maximum(row.rt_base, 1)))
+        row = row.replace(out_valid=valid, out_age=age,
+                          out_attempt=attempt,
+                          dead_lettered=row.dead_lettered + dead,
+                          retx=row.retx + jnp.sum(due).astype(jnp.int32))
+        em = self.emit(jnp.where(due, row.out_dst, -1),
+                       self.typ("app"), cap=self.tick_emit_cap,
+                       payload=row.out_payload, seq=row.out_seq)
+        return row, em
+
+    def round_counters(self, state: AdaptiveAckRow) -> Dict[str, jax.Array]:
+        return {
+            "ack_acked": jnp.sum(state.acked),
+            "ack_retx": jnp.sum(state.retx),
+            "ack_outstanding_now":
+                jnp.sum(state.out_valid).astype(jnp.int32)}
+
+    def health_counters(self, state: AdaptiveAckRow) -> Dict[str, jax.Array]:
+        out = dict(super().health_counters(state))
+        out["ack_retransmissions"] = jnp.sum(state.retx)
+        return out
+
+    def apply_setpoints(self, cfg, state: AdaptiveAckRow, values):
+        if "ack.retransmit_base" in values:
+            state = state.replace(rt_base=jnp.full_like(
+                state.rt_base,
+                jnp.asarray(values["ack.retransmit_base"], jnp.int32)))
+        return state
 
 
 # ---------------------------------------------------------- device taps

@@ -32,6 +32,7 @@ separate wl_completed counter to drift out of sync.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, Dict, Sequence, Tuple
 
 import jax
@@ -150,12 +151,42 @@ class WorkloadRpc(Rpc):
 
     # -------------------------------------------------------------- tick
 
+    # --- control-plane actuation hooks (ISSUE 10) --------------------------
+    # The two knobs of the tick pipeline, factored out so an adaptive
+    # subclass can read controller-driven state columns instead of the
+    # static Config values.  The base implementations trace EXACTLY the
+    # ops the inline code traced before the factoring — byte-identical
+    # base programs (warm-cache contract).
+
+    def _backoff_kw(self, cfg: Config, row: WlRow) -> Dict:
+        """Keyword set for qos/ack.retransmit_backoff (step 1)."""
+        return ack.backoff_kw(cfg)
+
+    def _admit(self, cfg: Config, row: WlRow, want, outstanding):
+        """Admission decision (step 3): ``(admitted [A] mask, row')``.
+        Config knobs; rate 0 = bucket bypass."""
+        use_shed = (cfg.shed_token_rate_milli > 0
+                    or cfg.shed_max_outstanding > 0)
+        if not use_shed:
+            return want, row
+        if cfg.shed_token_rate_milli > 0:
+            tokens = shed.refill(row.wl_tokens_milli,
+                                 cfg.shed_token_rate_milli,
+                                 cfg.shed_token_burst_milli)
+        else:
+            tokens = jnp.int32(1000 * self.A)  # never the binding limit
+        adm, tokens_out, shed_n = shed.admit(
+            tokens, want, outstanding, cfg.shed_max_outstanding)
+        if cfg.shed_token_rate_milli > 0:
+            row = row.replace(wl_tokens_milli=tokens_out)
+        return adm, row.replace(wl_shed=row.wl_shed + shed_n)
+
     def tick(self, cfg, me, row: WlRow, rnd, key):
         P, A = self.P, self.A
         # 1. retransmit / dead-letter over the promise ring
         valid, age, attempt, due, dead = ack.retransmit_backoff(
             row.prom_valid, row.prom_age, row.prom_attempt, me,
-            **ack.backoff_kw(cfg))
+            **self._backoff_kw(cfg, row))
         re_em = self.emit(
             jnp.where(due, row.prom_dst, -1), self.typ("rpc_req"),
             cap=P, ref=row.prom_ref, fn=row.prom_fn, arg=row.prom_arg)
@@ -171,23 +202,9 @@ class WorkloadRpc(Rpc):
         want = arr.issue_mask(self.spec, row.wl_rate_milli, rnd,
                               outstanding, k_issue)
 
-        # 3. admission control (Config knobs; rate 0 = bucket bypass)
-        use_shed = (cfg.shed_token_rate_milli > 0
-                    or cfg.shed_max_outstanding > 0)
-        if use_shed:
-            if cfg.shed_token_rate_milli > 0:
-                tokens = shed.refill(row.wl_tokens_milli,
-                                     cfg.shed_token_rate_milli,
-                                     cfg.shed_token_burst_milli)
-            else:
-                tokens = jnp.int32(1000 * A)  # never the binding limit
-            adm, tokens_out, shed_n = shed.admit(
-                tokens, want, outstanding, cfg.shed_max_outstanding)
-            if cfg.shed_token_rate_milli > 0:
-                row = row.replace(wl_tokens_milli=tokens_out)
-            row = row.replace(wl_shed=row.wl_shed + shed_n)
-        else:
-            adm = want
+        # 3. admission control (hook: static Config knobs on the base
+        #    class, controller-driven state on AdaptiveWorkloadRpc)
+        adm, row = self._admit(cfg, row, want, outstanding)
 
         # 4. issue admitted slots (static unroll over A; sequential refs)
         dsts = arr.pick_dsts(self.spec, me, cfg.n_nodes, k_dst)
@@ -277,3 +294,104 @@ class WorkloadRpc(Rpc):
             wl_dead_lettered=z,
             wl_tokens_milli=jnp.full_like(
                 state.wl_tokens_milli, jnp.int32(burst_milli)))
+
+
+# ===================== adaptive variant (ISSUE 10 control plane) ==========
+
+@struct.dataclass
+class AdaptiveWlRow(WlRow):
+    """WlRow + the three controller-driven knob columns.  Per-node [n]
+    copies of replicated setpoints: shard-local reads under the sharded
+    dataplanes, no gathers."""
+    wl_shed_rate_milli: jax.Array   # [n] token refill rate (milli/round)
+    wl_max_outstanding: jax.Array   # [n] promise-depth cap (<=0 = off)
+    wl_retransmit_base: jax.Array   # [n] backoff base interval (rounds)
+
+
+class AdaptiveWorkloadRpc(WorkloadRpc):
+    """WorkloadRpc whose admission + retransmit knobs are STATE the
+    control plane moves every round (the PR-8 ``wl_rate_milli``-as-state
+    pattern, now closed-loop).
+
+    Actuators:
+      ``wl.shed_rate_milli``   token-bucket refill rate; <= 0 bypasses
+                               the bucket (base-class semantics).
+      ``wl.max_outstanding``   promise-depth cap; <= 0 disables.
+      ``wl.retransmit_base``   retransmit base interval, clamped >= 1.
+
+    Seeds come from the Config shed/retransmit knobs unless overridden;
+    with no controller attached the knobs simply hold their seeds, so
+    the adaptive build is a superset, not a behavior fork.
+    """
+
+    actuator_names = ("wl.shed_rate_milli", "wl.max_outstanding",
+                      "wl.retransmit_base")
+
+    def __init__(self, cfg: Config,
+                 fns: Sequence[Callable[[jax.Array], jax.Array]] = (),
+                 promise_cap: int = 16,
+                 spec: arr.ArrivalSpec = arr.ArrivalSpec(),
+                 rate_milli: int = 1000,
+                 shed_rate_milli: int | None = None,
+                 max_outstanding: int | None = None,
+                 retransmit_base: int | None = None):
+        super().__init__(cfg, fns, promise_cap, spec, rate_milli)
+        self.shed_rate_milli0 = int(
+            cfg.shed_token_rate_milli if shed_rate_milli is None
+            else shed_rate_milli)
+        self.max_outstanding0 = int(
+            cfg.shed_max_outstanding if max_outstanding is None
+            else max_outstanding)
+        self.retransmit_base0 = int(
+            cfg.retransmit_interval if retransmit_base is None
+            else retransmit_base)
+
+    def init(self, cfg: Config, key: jax.Array) -> AdaptiveWlRow:
+        base = super().init(cfg, key)
+        n = cfg.n_nodes
+        return AdaptiveWlRow(
+            **{f.name: getattr(base, f.name)
+               for f in dataclasses.fields(WlRow)},
+            wl_shed_rate_milli=jnp.full(
+                (n,), self.shed_rate_milli0, jnp.int32),
+            wl_max_outstanding=jnp.full(
+                (n,), self.max_outstanding0, jnp.int32),
+            wl_retransmit_base=jnp.full(
+                (n,), self.retransmit_base0, jnp.int32))
+
+    # ------------------------------------------------- actuation hooks
+
+    def _backoff_kw(self, cfg: Config, row: AdaptiveWlRow) -> Dict:
+        # per-node scalar under the engine's tick vmap; the existing
+        # exponential-backoff math accepts a traced base unchanged
+        return ack.backoff_kw(
+            cfg, base=jnp.maximum(row.wl_retransmit_base, 1))
+
+    def _admit(self, cfg: Config, row: AdaptiveWlRow, want, outstanding):
+        rate = row.wl_shed_rate_milli
+        filled = shed.refill(row.wl_tokens_milli, jnp.maximum(rate, 0),
+                             cfg.shed_token_burst_milli)
+        # rate <= 0 keeps the base class's bucket-bypass semantics,
+        # data-dependently: unlimited effective tokens, bucket level
+        # frozen at the refilled value
+        tokens = jnp.where(rate > 0, filled, jnp.int32(1000 * self.A))
+        adm, tokens_out, shed_n = shed.admit_dynamic(
+            tokens, want, outstanding, row.wl_max_outstanding)
+        return adm, row.replace(
+            wl_tokens_milli=jnp.where(rate > 0, tokens_out, filled),
+            wl_shed=row.wl_shed + shed_n)
+
+    # ---------------------------------------------- setpoint absorption
+
+    def apply_setpoints(self, cfg: Config, state: AdaptiveWlRow, values):
+        def bcast(col, name):
+            if name not in values:
+                return col
+            return jnp.full_like(col, jnp.asarray(values[name], jnp.int32))
+        return state.replace(
+            wl_shed_rate_milli=bcast(state.wl_shed_rate_milli,
+                                     "wl.shed_rate_milli"),
+            wl_max_outstanding=bcast(state.wl_max_outstanding,
+                                     "wl.max_outstanding"),
+            wl_retransmit_base=bcast(state.wl_retransmit_base,
+                                     "wl.retransmit_base"))

@@ -63,6 +63,40 @@ def admit(tokens_milli: jax.Array, want: jax.Array,
     return jnp.stack(oks), tokens, shed
 
 
+def admit_dynamic(tokens_milli: jax.Array, want: jax.Array,
+                  outstanding: jax.Array, max_outstanding: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`admit` with a TRACED outstanding cap (controller-driven).
+
+    The static variant bakes the depth-cap comparison in or out of the
+    program at build time; here the cap is per-node state a controller
+    moves every round, so the comparison is always traced and
+    ``cap <= 0`` disables the depth check data-dependently.  Same
+    token-charging contract as :func:`admit`.
+    """
+    want = jnp.asarray(want, bool)
+    tokens = jnp.asarray(tokens_milli, jnp.int32)
+    depth = jnp.asarray(outstanding, jnp.int32)
+    cap = jnp.asarray(max_outstanding, jnp.int32)
+    shed = jnp.int32(0)
+    oks = []
+    for i in range(want.shape[0]):
+        fits = want[i] & (tokens >= 1000) & ((cap <= 0) | (depth < cap))
+        oks.append(fits)
+        tokens = tokens - jnp.where(fits, jnp.int32(1000), jnp.int32(0))
+        depth = depth + fits.astype(jnp.int32)
+        shed = shed + (want[i] & ~fits).astype(jnp.int32)
+    return jnp.stack(oks), tokens, shed
+
+
+def host_admit_dynamic(tokens_milli: int, want, outstanding: int,
+                       max_outstanding: int):
+    """Plain-Python twin of :func:`admit_dynamic` — same contract as
+    :func:`host_admit` (the cap is just a value here either way)."""
+    return host_admit(tokens_milli, want, outstanding,
+                      int(max_outstanding))
+
+
 def host_admit(tokens_milli: int, want, outstanding: int,
                max_outstanding: int):
     """Plain-Python twin of :func:`admit` for conservation tests."""

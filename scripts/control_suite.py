@@ -1,0 +1,385 @@
+"""Adaptive-control bench (ISSUE 10): closed loops vs static knobs.
+
+Two verdicts, both measured past the PR-8 saturation knee
+(BENCH_load.jsonl: knee at 6.0 req/node/round, static shed arm 4000
+holds p99 within the 16-round SLO):
+
+  * ``admission``  — offered load pinned PAST the knee (default 8.0
+    req/node/round).  Static token-rate arms (the PR-8 shedding knob at
+    3000/4000/5000 milli-tokens) vs the AIMD admission controller
+    closing on the ``rpc_slo_violated`` per-round delta.  BAR: the
+    adaptive arm's goodput (SLO-met completions) must reach at least
+    the best static arm that holds p99 <= SLO — without knowing the
+    knee in advance.
+  * ``chaos retransmit`` — a compiled partition-then-heal outage
+    (verify.chaos.ChaosSchedule) under the acked-delivery protocol.
+    Fixed retransmit timer vs the adaptive-backoff controller (AIMD on
+    the ``ack_acked`` delta: double the base interval while acks stall,
+    decay when they resume).  Both arms run the SAME protocol
+    (AdaptiveAcked), differing ONLY in controllers on/off.  BAR: equal
+    delivery (every message eventually acked, zero dead-letters) with
+    strictly fewer retransmissions in the adaptive arm.
+
+The sharded arm re-asserts the collective budget with controllers ON:
+exactly {all-to-all: 1, all-reduce: 1, all-gather: 0} — closing the
+loops adds zero collectives (the plane feeds on the one stacked psum
+the dataplane already emits).
+
+Measurement plumbing (make_cfg / build / measure / find_knee) is
+imported from scripts/load_suite.py — one pipeline, two benches.
+
+Usage:
+    python scripts/control_suite.py                    # full bench
+        [--n 4096] [--offered 8000] [--static-arms 3000,4000,5000]
+        [--rounds 32] [--warm 8] [--chaos-n 64]
+        [--sharded-n 512] [--skip-sharded] [--out BENCH_control.jsonl]
+    python scripts/control_suite.py --smoke            # tiny tier-1 cell
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_spec = importlib.util.spec_from_file_location(
+    "load_suite", os.path.join(_here, "load_suite.py"))
+ls = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(ls)  # also pins JAX to CPU + the warm .jax_cache
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import partisan_tpu as pt  # noqa: E402
+from partisan_tpu import peer_service as ps  # noqa: E402
+from partisan_tpu.control import (  # noqa: E402
+    ControlSpec, Controller, attach_plane)
+from partisan_tpu.models.hyparview import HyParView  # noqa: E402
+from partisan_tpu.models.stack import Lifted, Stacked  # noqa: E402
+from partisan_tpu.ops import msg as msgops  # noqa: E402
+from partisan_tpu.qos.ack import AdaptiveAcked  # noqa: E402
+from partisan_tpu.verify.chaos import ChaosSchedule  # noqa: E402
+from partisan_tpu.workload import arrivals  # noqa: E402
+from partisan_tpu.workload.driver import AdaptiveWorkloadRpc  # noqa: E402
+
+
+def admission_spec(lo: int = 1000, hi: int = 8000,
+                   init: int = 4000) -> ControlSpec:
+    """The admission loop: SLO violations this round -> shrink the
+    token rate x0.9; a clean round -> climb +200 milli-tokens."""
+    return ControlSpec((
+        Controller(name="admit", metric="rpc_slo_violated",
+                   actuator="wl.shed_rate_milli", kind="aimd",
+                   init=init, target_milli=0, sense=1, delta=True,
+                   alpha_milli=400, add=200, mult_milli=900,
+                   lo=lo, hi=hi),
+    ))
+
+
+def retransmit_spec(base: int, hi: int = 16) -> ControlSpec:
+    """The adaptive-backoff loop: acks stalled (delta below ~0.5/round)
+    -> double the base retransmit interval toward ``hi``; acks flowing
+    -> decay back toward the configured base."""
+    return ControlSpec((
+        Controller(name="retx", metric="ack_acked",
+                   actuator="ack.retransmit_base", kind="aimd",
+                   init=base, target_milli=500, sense=-1, delta=True,
+                   alpha_milli=1000, add=-1, mult_milli=2000,
+                   lo=base, hi=hi),
+    ))
+
+
+def build_adm(cfg: pt.Config, rate0: int, shed0: int):
+    """The admission-arm stack: AdaptiveWorkloadRpc so the token rate
+    is a STATE column — one compiled program serves every static arm."""
+    n = cfg.n_nodes
+    drv = AdaptiveWorkloadRpc(
+        cfg, promise_cap=ls.PROMISE_CAP,
+        spec=arrivals.ArrivalSpec(kind=arrivals.POISSON,
+                                  max_issue=ls.MAX_ISSUE),
+        rate_milli=rate0, shed_rate_milli=shed0)
+    proto = Stacked(HyParView(cfg), Lifted(drv))
+    world = ps.cluster(pt.init_world(cfg, proto), proto,
+                       [(i, (i - 1) // 2) for i in range(1, n)])
+    return proto, drv, world
+
+
+def set_shed_rate(world, value: int):
+    up = world.state.upper
+    up = up.replace(wl_shed_rate_milli=jnp.full_like(
+        up.wl_shed_rate_milli, jnp.int32(value)))
+    return world.replace(state=world.state.replace(upper=up))
+
+
+def run_admission(n: int, offered: int, static_arms, rounds: int,
+                  warm: int) -> list:
+    """Offered load past the knee; static token-rate arms vs AIMD."""
+    cfg = ls.make_cfg(n, shed_rate=4000)  # burst 16000 for every arm
+    slo = cfg.slo_deadline_rounds
+    rows = []
+
+    proto, _drv, world0 = build_adm(cfg, offered, static_arms[0])
+    step = pt.make_step(cfg, proto, donate=False)
+
+    @jax.jit
+    def run_scan(w):
+        return jax.lax.scan(lambda wc, _: step(wc), w, None,
+                            length=rounds)
+
+    for shed in static_arms:
+        w = set_shed_rate(world0, shed)
+        t0 = time.perf_counter()
+        w, ms = run_scan(w)
+        jax.block_until_ready(w.rnd)
+        row = {"bench": "control_suite", "arm": "static",
+               "n_nodes": n, "offered_milli": offered,
+               "shed_rate_milli": shed, "rounds": rounds, "warm": warm,
+               "slo_deadline_rounds": slo,
+               **ls.measure(ms, n, rounds, warm, slo),
+               "wall_s": round(time.perf_counter() - t0, 2)}
+        rows.append(row)
+        print(f"[static {shed}] goodput={row['slo_ok']} "
+              f"p99={row['p99']} shed={row['shed']}")
+
+    spec = admission_spec(init=static_arms[len(static_arms) // 2])
+    proto_a, _drv, world_a = build_adm(cfg, offered,
+                                       static_arms[len(static_arms) // 2])
+    world_a = attach_plane(world_a, spec)
+    step_a = pt.make_step(cfg, proto_a, donate=False, control=spec)
+
+    @jax.jit
+    def run_scan_a(w):
+        return jax.lax.scan(lambda wc, _: step_a(wc), w, None,
+                            length=rounds)
+
+    t0 = time.perf_counter()
+    world_a, ms = run_scan_a(world_a)
+    jax.block_until_ready(world_a.rnd)
+    sp = np.asarray(ms["ctl_admit__setpoint"])
+    row = {"bench": "control_suite", "arm": "adaptive",
+           "n_nodes": n, "offered_milli": offered,
+           "shed_rate_milli": None, "rounds": rounds, "warm": warm,
+           "slo_deadline_rounds": slo,
+           **ls.measure(ms, n, rounds, warm, slo),
+           "setpoint_first": int(sp[0]), "setpoint_last": int(sp[-1]),
+           "setpoint_mean": float(sp[warm:].mean()),
+           "wall_s": round(time.perf_counter() - t0, 2)}
+    rows.append(row)
+    print(f"[adaptive] goodput={row['slo_ok']} p99={row['p99']} "
+          f"setpoint {row['setpoint_first']} -> {row['setpoint_last']} "
+          f"(mean {row['setpoint_mean']:.0f})")
+    return rows
+
+
+def build_chaos(cfg: pt.Config, spec):
+    """Same AdaptiveAcked protocol for BOTH chaos arms — the fixed arm
+    simply never moves ``rt_base`` (control=None)."""
+    n = cfg.n_nodes
+    proto = AdaptiveAcked(cfg, ring_cap=4)
+    world = pt.init_world(cfg, proto)
+    if spec is not None:
+        world = attach_plane(world, spec)
+    # one tracked message per node, dst a fixed stride away: traffic
+    # that MUST cross the partition cut for half the nodes
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    em = proto.emit(nodes, proto.typ("ctl_send"), cap=n,
+                    peer=(nodes + n // 2) % n, payload=nodes, seq=nodes)
+    msgs, _ = msgops.inject(world.msgs, em, src=nodes, born=world.rnd)
+    return proto, world.replace(msgs=msgs)
+
+
+def run_chaos(n: int, rounds: int, outage: tuple) -> list:
+    """Partition-then-heal outage; fixed vs adaptive retransmit base."""
+    cfg = pt.Config(
+        n_nodes=n, seed=5,
+        retransmit_interval=2, retransmit_backoff_factor=1,
+        retransmit_max_attempts=max(rounds, 64))
+    o_start, o_end = outage
+    sched = (ChaosSchedule()
+             .partition(o_start, (0, n // 2 - 1), 1)
+             .partition(o_start, (n // 2, n - 1), 2)
+             .heal(o_end))
+    rows = []
+    for arm, spec in (("fixed", None),
+                      ("adaptive", retransmit_spec(
+                          cfg.retransmit_interval))):
+        proto, world = build_chaos(cfg, spec)
+        step = pt.make_step(cfg, proto, donate=False, chaos=sched,
+                            control=spec)
+
+        @jax.jit
+        def run_scan(w, _step=step):
+            return jax.lax.scan(lambda wc, _: _step(wc), w, None,
+                                length=rounds)
+
+        t0 = time.perf_counter()
+        world, ms = run_scan(world)
+        jax.block_until_ready(world.rnd)
+        st = world.state
+        delivered_origins = int(np.sum(np.asarray(st.seen) >= 1))
+        row = {"bench": "control_suite", "arm": f"chaos_{arm}",
+               "n_nodes": n, "rounds": rounds,
+               "outage": [o_start, o_end],
+               "delivered_origins": delivered_origins,
+               "undelivered_slots": int(np.sum(np.asarray(st.out_valid))),
+               "dead_lettered": int(np.sum(np.asarray(st.dead_lettered))),
+               "retransmissions": int(np.sum(np.asarray(st.retx))),
+               "acked": int(np.sum(np.asarray(st.acked))),
+               "wall_s": round(time.perf_counter() - t0, 2)}
+        if spec is not None:
+            sp = np.asarray(ms["ctl_retx__setpoint"])
+            row["base_peak"] = int(sp.max())
+            row["base_last"] = int(sp[-1])
+        rows.append(row)
+        print(f"[chaos {arm}] delivered={delivered_origins}/{n} "
+              f"retx={row['retransmissions']} "
+              f"dead={row['dead_lettered']}"
+              + (f" base peak={row.get('base_peak')}"
+                 if spec is not None else ""))
+    return rows
+
+
+def run_sharded(n: int, offered: int, rounds: int, warm: int) -> list:
+    """Controllers-ON collective budget on the 8-device mesh."""
+    from partisan_tpu.parallel import mesh as pmesh
+    from partisan_tpu.parallel.dataplane import (make_sharded_step,
+                                                 place_world)
+    cfg = ls.make_cfg(n, shed_rate=4000)
+    spec = admission_spec()
+    proto, _drv, world = build_adm(cfg, offered, 4000)
+    world = attach_plane(world, spec)
+    mesh = pmesh.make_mesh()
+    world = place_world(world, mesh)
+    step = make_sharded_step(cfg, proto, mesh, donate=False, control=spec)
+    comp = step.lower(world).compile()
+    st = pmesh.assert_collective_budget(
+        comp, max_collectives=2, max_bytes=32 * 1024 * 1024,
+        forbid=("all-gather",))
+    counts = {k: int(v) for k, v in st["counts"].items()}
+    assert counts.get("all-to-all", 0) == 1 \
+        and counts.get("all-reduce", 0) == 1 \
+        and counts.get("all-gather", 0) == 0, counts
+    print(f"[sharded] collective budget controllers-on: {counts}")
+
+    @jax.jit
+    def run_scan(w):
+        return jax.lax.scan(lambda wc, _: step(wc), w, None,
+                            length=rounds)
+
+    t0 = time.perf_counter()
+    world, ms = run_scan(world)
+    jax.block_until_ready(world.rnd)
+    row = {"bench": "control_suite", "arm": "sharded_adaptive",
+           "n_nodes": n, "offered_milli": offered, "rounds": rounds,
+           "warm": warm, "collectives": counts,
+           "slo_deadline_rounds": cfg.slo_deadline_rounds,
+           **ls.measure(ms, n, rounds, warm, cfg.slo_deadline_rounds),
+           "setpoint_last": int(np.asarray(ms["ctl_admit__setpoint"])[-1]),
+           "wall_s": round(time.perf_counter() - t0, 2)}
+    return [row]
+
+
+def verdicts(adm_rows, chaos_rows) -> dict:
+    slo = adm_rows[0]["slo_deadline_rounds"]
+    static = [r for r in adm_rows if r["arm"] == "static"]
+    adaptive = [r for r in adm_rows if r["arm"] == "adaptive"][0]
+    holding = [r for r in static
+               if not math.isinf(r["p99"]) and r["p99"] <= slo]
+    best_static = max((r["slo_ok"] for r in holding), default=0)
+    adaptive_holds = (not math.isinf(adaptive["p99"])
+                      and adaptive["p99"] <= slo)
+    fixed = [r for r in chaos_rows if r["arm"] == "chaos_fixed"][0]
+    adapt = [r for r in chaos_rows if r["arm"] == "chaos_adaptive"][0]
+    equal_delivery = (
+        fixed["delivered_origins"] == adapt["delivered_origins"]
+        and fixed["undelivered_slots"] == 0
+        and adapt["undelivered_slots"] == 0
+        and fixed["dead_lettered"] == 0 and adapt["dead_lettered"] == 0)
+    return {
+        "bench": "control_suite_summary",
+        "slo_deadline_rounds": slo,
+        "best_static_goodput_holding_slo": best_static,
+        "static_arms_holding_slo": [r["shed_rate_milli"] for r in holding],
+        "adaptive_goodput": adaptive["slo_ok"],
+        "adaptive_p99": adaptive["p99"],
+        "admission_bar": bool(adaptive_holds
+                              and adaptive["slo_ok"] >= best_static),
+        "chaos_fixed_retx": fixed["retransmissions"],
+        "chaos_adaptive_retx": adapt["retransmissions"],
+        "chaos_equal_delivery": equal_delivery,
+        "chaos_bar": bool(equal_delivery
+                          and adapt["retransmissions"]
+                          < fixed["retransmissions"]),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--offered", type=int, default=8000)
+    ap.add_argument("--static-arms", default="3000,4000,5000")
+    ap.add_argument("--rounds", type=int, default=32)
+    ap.add_argument("--warm", type=int, default=8)
+    ap.add_argument("--chaos-n", type=int, default=64)
+    ap.add_argument("--chaos-rounds", type=int, default=72)
+    # the cut lands at round 2 — while the tracked messages' acks are
+    # still in flight — so the whole outage window is spent retrying
+    ap.add_argument("--outage", default="2,22")
+    ap.add_argument("--sharded-n", type=int, default=512)
+    ap.add_argument("--skip-sharded", action="store_true")
+    ap.add_argument("--out", default="BENCH_control.jsonl")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny cell (n=64) — the tier-1 / suite_matrix "
+                         "smoke configuration; bars reported, not "
+                         "enforced")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.n, args.rounds, args.warm = 64, 16, 4
+        args.static_arms = "3000,5000"
+        args.chaos_n, args.chaos_rounds, args.outage = 32, 48, "2,14"
+        args.sharded_n = 64
+        if args.out == "BENCH_control.jsonl":
+            args.out = "/tmp/BENCH_control_smoke.jsonl"
+
+    static_arms = [int(r) for r in args.static_arms.split(",") if r]
+    outage = tuple(int(r) for r in args.outage.split(","))
+    assert args.warm >= 1 and args.rounds > args.warm
+
+    t0 = time.perf_counter()
+    adm_rows = run_admission(args.n, args.offered, static_arms,
+                             args.rounds, args.warm)
+    chaos_rows = run_chaos(args.chaos_n, args.chaos_rounds, outage)
+    all_rows = adm_rows + chaos_rows
+    if not args.skip_sharded:
+        all_rows += run_sharded(args.sharded_n, args.offered,
+                                args.rounds, args.warm)
+
+    summary = verdicts(adm_rows, chaos_rows)
+    summary["n_nodes"] = args.n
+    summary["total_wall_s"] = round(time.perf_counter() - t0, 1)
+    all_rows.append(summary)
+    print(f"summary: {summary}")
+
+    with open(args.out, "w") as f:
+        for row in all_rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"{len(all_rows)} rows -> {args.out}")
+
+    if not args.smoke and not (summary["admission_bar"]
+                               and summary["chaos_bar"]):
+        print("BAR FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -841,6 +841,105 @@ def dense_budget_test():
         assert stats["counts"]["all-reduce"] == 1, model
 
 
+def control_parity_test():
+    """ISSUE 10 tentpole contract: the in-scan admission controller's
+    setpoint trajectory bit-matches the plain-Python host twin replaying
+    the same metric stream, the 8-device sharded trajectory is
+    bit-identical to the unsharded one, the collective budget with
+    controllers ON stays exactly {all-to-all: 1, all-reduce: 1,
+    all-gather: 0}, and controllers OFF lowers the identical program.
+    Same program shapes as tests/test_control.py, shared via the
+    persistent compile cache."""
+    from partisan_tpu.control import (ControlSpec, Controller,
+                                      attach_plane, host_update_plane)
+    from partisan_tpu.control.plane import host_init_plane
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.models.stack import Lifted
+    from partisan_tpu.parallel import mesh as pmesh
+    from partisan_tpu.parallel.dataplane import (make_sharded_step,
+                                                 place_sharded_world,
+                                                 sharded_out_cap)
+    from partisan_tpu.workload import arrivals
+    from partisan_tpu.workload.driver import AdaptiveWorkloadRpc
+    cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=3,
+                    slo_deadline_rounds=8, shed_token_burst_milli=8000)
+    drv = AdaptiveWorkloadRpc(
+        cfg, promise_cap=8,
+        spec=arrivals.ArrivalSpec(kind=arrivals.POISSON, max_issue=4),
+        rate_milli=6000, shed_rate_milli=4000)
+    proto = Stacked(HyParView(cfg), Lifted(drv))
+    spec = ControlSpec((
+        Controller(name="admit", metric="rpc_slo_violated",
+                   actuator="wl.shed_rate_milli", kind="aimd",
+                   init=4000, target_milli=0, sense=1, delta=True,
+                   alpha_milli=400, add=200, mult_milli=900,
+                   lo=1000, hi=8000),
+    ))
+    world = attach_plane(pt.init_world(cfg, proto), spec)
+    step = pt.make_step(cfg, proto, donate=False, control=spec)
+    traj, rows = [], []
+    for _ in range(12):
+        world, m = step(world)
+        traj.append(int(m["ctl_admit__setpoint"]))
+        rows.append({k: int(v) for k, v in m.items() if np.ndim(v) == 0})
+    hp = host_init_plane(spec)
+    for m, sp in zip(rows, traj):
+        hp = host_update_plane(spec, hp, m)
+        assert hp["setpoint"][0] == sp  # host twin bit-parity
+    mesh = pmesh.make_mesh()
+    ws = attach_plane(
+        pt.init_world(cfg, proto,
+                      out_cap=sharded_out_cap(cfg, proto, 8, None)), spec)
+    ws = place_sharded_world(ws, cfg, mesh)
+    sstep = make_sharded_step(cfg, proto, mesh, donate=False,
+                              control=spec)
+    straj = []
+    for _ in range(12):
+        ws, sm = sstep(ws)
+        straj.append(int(sm["ctl_admit__setpoint"]))
+    assert straj == traj  # sharded == unsharded, bit-identical
+    st = pmesh.assert_collective_budget(
+        sstep.lower(ws).compile(), max_collectives=2,
+        max_bytes=32 * 1024 * 1024, forbid=("all-gather",))
+    assert st["counts"]["all-to-all"] == 1
+    assert st["counts"]["all-reduce"] == 1
+    assert st["counts"].get("all-gather", 0) == 0
+    w0 = pt.init_world(cfg, proto)
+    s_off = pt.make_step(cfg, proto, donate=False)
+    s_none = pt.make_step(cfg, proto, donate=False, control=None)
+    assert s_off.lower(w0).as_text() == s_none.lower(w0).as_text()
+
+
+def control_suite_smoke():
+    """ISSUE 10 bench-harness smoke: one tiny control_suite cell
+    through the real CLI — the admission static-vs-adaptive arms, the
+    chaos retransmit arms and the JSONL schema must hold end to end
+    (full benches live in scripts/control_suite.py ->
+    BENCH_control.jsonl; the sharded budget is control_parity_test's
+    pin, skipped here for wall time)."""
+    import importlib.util
+    import json
+    import tempfile
+    spec = importlib.util.spec_from_file_location(
+        "control_suite", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "control_suite.py"))
+    cs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cs)
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "bench.jsonl")
+        rc = cs.main(["--smoke", "--skip-sharded", "--out", out])
+        assert rc == 0
+        with open(out) as f:
+            rows = [json.loads(line) for line in f]
+    summary = rows[-1]
+    assert summary["bench"] == "control_suite_summary"
+    arms = {r["arm"] for r in rows[:-1]}
+    assert {"static", "adaptive", "chaos_fixed",
+            "chaos_adaptive"} <= arms
+    assert summary["chaos_equal_delivery"] is True
+    assert summary["chaos_adaptive_retx"] < summary["chaos_fixed_retx"]
+
+
 def dense_scale_smoke():
     """ISSUE 9 bench-harness smoke: one N=4096 window of the
     implicit-vs-explicit scale suite through the real CLI — both arms
@@ -1533,6 +1632,15 @@ def build_matrix():
         dense_budget_test)
     add("perf/dense", "dense_scale_smoke", "hyparview", "engine",
         dense_scale_smoke)
+
+    # ISSUE 10: the adaptive control plane — host-twin / sharded
+    # bit-parity + the controllers-on budget pin, and one tiny
+    # static-vs-adaptive bench cell (full arms live in
+    # scripts/control_suite.py -> BENCH_control.jsonl)
+    add("control/adaptive", "control_parity_test", "hyparview",
+        "engine", control_parity_test)
+    add("control/adaptive", "control_suite_smoke", "hyparview",
+        "engine", control_suite_smoke)
 
     return M
 

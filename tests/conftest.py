@@ -56,10 +56,14 @@ _RUN_LAST = ("tests/test_explorer.py", "TestScheduleValidation",
 _RUN_LAST_2 = ("tests/test_workload.py",)
 # tier 3: the ISSUE-9 explicit-SPMD dense dataplane is the newest of all
 _RUN_LAST_3 = ("tests/test_dense_dataplane.py",)
+# tier 4: the ISSUE-10 adaptive control plane is newer still
+_RUN_LAST_4 = ("tests/test_control.py",)
 
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_4):
+            return 4
         if any(k in it.nodeid for k in _RUN_LAST_3):
             return 3
         if any(k in it.nodeid for k in _RUN_LAST_2):

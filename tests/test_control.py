@@ -1,0 +1,547 @@
+"""Adaptive control plane tests (ISSUE 10): in-scan closed-loop
+controllers for admission, retransmission, and gossip cadence.
+
+The load-bearing checks:
+
+  * host-twin BIT-PARITY per primitive (EWMA filter, AIMD, additive
+    step) and for the full plane update over randomized int streams —
+    the controllers are pure integer milli-unit arithmetic, so the
+    Python twin must match the device exactly, not approximately;
+  * sharded == unsharded setpoint TRAJECTORIES on the 8-device mesh
+    (the plane updates from the one stacked psum both dataplanes
+    already emit, so every shard sees identical global inputs);
+  * the collective budget with controllers ON stays exactly
+    {all-to-all: 1, all-reduce: 1, all-gather: 0} on BOTH dataplanes —
+    closing the loop adds ZERO collectives;
+  * controllers OFF compiles byte-identical programs (the feature
+    gates at Python build time, per the repo-wide convention).
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import checkpoint as ckpt
+from partisan_tpu import peer_service as ps
+from partisan_tpu.control import (
+    ControlSpec,
+    Controller,
+    aimd_step,
+    additive_step,
+    attach_plane,
+    control_specs,
+    ewma_filter,
+    host_update_plane,
+    update_plane,
+)
+from partisan_tpu.control.controllers import (
+    host_aimd_step,
+    host_additive_step,
+    host_ewma_filter,
+)
+from partisan_tpu.control.plane import host_init_plane, metric_names
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.stack import Lifted, Stacked
+from partisan_tpu.parallel import dense_dataplane as dd
+from partisan_tpu.parallel import mesh as pmesh
+from partisan_tpu.parallel.dataplane import (
+    make_sharded_step,
+    place_sharded_world,
+    sharded_out_cap,
+)
+from partisan_tpu.qos.ack import AdaptiveAcked
+from partisan_tpu.workload import arrivals
+from partisan_tpu.workload.driver import AdaptiveWorkloadRpc
+
+# mid-weight tier (VERDICT r3 #10): deselect with the quick tier
+pytestmark = pytest.mark.standard
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+N_SHARDS = 8
+
+CFG = pt.Config(n_nodes=16, inbox_cap=16, seed=3, slo_deadline_rounds=8,
+                shed_token_burst_milli=8000)
+
+
+@functools.lru_cache(maxsize=None)
+def _proto():
+    drv = AdaptiveWorkloadRpc(
+        CFG, promise_cap=8,
+        spec=arrivals.ArrivalSpec(kind=arrivals.POISSON, max_issue=4),
+        rate_milli=6000, shed_rate_milli=4000)
+    return Stacked(HyParView(CFG), Lifted(drv))
+
+
+@functools.lru_cache(maxsize=None)
+def _spec():
+    return ControlSpec((
+        Controller(name="admit", metric="rpc_slo_violated",
+                   actuator="wl.shed_rate_milli", kind="aimd",
+                   init=4000, target_milli=0, sense=1, delta=True,
+                   alpha_milli=400, add=200, mult_milli=900,
+                   lo=1000, hi=8000),
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _unsharded_run():
+    """12 closed-loop rounds; returns (setpoint traj, raw metric rows)."""
+    proto, spec = _proto(), _spec()
+    world = attach_plane(pt.init_world(CFG, proto), spec)
+    step = pt.make_step(CFG, proto, donate=False, control=spec)
+    traj, rows = [], []
+    for _ in range(12):
+        world, m = step(world)
+        traj.append(int(m["ctl_admit__setpoint"]))
+        rows.append({k: int(v) for k, v in m.items() if np.ndim(v) == 0})
+    return traj, rows
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_run():
+    """Same 12 rounds on the 8-device mesh; returns
+    (setpoint traj, compiled collective counts)."""
+    proto, spec = _proto(), _spec()
+    mesh = pmesh.make_mesh()
+    world = attach_plane(
+        pt.init_world(CFG, proto,
+                      out_cap=sharded_out_cap(CFG, proto, N_SHARDS, None)),
+        spec)
+    world = place_sharded_world(world, CFG, mesh)
+    step = make_sharded_step(CFG, proto, mesh, donate=False, control=spec)
+    traj = []
+    for _ in range(12):
+        world, m = step(world)
+        traj.append(int(m["ctl_admit__setpoint"]))
+    comp = step.lower(world).compile()
+    stats = pmesh.collective_stats(comp)
+    pmesh.assert_collective_budget(comp, max_collectives=2,
+                                   max_bytes=32 * 1024 * 1024,
+                                   forbid=("all-gather",))
+    return traj, dict(stats["counts"])
+
+
+DENSE_CFG = pt.Config(n_nodes=256, shuffle_interval=4,
+                      random_promotion_interval=2)
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_spec():
+    return ControlSpec((
+        Controller(name="cadence", metric="lonely",
+                   actuator="dense.shuffle_interval", kind="step",
+                   init=4, target_milli=0, sense=-1, delta=False,
+                   alpha_milli=600, step=1, deadband_milli=200,
+                   lo=1, hi=16),
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_run(model):
+    """8 controlled dense rounds; returns (traj, collective counts)."""
+    spec = _dense_spec()
+    mesh = pmesh.make_mesh()
+    kw = {"model": model}
+    if model == "plumtree":
+        kw["broadcast_interval"] = 5
+    step = dd.make_sharded_dense_round(DENSE_CFG, mesh, control=spec, **kw)
+    init = (dd.sharded_pt_init if model == "plumtree"
+            else dd.sharded_dense_init)
+    st = dd.place_sharded(init(DENSE_CFG, N_SHARDS), mesh)
+    plane = spec.init_plane()
+    traj = []
+    for _ in range(8):
+        st, plane, m = step(st, plane)
+        traj.append(int(m["ctl_cadence__setpoint"]))
+    comp = step.lower(st, plane).compile()
+    counts = dict(pmesh.collective_stats(comp)["counts"])
+    return traj, counts
+
+
+# ============================================== primitive host-twin parity
+
+class TestPrimitiveParity:
+    """Device controller arithmetic bit-matches the plain-Python twins
+    over randomized int streams — including negative values, where
+    jnp's floor division must match Python's ``//``."""
+
+    RNG = np.random.default_rng(7)
+
+    def test_ewma_filter_parity(self):
+        f = jax.jit(functools.partial(ewma_filter, alpha_milli=400))
+        filt_d, filt_h = jnp.int32(0), 0
+        for err in self.RNG.integers(-(1 << 20), 1 << 20, size=200):
+            filt_d = f(filt_d, jnp.int32(int(err)))
+            filt_h = host_ewma_filter(filt_h, int(err), 400)
+            assert int(filt_d) == filt_h
+
+    def test_aimd_parity(self):
+        kw = dict(add=37, mult_milli=910, lo=100, hi=50_000)
+        f = jax.jit(functools.partial(aimd_step, **kw))
+        sp_d, sp_h = jnp.int32(4000), 4000
+        for dec in self.RNG.integers(0, 2, size=200):
+            sp_d = f(sp_d, jnp.bool_(bool(dec)))
+            sp_h = host_aimd_step(sp_h, bool(dec), **kw)
+            assert int(sp_d) == sp_h
+
+    def test_aimd_negative_add_grows_down(self):
+        """mult_milli > 1000 with add < 0: the adaptive-retransmit shape
+        (double on stall, decay by 1) stays inside [lo, hi]."""
+        kw = dict(add=-1, mult_milli=2000, lo=4, hi=64)
+        sp = 4
+        for dec in [True, True, True, True, True, False, False]:
+            sp = host_aimd_step(sp, dec, **kw)
+            assert 4 <= sp <= 64
+            d = aimd_step(jnp.int32(4), jnp.bool_(dec), **kw)
+            assert 4 <= int(d) <= 64
+        assert sp == 62  # 4 -> 8 -> 16 -> 32 -> 64, then 63, 62
+
+    def test_additive_step_parity(self):
+        kw = dict(step=3, deadband_milli=500, lo=1, hi=100)
+        f = jax.jit(functools.partial(additive_step, **kw))
+        sp_d, sp_h = jnp.int32(50), 50
+        for err in self.RNG.integers(-(1 << 20), 1 << 20, size=200):
+            sp_d = f(sp_d, jnp.int32(int(err)))
+            sp_h = host_additive_step(sp_h, int(err), **kw)
+            assert int(sp_d) == sp_h
+
+    def test_additive_step_deadband(self):
+        """Inside the deadband the setpoint HOLDS (hysteresis, no hunt);
+        positive error drives the setpoint DOWN."""
+        kw = dict(step=2, deadband_milli=1000, lo=0, hi=10)
+        assert host_additive_step(5, 0, **kw) == 5
+        assert host_additive_step(5, 1000, **kw) == 5      # on the edge
+        assert host_additive_step(5, 1001, **kw) == 3      # above: down
+        assert host_additive_step(5, -1001, **kw) == 7     # below: up
+
+    def test_full_plane_update_parity(self):
+        """update_plane vs host_update_plane over a random metric stream
+        — one AIMD delta loop + one additive absolute loop."""
+        spec = ControlSpec((
+            Controller(name="a", metric="m1", actuator="x.a", kind="aimd",
+                       init=1000, sense=1, delta=True, alpha_milli=300,
+                       add=50, mult_milli=850, lo=10, hi=100_000),
+            Controller(name="b", metric="m2", actuator="x.b", kind="step",
+                       init=8, target_milli=5000, sense=-1, delta=False,
+                       alpha_milli=700, step=1, deadband_milli=400,
+                       lo=1, hi=64),
+        ))
+        dev = spec.init_plane()
+        host = host_init_plane(spec)
+        upd = jax.jit(functools.partial(update_plane, spec))
+        for _ in range(60):
+            m = {"m1": int(self.RNG.integers(0, 5000)),
+                 "m2": int(self.RNG.integers(0, 40))}
+            dev = upd(dev, {k: jnp.int32(v) for k, v in m.items()})
+            host = host_update_plane(spec, host, m)
+            assert list(np.asarray(dev.setpoint)) == host["setpoint"]
+            assert list(np.asarray(dev.filt)) == host["filt"]
+            assert list(np.asarray(dev.prev)) == host["prev"]
+
+
+# ==================================================== spec validation
+
+class TestSpecValidation:
+    def test_duplicate_name(self):
+        with pytest.raises(ValueError, match="duplicate controller"):
+            ControlSpec((Controller(name="x", metric="m"),
+                         Controller(name="x", metric="m")))
+
+    def test_unknown_kind(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            ControlSpec((Controller(name="x", metric="m", kind="pid"),))
+
+    def test_overflow_guard(self):
+        with pytest.raises(ValueError, match="overflow"):
+            ControlSpec((Controller(name="x", metric="m",
+                                    mult_milli=2000, hi=1 << 21),))
+
+    def test_unknown_metric_named_error(self):
+        spec = ControlSpec((Controller(name="x", metric="no_such_metric",
+                                       actuator="wl.shed_rate_milli"),))
+        with pytest.raises(ValueError, match="unknown metric"):
+            pt.make_step(CFG, _proto(), donate=False, control=spec)
+
+    def test_unknown_actuator_named_error(self):
+        spec = ControlSpec((Controller(name="x", metric="delivered",
+                                       actuator="no.such_knob"),))
+        with pytest.raises(ValueError, match="unknown actuator"):
+            pt.make_step(CFG, _proto(), donate=False, control=spec)
+
+    def test_stacked_lifted_actuator_names(self):
+        """The stack surfaces the adaptive workload driver's knobs."""
+        assert _proto().actuator_names == (
+            "wl.shed_rate_milli", "wl.max_outstanding",
+            "wl.retransmit_base")
+
+    def test_adaptive_acked_actuator_names(self):
+        assert AdaptiveAcked(CFG).actuator_names == \
+            ("ack.retransmit_base",)
+
+
+# ============================================== sparse dataplane closed loop
+
+class TestSparseControl:
+    def test_loop_actually_moves(self):
+        traj, _ = _unsharded_run()
+        assert traj[0] != traj[-1]  # closed loop, not a constant
+        spec = _spec()
+        c = spec.controllers[0]
+        assert all(c.lo <= sp <= c.hi for sp in traj)
+
+    def test_host_twin_closed_loop_parity(self):
+        """The host twin replays the device's raw metric stream and must
+        reproduce the setpoint trajectory bit-for-bit."""
+        traj, rows = _unsharded_run()
+        spec = _spec()
+        hp = host_init_plane(spec)
+        host_traj = []
+        for m in rows:
+            hp = host_update_plane(spec, hp, m)
+            host_traj.append(hp["setpoint"][0])
+        assert host_traj == traj
+
+    @needs_mesh
+    def test_sharded_matches_unsharded(self):
+        """The plane updates from post-psum totals, so the 8-shard
+        trajectory is bit-identical to the single-device one."""
+        traj, _ = _unsharded_run()
+        straj, _ = _sharded_run()
+        assert straj == traj
+
+    @needs_mesh
+    def test_budget_controllers_on(self):
+        """Closing the loop adds ZERO collectives: exactly one
+        all-to-all + one all-reduce, no all-gathers."""
+        _, counts = _sharded_run()
+        assert counts.get("all-to-all", 0) == 1
+        assert counts.get("all-reduce", 0) == 1
+        assert counts.get("all-gather", 0) == 0
+
+    def test_controllers_off_byte_identity(self):
+        """control=None lowers to the IDENTICAL program as the default
+        build — the feature gates at Python level."""
+        proto = _proto()
+        w0 = pt.init_world(CFG, proto)
+        s1 = pt.make_step(CFG, proto, donate=False)
+        s2 = pt.make_step(CFG, proto, donate=False, control=None)
+        assert s1.lower(w0).as_text() == s2.lower(w0).as_text()
+
+
+# =============================================== dense dataplane closed loop
+
+@needs_mesh
+class TestDenseControl:
+    def test_hv_budget_and_trajectory(self):
+        traj, counts = _dense_run("hyparview")
+        assert counts.get("all-to-all", 0) == 1
+        assert counts.get("all-reduce", 0) == 1
+        assert counts.get("all-gather", 0) == 0
+        c = _dense_spec().controllers[0]
+        assert all(c.lo <= sp <= c.hi for sp in traj)
+
+    def test_plumtree_budget(self):
+        _, counts = _dense_run("plumtree")
+        assert counts.get("all-to-all", 0) == 1
+        assert counts.get("all-reduce", 0) == 1
+        assert counts.get("all-gather", 0) == 0
+
+    def test_controllers_off_byte_identity(self):
+        mesh = pmesh.make_mesh()
+        s1 = dd.make_sharded_dense_round(DENSE_CFG, mesh)
+        s2 = dd.make_sharded_dense_round(DENSE_CFG, mesh, control=None)
+        st = dd.place_sharded(dd.sharded_dense_init(DENSE_CFG, N_SHARDS),
+                              mesh)
+        assert s1.lower(st).as_text() == s2.lower(st).as_text()
+
+    def test_scamp_control_named_error(self):
+        mesh = pmesh.make_mesh()
+        with pytest.raises(ValueError, match="scamp"):
+            dd.make_sharded_dense_round(DENSE_CFG, mesh, model="scamp",
+                                        control=_dense_spec())
+
+    def test_flight_control_named_error(self):
+        from partisan_tpu.telemetry.flight import FlightSpec
+        mesh = pmesh.make_mesh()
+        with pytest.raises(ValueError, match="flight"):
+            dd.make_sharded_dense_round(
+                DENSE_CFG, mesh, control=_dense_spec(),
+                flight=FlightSpec(window=8, cap=8))
+
+
+# ======================================================= runtime knobs
+
+class TestKnobs:
+    def test_set_knob_pins_then_clear_resumes(self):
+        proto, spec = _proto(), _spec()
+        step = pt.make_step(CFG, proto, donate=False, control=spec)
+        world = attach_plane(pt.init_world(CFG, proto), spec)
+        for _ in range(3):
+            world, m = step(world)
+        world = ps.set_knob(world, spec, "admit", 2222)
+        for _ in range(3):
+            world, m = step(world)
+            assert int(m["ctl_admit__setpoint"]) == 2222  # pinned
+        world = ps.clear_knob(world, spec, "admit")
+        world, m = step(world)
+        assert int(m["ctl_admit__setpoint"]) != 2222  # loop resumed
+
+    def test_unknown_knob_named_error(self):
+        spec = _spec()
+        world = attach_plane(pt.init_world(CFG, _proto()), spec)
+        with pytest.raises(ValueError,
+                           match="unknown control knob 'nope'"):
+            ps.set_knob(world, spec, "nope", 1)
+
+    def test_set_knob_requires_plane(self):
+        world = pt.init_world(CFG, _proto())  # no plane attached
+        with pytest.raises(ValueError, match="no ControlPlane"):
+            ps.set_knob(world, _spec(), "admit", 1)
+
+    def test_attach_plane_refuses_occupied_aux(self):
+        world = pt.init_world(CFG, _proto()).replace(aux={"faults": 1})
+        with pytest.raises(ValueError, match="aux is occupied"):
+            attach_plane(world, _spec())
+
+
+# ================================================ checkpoint kill-and-resume
+
+@needs_mesh
+class TestCheckpointResume:
+    def test_kill_and_resume_bit_identical(self, tmp_path):
+        """Save mid-trajectory on the mesh, restore through
+        load_sharded(control=...), and the resumed run must continue the
+        controller trajectory (and the whole world) bit-identically."""
+        proto, spec = _proto(), _spec()
+        mesh = pmesh.make_mesh()
+        world = attach_plane(
+            pt.init_world(CFG, proto,
+                          out_cap=sharded_out_cap(CFG, proto, N_SHARDS,
+                                                  None)), spec)
+        world = place_sharded_world(world, CFG, mesh)
+        step = make_sharded_step(CFG, proto, mesh, donate=False,
+                                 control=spec)
+        for _ in range(4):
+            world, _m = step(world)
+        path = str(tmp_path / "ck")
+        ckpt.save(path, CFG, world, proto=proto)
+
+        cont_traj, w_cont = [], world
+        for _ in range(4):
+            w_cont, m = step(w_cont)
+            cont_traj.append(int(m["ctl_admit__setpoint"]))
+
+        restored, _mf = ckpt.load_sharded(path, CFG, proto, mesh,
+                                          control=spec)
+        res_traj, w_res = [], restored
+        for _ in range(4):
+            w_res, m = step(w_res)
+            res_traj.append(int(m["ctl_admit__setpoint"]))
+
+        assert res_traj == cont_traj
+        for a, b in zip(jax.tree_util.tree_leaves(w_cont),
+                        jax.tree_util.tree_leaves(w_res)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_spec_drift_named_error(self, tmp_path):
+        """Restoring with a DIFFERENT controller count fails with the
+        named .aux leaf error, not a reshape crash."""
+        proto, spec = _proto(), _spec()
+        mesh = pmesh.make_mesh()
+        world = attach_plane(
+            pt.init_world(CFG, proto,
+                          out_cap=sharded_out_cap(CFG, proto, N_SHARDS,
+                                                  None)), spec)
+        world = place_sharded_world(world, CFG, mesh)
+        path = str(tmp_path / "ck")
+        ckpt.save(path, CFG, world, proto=proto)
+        two = ControlSpec(spec.controllers + (
+            Controller(name="extra", metric="delivered"),))
+        with pytest.raises(ValueError, match=r"aux"):
+            ckpt.load_sharded(path, CFG, proto, mesh, control=two)
+
+
+# ========================================================= telemetry wiring
+
+@functools.lru_cache(maxsize=None)
+def _ring_rows():
+    from partisan_tpu.telemetry.registry import default_registry
+    from partisan_tpu.telemetry.ring import flush, make_ring
+    from partisan_tpu.telemetry.runner import make_window_runner
+    proto, spec = _proto(), _spec()
+    reg = default_registry().with_specs(control_specs(spec))
+    runner = make_window_runner(CFG, proto, reg, window=6, control=spec)
+    world = attach_plane(pt.init_world(CFG, proto), spec)
+    rows, _ring = flush(runner(world, make_ring(reg, 6))[1], reg)
+    return reg, tuple(rows)
+
+
+class TestTelemetry:
+    def test_gauges_land_in_ring(self):
+        _reg, rows = _ring_rows()
+        assert len(rows) == 6
+        for name in metric_names(_spec()):
+            assert name in rows[0]
+        # the setpoint gauge carries the live value, not a zeroed slot
+        assert rows[-1]["ctl_admit__setpoint"] >= 1000
+
+    def test_prometheus_exposition(self):
+        from partisan_tpu.telemetry.sinks import (PrometheusSink,
+                                                  parse_exposition)
+        reg, rows = _ring_rows()
+        sink = PrometheusSink(registry=reg)
+        for r in rows:
+            sink.write_row(r)
+        parsed = parse_exposition(sink.expose())
+        key = [k for k in parsed if "ctl_admit__setpoint" in k]
+        assert key, sorted(parsed)
+        fam = parsed[key[0]]
+        assert fam["type"] == "gauge"
+        assert list(fam["samples"].values())[0] == \
+            rows[-1]["ctl_admit__setpoint"]
+
+    def test_perfetto_counter_track(self):
+        from partisan_tpu.telemetry.perfetto import chrome_trace
+        _reg, rows = _ring_rows()
+        trace = chrome_trace(metric_rows=rows)
+        counters = [e for e in trace["traceEvents"]
+                    if e.get("ph") == "C"
+                    and e.get("name") == "ctl_admit__setpoint"]
+        assert len(counters) == len(rows)
+
+
+# ====================================================== port bridge knobs
+
+class TestPortBridge:
+    def test_adaptive_session_knob_roundtrip(self):
+        from partisan_tpu.bridge.etf import Atom
+        from partisan_tpu.bridge.port_server import Session
+        s = Session()
+        r = s.handle((Atom("start"), Atom("hyparview"),
+                      [(Atom("n_nodes"), 8), (Atom("seed"), 1),
+                       (Atom("adaptive"), True),
+                       (Atom("shed_token_rate_milli"), 4000)]))
+        assert r == Atom("ok"), r
+        r = s.handle((Atom("advance"), 2))
+        assert r[0] == Atom("ok")
+        assert Atom("ctl_admit__setpoint") in r[1]
+        assert s.handle((Atom("set_knob"), Atom("admit"), 2000)) == \
+            Atom("ok")
+        r = s.handle((Atom("advance"), 1))
+        assert r[1][Atom("ctl_admit__setpoint")] == 2000
+        r = s.handle((Atom("set_knob"), Atom("nope"), 1))
+        assert r[0] == Atom("error")
+        assert b"unknown control knob" in r[1]
+
+    def test_knobs_need_started_session(self):
+        from partisan_tpu.bridge.etf import Atom
+        from partisan_tpu.bridge.port_server import Session
+        r = Session().handle((Atom("set_knob"), Atom("admit"), 1))
+        assert r == (Atom("error"), Atom("not_started"))
