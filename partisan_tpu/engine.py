@@ -535,6 +535,7 @@ def make_round_kernels(cfg: Config, proto: ProtocolBase, n_rows: int):
                     state, em_slot = apply_t((state, em_slot))
             return store_em_slot((state,) + tuple(carry[1:]), em_slot, k)
 
+        # trace-lint: allow(config-fork): deliver_gate picks the kernel variant at build time (repo convention: features gate in Python)
         if not cfg.deliver_gate:
             def fori_body(k, carry):
                 return dense_slot(k, carry)
@@ -806,6 +807,7 @@ def make_step(
 
         # -- connection lanes: partition-key hash or random spread over the
         #    k parallel connections (dispatch_pid, partisan_util.erl:142-201)
+        # trace-lint: allow(config-fork): lane dispatch is compiled in or out per config at build time, both programs are budget-tested
         if cfg.parallelism > 1:
             now = msgops.dispatch(
                 now, cfg.parallelism,
@@ -850,6 +852,7 @@ def make_step(
         new = new.replace(valid=new.valid & alive_src)
         # transport delays (ingress_delay + egress_delay, Config): extra
         # rounds in flight, stamped once at emission
+        # trace-lint: allow(config-fork): delay stamping traces in only when configured — zero-cost in the default program
         if cfg.ingress_delay or cfg.egress_delay:
             new = new.replace(
                 delay=new.delay + cfg.ingress_delay + cfg.egress_delay)

@@ -193,6 +193,7 @@ class DataPlane(UpperProtocol):
         # never be retransmitted); the drop is counted above
         ship = ~want_ack | stored
         wire_clock = jnp.where(stored, seq, 0)
+        # trace-lint: allow(config-fork): unicast vs broadcast forwarding is a build-time protocol variant (with_broadcast suite rows)
         if not cfg.broadcast:
             em = self.emit(jnp.where(ship, dst, -1)[None], self.typ("fwd"),
                            channel=m.channel,
@@ -319,6 +320,7 @@ class DataPlane(UpperProtocol):
                         out_attempt=attempt,
                         dead_lettered=up.dead_lettered + dead)
         row = self.up(row, up)
+        # trace-lint: allow(config-fork): unicast vs broadcast retransmit path is the same build-time variant as handle_ctl_fwd's
         if not cfg.broadcast:
             em = self.emit(jnp.where(due, up.out_dst, -1), self.typ("fwd"),
                            cap=self.tick_emit_cap, channel=up.out_chan,

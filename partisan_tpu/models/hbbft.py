@@ -74,6 +74,7 @@ def _digest(batch: jax.Array) -> jax.Array:
     folded too (they are part of the canonical fixed-shape block)."""
     h = jnp.uint32(0x9E3779B9)
     x = batch.astype(jnp.uint32)
+    # trace-lint: allow(unroll-bomb): batch width is the tiny static B of the hbbft payload — bounded unroll keeps the digest fused
     for i in range(batch.shape[-1]):
         h = h ^ (x[..., i] + jnp.uint32(0x85EBCA6B) + (h << 6) + (h >> 2))
         h = (h ^ (h >> 16)) * jnp.uint32(0x7FEB352D)

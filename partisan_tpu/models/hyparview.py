@@ -298,7 +298,8 @@ class HyParView(ProtocolBase):
                         key: jax.Array) -> HvState:
         """merge_exchange (:1589-1595): fold a received sample into the
         passive view."""
-        for j in range(sample.shape[0]):  # static unroll, S is tiny
+        # trace-lint: allow(unroll-bomb): S (shuffle sample width) is a tiny static Config bound; each step reuses the previous add's row
+        for j in range(sample.shape[0]):
             row = self._add_passive(cfg, me, row, sample[j],
                                     prng.decision_key(key, 10 + j))
         return row
@@ -502,7 +503,8 @@ class HyParView(ProtocolBase):
         """Append (ref, peer) for every current active peer to the
         partition table (handle_partition_injection :1748-1772);
         duplicates skipped, overflow counted."""
-        for j in range(row.active.shape[0]):   # static unroll over A
+        # trace-lint: allow(unroll-bomb): A (active view width) is a tiny static Config bound; dedup needs the sequential fold
+        for j in range(row.active.shape[0]):
             p = row.active[j]
             dup = jnp.any((row.part_ref == ref) & (row.part_peer == p))
             want = (p >= 0) & (ref >= 0) & ~dup
@@ -582,7 +584,8 @@ class HyParView(ProtocolBase):
         expired_peers = jnp.where(expired, row.active, -1)
         row = row.replace(active=jnp.where(expired, -1, row.active),
                           active_ttl=ttl)
-        for j in range(expired_peers.shape[0]):  # static unroll over A slots
+        # trace-lint: allow(unroll-bomb): A slots, same tiny static bound and sequential _add_passive fold as _merge_exchange
+        for j in range(expired_peers.shape[0]):
             row = self._add_passive(cfg, me, row, expired_peers[j],
                                     prng.decision_key(key, 40 + j))
         # staggered by node id: ~N/interval nodes fire per round, avoiding

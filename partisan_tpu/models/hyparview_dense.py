@@ -460,6 +460,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
             # rows — schedule composition outweighs op savings on this
             # round, the recurring round-4 lesson.
             e = ids
+            # trace-lint: allow(unroll-bomb): arwl is the HyParView active random-walk length, a small static Config bound (default 6)
             for h in range(cfg.arwl):
                 rows = _gather_rows(active, e)
                 step_to = jax.vmap(
@@ -692,6 +693,7 @@ def bounded_bfs(expand_hops, alive: jax.Array, n: int,
     budget = max(4096, n)
     for _ in range(max(1, budget // hops)):
         r, changed = expand_hops(r, hops)
+        # trace-lint: allow(traced-coercion): host-driven fixpoint — expand_hops is a bounded jitted launch, changed is concrete here
         if not bool(changed):
             return r
     raise RuntimeError(

@@ -283,7 +283,8 @@ class Plumtree(UpperProtocol):
         new = jnp.where(already, -1, peers)
         owned = up.root_key >= 0
         eager = up.eager
-        for j in range(new.shape[0]):          # static unroll over A
+        # trace-lint: allow(unroll-bomb): A (eager set width) is a tiny static Config bound; lazy-set dedup folds sequentially
+        for j in range(new.shape[0]):
             pj = new[j]
             add = owned & ~jax.vmap(ps.contains, in_axes=(0, None))(
                 up.lazy, pj)

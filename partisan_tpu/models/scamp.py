@@ -119,6 +119,7 @@ class ScampV1(ProtocolBase):
     # ------------------------------------------------------------- primitives
 
     def _keep_probability(self, row: ScampState) -> jax.Array:
+        # trace-lint: allow(config-fork): exact-vs-quantized keep coin is a build-time reference-parity mode, both arms scalar
         if self.cfg.scamp_exact_keep_probability:
             return 1.0 / (1.0 + ps.size(row.partial).astype(jnp.float32))
         return jnp.float32(0.4)  # the reference's quantized coin (:352-360)
@@ -183,6 +184,7 @@ class ScampV1(ProtocolBase):
         empty-view contact keeps the subscription directly (first join).
 
         Reference mode: identical to a forward_subscription walk hop."""
+        # trace-lint: allow(config-fork): paper-fanout vs walk-hop subscription is a build-time reference-parity mode
         if not cfg.scamp_paper_fanout:
             return self.handle_forward_subscription(cfg, me, row, m, key)
         subject = m.data["subject"]

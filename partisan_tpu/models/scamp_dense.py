@@ -356,6 +356,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         hsize = jnp.where(active_w,
                           sizes_all[jnp.clip(flat_pos, 0, N - 1)], 0)
         can_keep = active_w & (flat_pos != subj)
+        # trace-lint: allow(config-fork): same build-time keep-coin mode as ScampV1._keep_probability, dense lowering
         if cfg.scamp_exact_keep_probability:
             p_keep = 1.0 / (1.0 + hsize.astype(jnp.float32))
         else:

@@ -335,10 +335,18 @@ def wire_hash(m: Msgs) -> jax.Array:
     for j, name in enumerate(sorted(m.data)):
         x = m.data[name]
         flat = x.reshape((m.cap, -1)).astype(jnp.uint32)
-        fold = jnp.zeros((m.cap,), jnp.uint32)
-        for c in range(flat.shape[1]):
-            fold = _mix(fold ^ flat[:, c]
-                        ^ jnp.uint32((c * 0x9E3779B9) & 0xFFFFFFFF))
+
+        # column fold as a fori_loop, not a Python unroll: the trip
+        # count is the flattened payload width, so the unrolled form
+        # grew the jaxpr linearly with payload shape (trace-lint
+        # unroll-bomb).  uint32 multiply wraps mod 2^32, so the salt
+        # term is bit-identical to the old `(c * K) & 0xFFFFFFFF`.
+        def _col(c, fold, flat=flat):
+            salt = c.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+            return _mix(fold ^ flat[:, c] ^ salt)
+
+        fold = jax.lax.fori_loop(
+            0, flat.shape[1], _col, jnp.zeros((m.cap,), jnp.uint32))
         h = _mix(h ^ fold ^ jnp.uint32(((j + 1) * 0x85EBCA6B) & 0xFFFFFFFF))
     return h
 

@@ -416,6 +416,7 @@ def make_sharded_step(
         # -- connection lanes + monotonic elide run PRE-exchange: every
         #    message of a (src, dst, channel, lane) connection is still
         #    on the src's shard here, so keep-latest sees the whole group
+        # trace-lint: allow(config-fork): lane dispatch compiled in or out per config at build time, mirrors engine.make_step
         if cfg.parallelism > 1:
             now = msgops.dispatch(
                 now, cfg.parallelism,
@@ -475,6 +476,7 @@ def make_sharded_step(
         new, src_row2, node_dropped = kernels.collect(
             delivered, temits, node_ids, rnd)
         new = new.replace(valid=new.valid & world.alive[src_row2])
+        # trace-lint: allow(config-fork): delay stamping traces in only when configured, mirrors engine.make_step
         if cfg.ingress_delay or cfg.egress_delay:
             new = new.replace(
                 delay=new.delay + cfg.ingress_delay + cfg.egress_delay)

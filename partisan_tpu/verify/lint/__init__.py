@@ -1,0 +1,20 @@
+"""Trace-lint: compile-surface static analysis (ISSUE 11).
+
+Two levels:
+
+* **Level 1** (:mod:`.engine`, :mod:`.rules`, :mod:`.twins`) — a pure
+  stdlib AST walk over every module in ``partisan_tpu/`` flagging
+  tracing hazards in jit-reachable code.  Importing these modules does
+  NOT import JAX; ``scripts/trace_lint.py`` runs them in milliseconds.
+* **Level 2** (:mod:`.fingerprint`) — lower-only program fingerprints
+  of the flagship entrypoints (jaxpr eqn counts, StableHLO collective
+  counts, lowered-text size) diffed against the committed golden
+  ``LINT_fingerprints.json``.  Importing it DOES import JAX, so it is
+  deliberately not re-exported here.
+
+See README "Static analysis & compile-surface lint".
+"""
+
+from .engine import lint_paths, lint_source, lint_tree  # noqa: F401
+from .report import (ENGINE_RULES, Finding, RULES,  # noqa: F401
+                     format_report)

@@ -1,0 +1,551 @@
+"""Trace-lint Level 1: the AST rule engine.
+
+The reference derives protocol annotations from a *static* Core-Erlang
+walk (``partisan_analysis.erl``); ``verify/static_analysis.py`` already
+rebuilt that direction for causality.  This module extends it to the
+whole compile surface: every module under ``partisan_tpu/`` is parsed
+(never imported — no JAX required) and functions are classified as
+traced or host, then the rules in :mod:`.rules` run over the traced
+ones with a provenance analysis that tells a build-time constant from a
+Config field from a traced value.
+
+Classification (deliberately two-tier, over-approximating TRACED):
+
+* **Tier A — structurally traced.**  A function is traced if it is
+  passed by name into a trace entry point (``jax.jit``, ``vmap``,
+  ``lax.scan``/``cond``/``switch``/``while_loop``/``fori_loop``,
+  ``shard_map``, ``pallas_call`` …), if it is a protocol handler by the
+  repo's naming convention (``handle_*``, ``tick``, ``tick_upper``), or
+  if it is reachable from a Tier-A function through ``self.X(...)`` /
+  local-name calls / ``functools.partial`` aliases.  Nested ``def``s
+  inside a Tier-A body are traced too (they are the scan/cond bodies).
+* **Tier B — heuristically traced.**  Anything else that *uses* traced
+  ops (``jnp.``/``lax.``/``jax.lax`` …) and shows no host marker
+  (``np.asarray``, ``device_get``, ``block_until_ready``, ``.tolist``,
+  ``print``, ``time.``) and is not a builder by name (``make_*``,
+  ``*_init``, ``host_*``, ``__init__``, ``test_*`` …).  Builders run at
+  Python time by convention across this repo ("the feature gates at
+  build time"), so their loops over config are exactly the intended
+  place for config-dependent structure.
+
+Provenance lattice (what the rules compare against)::
+
+    STATIC(0) < PARAM(1) < CONFIG(2) < SHAPE(3) < RUNTIME(4)
+
+Function parameters sit at PARAM — builder params like ``fanout`` or
+``n_shards`` are static-by-construction in this codebase, and treating
+them as runtime would bury the real findings under noise.  The price is
+flow-insensitivity in the other direction: a loop bounded by a
+genuinely-traced *parameter* is not flagged (it would not trace at all,
+so XLA catches it long before CI would).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .report import Finding, apply_pragmas, parse_pragmas
+
+# ----------------------------------------------------------- provenance
+
+STATIC, PARAM, CONFIG, SHAPE, RUNTIME = 0, 1, 2, 3, 4
+LEVEL_NAMES = {STATIC: "static", PARAM: "param", CONFIG: "config",
+               SHAPE: "shape", RUNTIME: "runtime"}
+
+#: callables that begin a traced region when handed a function by name
+TRACE_ENTRIES = frozenset({
+    "jit", "vmap", "pmap", "scan", "cond", "switch", "while_loop",
+    "fori_loop", "shard_map", "pallas_call", "remat", "checkpoint",
+    "associative_scan", "custom_vjp", "custom_jvp", "named_call",
+})
+
+#: module aliases whose attribute calls mean "this code builds a jaxpr"
+_TRACED_ROOTS = frozenset({"jnp", "lax"})
+#: calls/attrs that mean "this function syncs to host" — a function
+#: containing one is host-side glue even if it also touches jnp
+_HOST_CALL_ATTRS = frozenset({
+    "device_get", "block_until_ready", "tolist", "item",
+})
+#: these are host markers only under a numpy root (jnp.asarray is a
+#: device op; np.asarray is THE canonical host transfer)
+_NP_HOST_ATTRS = frozenset({"asarray", "array"})
+_NP_ROOTS = frozenset({"np", "numpy", "onp"})
+_HOST_CALL_NAMES = frozenset({"print", "input", "open"})
+_HOST_ROOTS = frozenset({"time", "os", "sys", "json", "csv"})
+
+#: ``.attr`` accesses that stay compile-time even on a traced array
+_SHAPE_ATTRS = frozenset({"shape", "size"})
+_STATIC_ATTRS = frozenset({"ndim", "dtype", "at"})
+
+
+def _dotted_root(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an Attribute chain (``jax.lax.scan`` -> jax)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_cfg_base(node: ast.AST) -> bool:
+    """``cfg.X`` / ``self.cfg.X`` / ``some_cfg.X`` bases."""
+    if isinstance(node, ast.Name):
+        return node.id == "cfg" or node.id.endswith("cfg")
+    if isinstance(node, ast.Attribute):
+        return node.attr == "cfg" or node.attr.endswith("cfg")
+    return False
+
+
+class ProvEnv:
+    """Per-function provenance environment with a lexical parent chain
+    (closures see the enclosing function's locals)."""
+
+    def __init__(self, fn: "FnInfo", parent: Optional["ProvEnv"],
+                 module_consts: Dict[str, int]):
+        self.parent = parent
+        self.module_consts = module_consts
+        self.names: Dict[str, int] = {}
+        args = fn.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            self.names[a.arg] = PARAM
+        if args.vararg:
+            self.names[args.vararg.arg] = PARAM
+        if args.kwarg:
+            self.names[args.kwarg.arg] = PARAM
+        if "self" in self.names:
+            # `self` itself is the protocol/builder object, not a tracer
+            self.names["self"] = STATIC
+        self._fill(fn)
+
+    def _fill(self, fn: "FnInfo") -> None:
+        # single in-order pass over the function's OWN statements
+        # (nested defs excluded): flow-insensitive, last write wins,
+        # which matches the straight-line style of the traced code here
+        for node in fn.own_nodes():
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    self._assign(tgt, node.value)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    lvl = max(self.lookup(node.target.id),
+                              self.prov(node.value))
+                    self.names[node.target.id] = lvl
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._assign(node.target, node.value)
+            elif isinstance(node, ast.For):
+                # loop variable inherits the iterable's provenance
+                self._assign_level(node.target, self.prov(node.iter))
+            elif isinstance(node, (ast.withitem,)) and node.optional_vars:
+                self._assign(node.optional_vars, node.context_expr)
+
+    def _assign(self, tgt: ast.AST, value: ast.AST) -> None:
+        if isinstance(tgt, ast.Name):
+            self.names[tgt.id] = self.prov(value)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            vals = (value.elts if isinstance(value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(tgt.elts) else None)
+            for i, t in enumerate(tgt.elts):
+                if vals is not None:
+                    self._assign(t, vals[i])
+                else:
+                    self._assign_level(t, self.prov(value))
+        # attribute/subscript targets carry no new name binding
+
+    def _assign_level(self, tgt: ast.AST, level: int) -> None:
+        if isinstance(tgt, ast.Name):
+            self.names[tgt.id] = level
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for t in tgt.elts:
+                self._assign_level(t, level)
+
+    def lookup(self, name: str) -> int:
+        env: Optional[ProvEnv] = self
+        while env is not None:
+            if name in env.names:
+                return env.names[name]
+            env = env.parent
+        # module-level constant / import / def — build-time by definition
+        return STATIC
+
+    # -- expression provenance ------------------------------------------
+
+    def prov(self, node: ast.AST) -> int:
+        if isinstance(node, ast.Constant):
+            return STATIC
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id)
+        if isinstance(node, ast.Attribute):
+            return self._attr_prov(node)
+        if isinstance(node, ast.Subscript):
+            if (isinstance(node.value, ast.Attribute)
+                    and node.value.attr in _SHAPE_ATTRS):
+                return SHAPE          # x.shape[0]
+            return max(self.prov(node.value), self.prov(node.slice))
+        if isinstance(node, ast.Call):
+            return self._call_prov(node)
+        if isinstance(node, (ast.BinOp,)):
+            return max(self.prov(node.left), self.prov(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.prov(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return max((self.prov(v) for v in node.values), default=STATIC)
+        if isinstance(node, ast.Compare):
+            return max([self.prov(node.left)]
+                       + [self.prov(c) for c in node.comparators])
+        if isinstance(node, ast.IfExp):
+            return max(self.prov(node.test), self.prov(node.body),
+                       self.prov(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return max((self.prov(e) for e in node.elts), default=STATIC)
+        if isinstance(node, ast.Starred):
+            return self.prov(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return max((self.prov(v.value) for v in node.values
+                        if isinstance(v, ast.FormattedValue)),
+                       default=STATIC)
+        if isinstance(node, ast.Slice):
+            return max((self.prov(p) for p in
+                        (node.lower, node.upper, node.step)
+                        if p is not None), default=STATIC)
+        return STATIC
+
+    def _attr_prov(self, node: ast.Attribute) -> int:
+        if node.attr in _STATIC_ATTRS:
+            return STATIC
+        if node.attr in _SHAPE_ATTRS:
+            return SHAPE
+        if _is_cfg_base(node.value):
+            return CONFIG
+        if isinstance(node.value, ast.Name):
+            base = node.value.id
+            if base == "self":
+                # instance attribute: fixed at build time per object,
+                # but forks the program per configuration -> CONFIG
+                return CONFIG
+            root_lvl = self.lookup(base)
+            if root_lvl == STATIC:
+                return STATIC         # module alias / class / import
+            # attribute on a local or parameter: a field of whatever
+            # flows through — conservatively runtime
+            return RUNTIME
+        return max(self.prov(node.value), PARAM)
+
+    def _call_prov(self, node: ast.Call) -> int:
+        f = node.func
+        arg_lvl = max(
+            [self.prov(a) for a in node.args]
+            + [self.prov(kw.value) for kw in node.keywords]
+            + [STATIC])
+        if isinstance(f, ast.Name):
+            if f.id in ("len", "isinstance", "getattr", "hasattr",
+                        "callable", "type", "id"):
+                return STATIC
+            if f.id in ("range", "min", "max", "abs", "int", "float",
+                        "bool", "sum", "enumerate", "zip", "reversed",
+                        "sorted", "tuple", "list"):
+                return arg_lvl
+            # free function: result no cleaner than its inputs
+            return arg_lvl
+        if isinstance(f, ast.Attribute):
+            root = _dotted_root(f)
+            if root in _TRACED_ROOTS or root == "jax":
+                return RUNTIME        # jnp./lax./jax.* build tracers
+            if root in ("np", "numpy", "math", "functools", "operator"):
+                return arg_lvl
+            # bound method: result follows the receiver and the args
+            return max(self.prov(f.value), arg_lvl)
+        return arg_lvl
+
+
+# ------------------------------------------------- function classification
+
+@dataclass
+class FnInfo:
+    node: ast.AST                     # FunctionDef / AsyncFunctionDef
+    name: str
+    qualname: str
+    cls: Optional[str]                # enclosing class name, if a method
+    parent: Optional["FnInfo"]        # lexically enclosing function
+    traced: bool = field(default=False)
+    tier: str = field(default="")     # "A" / "B" / "" (host)
+
+    def own_nodes(self) -> Iterable[ast.AST]:
+        """Every AST node of THIS function's body, stopping at nested
+        function boundaries (a nested def is its own FnInfo)."""
+        stack: List[ast.AST] = list(reversed(self.node.body))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue              # nested def: its own FnInfo's walk
+            yield n
+            # preorder in SOURCE order — the provenance pass is
+            # last-write-wins, so document order is load-bearing
+            stack.extend(reversed(list(ast.iter_child_nodes(n))))
+
+
+_HOST_NAME_PREFIXES = ("make_", "build_", "host_", "init", "_init",
+                       "test_", "bench_", "run_", "load_", "save_",
+                       "format_", "plot_", "main")
+_HOST_NAME_SUFFIXES = ("_init", "_main")
+_HANDLER_NAMES = ("tick", "tick_upper")
+
+
+#: classes that are host-side harnesses by convention — their methods
+#: drive compiled programs, they are not traced themselves
+_HOST_CLASS_SUFFIXES = ("Runner", "Checker", "Suite", "Bridge", "Server",
+                        "Service", "Session", "Launcher", "Explorer")
+
+
+def _is_host_by_name(fn: FnInfo) -> bool:
+    n = fn.name
+    if n.startswith("__") and n.endswith("__"):
+        return True
+    if fn.cls is not None and fn.cls.endswith(_HOST_CLASS_SUFFIXES):
+        return True
+    return (n.startswith(_HOST_NAME_PREFIXES)
+            or n.endswith(_HOST_NAME_SUFFIXES))
+
+
+class ModuleIndex:
+    """All functions of one module + the Tier-A/Tier-B classification."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        self.tree = tree
+        self.fns: List[FnInfo] = []
+        self.module_consts: Dict[str, int] = {}
+        self._collect(tree, cls=None, parent=None, prefix="")
+        for stmt in tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, int)):
+                self.module_consts[stmt.targets[0].id] = stmt.value.value
+        self._classify()
+
+    # -- collection -----------------------------------------------------
+
+    def _collect(self, node: ast.AST, cls: Optional[str],
+                 parent: Optional[FnInfo], prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FnInfo(child, child.name, prefix + child.name,
+                            cls, parent)
+                self.fns.append(fi)
+                self._collect(child, cls=None, parent=fi,
+                              prefix=fi.qualname + ".")
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, cls=child.name, parent=parent,
+                              prefix=prefix + child.name + ".")
+            elif not isinstance(child, ast.Lambda):
+                self._collect(child, cls=cls, parent=parent, prefix=prefix)
+
+    # -- classification -------------------------------------------------
+
+    def _resolve(self, name: str, scope: FnInfo,
+                 cls: Optional[str]) -> Optional[FnInfo]:
+        """Function the bare name ``name`` refers to from inside
+        ``scope``: nested def, enclosing-scope def, or module-level."""
+        chain: List[Optional[FnInfo]] = []
+        p: Optional[FnInfo] = scope
+        while p is not None:
+            chain.append(p)
+            p = p.parent
+        chain.append(None)            # module scope
+        for holder in chain:
+            for f in self.fns:
+                if f.name == name and f.parent is holder:
+                    return f
+        return None
+
+    def _method(self, cls: Optional[str], name: str) -> Optional[FnInfo]:
+        if cls is None:
+            return None
+        for f in self.fns:
+            if f.cls == cls and f.name == name:
+                return f
+        return None
+
+    def _classify(self) -> None:
+        # partial aliases: `emit = functools.partial(_emit, ...)` makes a
+        # call to `emit` inside a traced fn reach `_emit`
+        aliases: Dict[Tuple[Optional[str], str], str] = {}
+        for fn in self.fns:
+            for node in fn.own_nodes():
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    cf = node.value.func
+                    tail = (cf.attr if isinstance(cf, ast.Attribute)
+                            else cf.id if isinstance(cf, ast.Name) else "")
+                    if (tail == "partial" and node.value.args
+                            and isinstance(node.value.args[0], ast.Name)):
+                        aliases[(fn.qualname, node.targets[0].id)] = \
+                            node.value.args[0].id
+
+        seeds: List[FnInfo] = []
+        # (1) protocol handlers by convention
+        for fn in self.fns:
+            if fn.cls is not None and (
+                    fn.name.startswith("handle_")
+                    or fn.name in _HANDLER_NAMES):
+                seeds.append(fn)
+        # (2) functions handed to trace entry points by name
+        for fn in self.fns:
+            for node in fn.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = (node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                        if isinstance(node.func, ast.Name) else "")
+                if tail not in TRACE_ENTRIES:
+                    continue
+                cands = list(node.args) + [kw.value for kw in node.keywords]
+                for a in cands:
+                    if (isinstance(a, ast.Call)
+                            and isinstance(a.func, (ast.Name, ast.Attribute))
+                            and (a.func.id if isinstance(a.func, ast.Name)
+                                 else a.func.attr) == "partial"
+                            and a.args):
+                        a = a.args[0]
+                    if isinstance(a, ast.Name):
+                        t = self._resolve(a.id, fn, fn.cls)
+                        if t is not None:
+                            seeds.append(t)
+                    elif (isinstance(a, ast.Attribute)
+                          and isinstance(a.value, ast.Name)
+                          and a.value.id == "self"):
+                        t = self._method(fn.cls, a.attr)
+                        if t is not None:
+                            seeds.append(t)
+        # (3) @jit-style decorators
+        for fn in self.fns:
+            for dec in getattr(fn.node, "decorator_list", ()):
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                tail = (d.attr if isinstance(d, ast.Attribute)
+                        else d.id if isinstance(d, ast.Name) else "")
+                if tail in TRACE_ENTRIES or tail == "partial" and \
+                        isinstance(dec, ast.Call) and any(
+                            (isinstance(a, ast.Attribute)
+                             and a.attr in TRACE_ENTRIES)
+                            or (isinstance(a, ast.Name)
+                                and a.id in TRACE_ENTRIES)
+                            for a in dec.args):
+                    seeds.append(fn)
+
+        # transitive closure: self-calls, local-name calls, aliases,
+        # and nested defs of traced functions
+        work = list(seeds)
+        while work:
+            fn = work.pop()
+            if fn.traced:
+                continue
+            fn.traced, fn.tier = True, "A"
+            for g in self.fns:
+                if g.parent is fn and not g.traced:
+                    work.append(g)
+            for node in fn.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"):
+                    t = self._method(fn.cls, f.attr)
+                    if t is not None and not t.traced:
+                        work.append(t)
+                elif isinstance(f, ast.Name):
+                    name = f.id
+                    # follow partial aliases bound in any enclosing scope
+                    p: Optional[FnInfo] = fn
+                    while p is not None:
+                        name = aliases.get((p.qualname, name), name)
+                        p = p.parent
+                    t = self._resolve(name, fn, fn.cls)
+                    if t is not None and not t.traced:
+                        work.append(t)
+
+        # Tier B: jnp/lax users with no host markers and a non-builder name
+        for fn in self.fns:
+            if fn.traced or _is_host_by_name(fn):
+                continue
+            has_traced, has_host = False, False
+            for node in fn.own_nodes():
+                if isinstance(node, ast.Attribute):
+                    root = _dotted_root(node)
+                    if root in _TRACED_ROOTS:
+                        has_traced = True
+                    if root in _HOST_ROOTS:
+                        has_host = True
+                    if node.attr in _HOST_CALL_ATTRS:
+                        has_host = True
+                    if (node.attr in _NP_HOST_ATTRS
+                            and root in _NP_ROOTS):
+                        has_host = True
+                    if node.attr == "Tracer":
+                        # an explicit isinstance(x, jax.core.Tracer)
+                        # guard marks deliberate host/trace dual-mode
+                        # code — the host branch owns the coercions
+                        has_host = True
+                elif isinstance(node, ast.Call):
+                    if (isinstance(node.func, ast.Name)
+                            and node.func.id in _HOST_CALL_NAMES):
+                        has_host = True
+                    # int()/float()/bool() applied DIRECTLY to a jnp/lax
+                    # result is legal only on a concrete (host) array —
+                    # code doing it is host-side analysis, not a traced
+                    # fn (Tier A, where it would be a real bug, is
+                    # classified structurally and ignores this marker)
+                    if (isinstance(node.func, ast.Name)
+                            and node.func.id in ("int", "float", "bool")
+                            and node.args
+                            and isinstance(node.args[0], ast.Call)
+                            and _dotted_root(node.args[0].func)
+                            in _TRACED_ROOTS):
+                        has_host = True
+            if has_traced and not has_host:
+                fn.traced, fn.tier = True, "B"
+
+    def env_for(self, fn: FnInfo) -> ProvEnv:
+        parent_env = self.env_for(fn.parent) if fn.parent else None
+        return ProvEnv(fn, parent_env, self.module_consts)
+
+
+# ------------------------------------------------------------ module walk
+
+def lint_source(src: str, path: str) -> List[Finding]:
+    """Level-1 lint of one module's source: rules + twins + pragmas."""
+    from .rules import run_rules          # local: avoid import cycle
+    from .twins import check_twins
+    tree = ast.parse(src)
+    idx = ModuleIndex(tree, path)
+    pragmas, engine_findings = parse_pragmas(src, path)
+    findings: List[Finding] = []
+    for fn in idx.fns:
+        if fn.traced:
+            findings.extend(run_rules(idx, fn))
+    findings.extend(check_twins(idx))
+    return apply_pragmas(findings, pragmas, path) + engine_findings
+
+
+def lint_paths(paths: Iterable[str], root: str = "") -> List[Finding]:
+    out: List[Finding] = []
+    for p in paths:
+        rel = os.path.relpath(p, root) if root else p
+        with open(p, encoding="utf-8") as f:
+            out.extend(lint_source(f.read(), rel))
+    return out
+
+
+def lint_tree(pkg_dir: str, root: str = "") -> List[Finding]:
+    """Lint every ``*.py`` under ``pkg_dir`` (the partisan_tpu tree)."""
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    return lint_paths(sorted(paths), root or os.path.dirname(pkg_dir))

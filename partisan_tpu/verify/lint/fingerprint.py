@@ -1,0 +1,273 @@
+"""Trace-lint Level 2: lower-only program fingerprints.
+
+``mesh.assert_collective_budget`` pins collective counts, but only on a
+COMPILED executable — and XLA compile time is the binding constraint
+(ROADMAP "kill the compile wall": the explorer checker compiles ~13 min
+cold).  This module generalizes the budget into a static CI gate that
+never invokes XLA: each flagship entrypoint is ``.trace()``d and
+``.lower()``d at a small canonical shape, and three structural metrics
+are recorded:
+
+* ``eqns`` — total jaxpr equation count (recursive through sub-jaxprs:
+  a top-level shard_map/scan wraps everything in one equation);
+* ``collectives`` — per-kind counts of explicit ``stablehlo.*``
+  collective ops in the lowered StableHLO text (the explicit-SPMD
+  dataplane's all_to_all/all_reduce are visible pre-compile);
+* ``text_bytes`` — lowered-text size (informational; tracks HLO bloat).
+
+``check()`` diffs against the committed golden ``LINT_fingerprints.json``
+and fails on ANY collective-count change or >10% eqn growth — the two
+regressions that respectively break the collective budget and feed the
+compile wall.  Shrinkage and text-size drift are reported but pass;
+re-bless with ``scripts/trace_lint.py --bless`` after an intended
+program change.
+
+Importing this module imports JAX (unlike the Level-1 engine); callers
+must set ``JAX_PLATFORMS=cpu`` + the 8-device host-platform flag first
+(scripts/trace_lint.py and tests/conftest.py both do).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Tuple
+
+GOLDEN_BASENAME = "LINT_fingerprints.json"
+
+#: allowed relative eqn-count growth before check() fails
+EQN_GROWTH_LIMIT = 0.10
+
+_COLLECTIVE_RE = re.compile(
+    r"\bstablehlo\.(all_to_all|all_reduce|all_gather|collective_permute"
+    r"|reduce_scatter|collective_broadcast)\b")
+
+
+# ------------------------------------------------------------ measurement
+
+def _eqn_count(jaxpr) -> int:
+    """Total equations including every nested sub-jaxpr (scan/cond/
+    shard_map bodies live in eqn params, so the top level alone is ~1)."""
+    inner = getattr(jaxpr, "jaxpr", None)   # ClosedJaxpr -> Jaxpr
+    if inner is not None:
+        jaxpr = inner
+    n = len(jaxpr.eqns)
+    for eqn in jaxpr.eqns:
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    n += _eqn_count(sub)
+    return n
+
+
+def fingerprint_one(build: Callable[[], Tuple[Callable, tuple]]
+                    ) -> Dict[str, object]:
+    """Trace + lower ONE entrypoint (no XLA compile) and measure it."""
+    fn, args = build()
+    traced = fn.trace(*args)
+    text = traced.lower().as_text()
+    colls = Counter(m.group(1).replace("_", "-")
+                    for m in _COLLECTIVE_RE.finditer(text))
+    return {
+        "eqns": _eqn_count(traced.jaxpr),
+        "text_bytes": len(text),
+        "collectives": dict(sorted(colls.items())),
+    }
+
+
+# --------------------------------------------------- flagship entrypoints
+#
+# Shapes deliberately mirror the test suite's (test_dense_dataplane /
+# test_control / test_explorer module constants) so any session that has
+# run tier-1 shares its warm persistent cache with nothing — lowering
+# needs no cache — but the PROGRAMS fingerprinted are the ones CI
+# actually exercises.
+
+def _cfg16():
+    from partisan_tpu.config import Config
+    return Config(n_nodes=16, inbox_cap=16, seed=3, slo_deadline_rounds=8,
+                  shed_token_burst_milli=8000)
+
+
+def _control_spec():
+    from partisan_tpu.control import ControlSpec, Controller
+    return ControlSpec((
+        Controller(name="admit", metric="rpc_slo_violated",
+                   actuator="wl.shed_rate_milli", kind="aimd",
+                   init=4000, target_milli=0, sense=1, delta=True,
+                   alpha_milli=400, add=200, mult_milli=900,
+                   lo=1000, hi=8000),
+    ))
+
+
+def _control_proto(cfg):
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.models.stack import Lifted, Stacked
+    from partisan_tpu.workload import arrivals
+    from partisan_tpu.workload.driver import AdaptiveWorkloadRpc
+    drv = AdaptiveWorkloadRpc(
+        cfg, promise_cap=8,
+        spec=arrivals.ArrivalSpec(kind=arrivals.POISSON, max_issue=4),
+        rate_milli=6000, shed_rate_milli=4000)
+    return Stacked(HyParView(cfg), Lifted(drv))
+
+
+def _engine_step_hyparview():
+    import partisan_tpu as pt
+    from partisan_tpu.models.hyparview import HyParView
+    cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    world = pt.init_world(cfg, proto)
+    return pt.make_step(cfg, proto, donate=False), (world,)
+
+
+def _engine_step_control():
+    import partisan_tpu as pt
+    from partisan_tpu.control import attach_plane
+    cfg = _cfg16()
+    proto, spec = _control_proto(cfg), _control_spec()
+    world = attach_plane(pt.init_world(cfg, proto), spec)
+    return pt.make_step(cfg, proto, donate=False, control=spec), (world,)
+
+
+def _sharded_dataplane_round():
+    import partisan_tpu as pt
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                 make_sharded_step)
+    from partisan_tpu.parallel.mesh import make_mesh
+    cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    mesh = make_mesh(n_devices=8)
+    world = init_sharded_world(cfg, proto, mesh)
+    return make_sharded_step(cfg, proto, mesh, donate=False), (world,)
+
+
+def _dense(model: str):
+    import partisan_tpu as pt
+    from partisan_tpu.parallel import dense_dataplane as dd
+    from partisan_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(n_devices=8)
+    if model == "scamp":
+        cfg = pt.Config(n_nodes=256)
+        st = dd.sharded_scamp_init(cfg, 8)
+        step = dd.make_sharded_dense_round(cfg, mesh, model="scamp")
+    else:
+        cfg = pt.Config(n_nodes=256, shuffle_interval=4,
+                        random_promotion_interval=2)
+        if model == "plumtree":
+            st = dd.sharded_pt_init(cfg, 8)
+            step = dd.make_sharded_dense_round(cfg, mesh, model="plumtree",
+                                               broadcast_interval=5)
+        else:
+            st = dd.sharded_dense_init(cfg, 8)
+            step = dd.make_sharded_dense_round(cfg, mesh)
+    return step, (dd.place_sharded(st, mesh),)
+
+
+def _dense_hv_control():
+    import partisan_tpu as pt
+    from partisan_tpu.control import ControlSpec, Controller
+    from partisan_tpu.parallel import dense_dataplane as dd
+    from partisan_tpu.parallel.mesh import make_mesh
+    cfg = pt.Config(n_nodes=256, shuffle_interval=4,
+                    random_promotion_interval=2)
+    spec = ControlSpec((
+        Controller(name="cadence", metric="lonely",
+                   actuator="dense.shuffle_interval", kind="step",
+                   init=4, target_milli=0, sense=-1, delta=False,
+                   alpha_milli=600, step=1, deadband_milli=200,
+                   lo=1, hi=16),
+    ))
+    mesh = make_mesh(n_devices=8)
+    step = dd.make_sharded_dense_round(cfg, mesh, control=spec)
+    st = dd.place_sharded(dd.sharded_dense_init(cfg, 8), mesh)
+    return step, (st, spec.init_plane())
+
+
+def _explorer_checker_b1():
+    import partisan_tpu as pt
+    from partisan_tpu.verify.chaos import ChaosSchedule
+    from partisan_tpu.verify.explorer import SETUPS, Explorer
+    cfg = pt.Config(n_nodes=16, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto, world = SETUPS["hyparview_tree"](cfg)
+    ex = Explorer(cfg, proto, n_rounds=60, n_events=10, batch=1,
+                  world=world, heal_margin=12)
+    sched = ChaosSchedule().crash(8, (4, 7)).recover(32, (4, 7))
+    worldB, tables, check = ex._stack_inputs(ex._pad_batch([sched]))
+    return ex._run, (worldB, tables, check)
+
+
+#: name -> builder returning (jitted fn, args); each is lowered at a
+#: small canonical shape mirroring the tier-1 suite's programs
+FLAGSHIP: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
+    "engine_step_hyparview_n64": _engine_step_hyparview,
+    "engine_step_control_n16": _engine_step_control,
+    "sharded_dataplane_round_n64x8": _sharded_dataplane_round,
+    "dense_hyparview_n256x8": lambda: _dense("hyparview"),
+    "dense_scamp_n256x8": lambda: _dense("scamp"),
+    "dense_plumtree_n256x8": lambda: _dense("plumtree"),
+    "dense_hyparview_control_n256x8": _dense_hv_control,
+    "explorer_checker_hyparview_b1": _explorer_checker_b1,
+}
+
+
+# --------------------------------------------------------- bless / check
+
+def fingerprint_all(registry: Optional[Dict] = None,
+                    progress: Optional[Callable[[str], None]] = None
+                    ) -> Dict[str, Dict]:
+    out = {}
+    for name, build in (registry or FLAGSHIP).items():
+        if progress:
+            progress(name)
+        out[name] = fingerprint_one(build)
+    return out
+
+
+def bless(path: str, registry: Optional[Dict] = None,
+          progress: Optional[Callable[[str], None]] = None) -> Dict:
+    fps = fingerprint_all(registry, progress)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(fps, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return fps
+
+
+def check(path: str, registry: Optional[Dict] = None,
+          progress: Optional[Callable[[str], None]] = None) -> List[str]:
+    """-> list of failure strings (empty = gate passes).  Every failure
+    names the entrypoint and the metric that moved."""
+    with open(path, encoding="utf-8") as f:
+        golden = json.load(f)
+    registry = registry or FLAGSHIP
+    errors: List[str] = []
+    for name in sorted(set(golden) - set(registry)):
+        errors.append(
+            f"{name}: in {GOLDEN_BASENAME} but not in the FLAGSHIP "
+            f"registry — remove it or restore the entrypoint, then "
+            f"re-bless")
+    for name, build in registry.items():
+        if name not in golden:
+            errors.append(
+                f"{name}: flagship entrypoint has no golden fingerprint "
+                f"— run scripts/trace_lint.py --bless")
+            continue
+        if progress:
+            progress(name)
+        cur, ref = fingerprint_one(build), golden[name]
+        if cur["collectives"] != ref["collectives"]:
+            errors.append(
+                f"{name}: collective counts changed "
+                f"{ref['collectives']} -> {cur['collectives']} — the "
+                f"collective budget is pinned exactly; re-bless only "
+                f"if the change is intended")
+        growth = (cur["eqns"] - ref["eqns"]) / max(ref["eqns"], 1)
+        if growth > EQN_GROWTH_LIMIT:
+            errors.append(
+                f"{name}: eqn count grew {ref['eqns']} -> {cur['eqns']} "
+                f"(+{growth:.0%}, limit +{EQN_GROWTH_LIMIT:.0%}) — "
+                f"compile-surface regression; shrink the program or "
+                f"re-bless with justification")
+    return errors

@@ -1,0 +1,134 @@
+"""Findings, pragmas, and report formatting for trace-lint.
+
+Pure stdlib — this module (like the whole Level-1 linter) must be
+importable without JAX so `scripts/trace_lint.py` can run the AST pass
+in milliseconds on a box with no accelerator stack warmed up.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Rules the AST/twin passes can emit (suppressible via pragma).
+RULES: Dict[str, str] = {
+    "unroll-bomb": (
+        "Python for/while loop in traced code whose trip count derives "
+        "from a Config field, array shape, or runtime value — unrolls "
+        "into the jaxpr and multiplies compile time"),
+    "traced-coercion": (
+        "int()/float()/bool()/.item()/np.* applied to a value computed "
+        "by traced ops — concretizes a tracer (ConcretizationTypeError "
+        "at best, silent host sync at worst)"),
+    "traced-format": (
+        "f-string/str()/.format() over a traced value — formats the "
+        "tracer repr, not the runtime value"),
+    "config-fork": (
+        "branch on a Config attribute inside a traced function — every "
+        "config value traces a distinct program (per-config "
+        "program-shape fork); hoist the fork to build time"),
+    "twin-drift": (
+        "a host_* twin's signature or constant set diverged from its "
+        "device counterpart — the bit-parity contract is stale"),
+}
+
+# Errors the engine itself emits (NOT suppressible — a pragma problem
+# cannot be pragma'd away).
+ENGINE_RULES: Dict[str, str] = {
+    "unused-pragma": "a trace-lint pragma that suppressed nothing",
+    "pragma-missing-reason": "allow(<rule>) without a ': reason' string",
+    "unknown-rule": "allow(<rule>) naming a rule the linter doesn't have",
+}
+
+#: the pragma shape: ``trace-lint: allow(<rule>): reason text``
+PRAGMA_RE = re.compile(
+    r"#\s*trace-lint:\s*allow\(([\w-]+)\)\s*(?::\s*(\S.*?))?\s*$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Pragma:
+    rule: str
+    line: int
+    reason: Optional[str]
+    used: bool = field(default=False)
+
+
+def parse_pragmas(src: str, path: str):
+    """-> (pragmas, engine findings for malformed ones).
+
+    A pragma suppresses findings of its rule on its OWN line or the
+    line directly BELOW it (so it can trail the flagged statement or
+    sit on its own line above a long one).
+    """
+    pragmas: List[Pragma] = []
+    findings: List[Finding] = []
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rule, reason = m.group(1), m.group(2)
+        if rule not in RULES:
+            findings.append(Finding(
+                "unknown-rule", path, i,
+                f"allow({rule}) names no rule; known: "
+                + ", ".join(sorted(RULES))))
+            continue
+        if not reason:
+            findings.append(Finding(
+                "pragma-missing-reason", path, i,
+                f"allow({rule}) needs ': <reason>' — an unexplained "
+                f"suppression is indistinguishable from a stale one"))
+        pragmas.append(Pragma(rule, i, reason))
+    return pragmas, findings
+
+
+def apply_pragmas(findings: List[Finding], pragmas: List[Pragma],
+                  path: str) -> List[Finding]:
+    """Drop suppressed findings, then turn every still-unused pragma
+    into an ``unused-pragma`` finding (a suppression that suppresses
+    nothing is stale by definition and must be deleted, not kept)."""
+    by_line: Dict[int, List[Pragma]] = {}
+    for p in pragmas:
+        by_line.setdefault(p.line, []).append(p)
+    kept: List[Finding] = []
+    for f in findings:
+        hit = None
+        for cand_line in (f.line, f.line - 1):
+            for p in by_line.get(cand_line, ()):
+                if p.rule == f.rule:
+                    hit = p
+                    break
+            if hit:
+                break
+        if hit is not None:
+            hit.used = True
+        else:
+            kept.append(f)
+    for p in pragmas:
+        if not p.used:
+            kept.append(Finding(
+                "unused-pragma", path, p.line,
+                f"allow({p.rule}) suppressed nothing — delete it (or "
+                f"the hazard it excused moved)"))
+    return kept
+
+
+def format_report(findings: List[Finding]) -> str:
+    if not findings:
+        return "trace-lint: clean (0 findings)"
+    lines = [str(f) for f in
+             sorted(findings, key=lambda f: (f.path, f.line, f.rule))]
+    lines.append(f"trace-lint: {len(findings)} finding(s)")
+    return "\n".join(lines)

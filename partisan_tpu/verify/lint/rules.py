@@ -1,0 +1,158 @@
+"""Trace-lint AST rules — each runs over ONE traced function with the
+provenance environment from :mod:`.engine`.
+
+Thresholds, stated once: loop-structure rules fire at CONFIG and above
+(a config/shape/runtime trip count changes the *program*), value rules
+fire at RUNTIME only (coercing a config int is legal and common — it is
+coercing the *output of traced ops* that concretizes a tracer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import (CONFIG, LEVEL_NAMES, RUNTIME, STATIC, FnInfo,
+                     ModuleIndex, ProvEnv, _dotted_root, _is_cfg_base)
+from .report import Finding
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:                 # pragma: no cover — defensive
+        return "<expr>"
+
+
+def _clip(s: str, n: int = 48) -> str:
+    return s if len(s) <= n else s[:n - 3] + "..."
+
+
+def run_rules(idx: ModuleIndex, fn: FnInfo) -> List[Finding]:
+    env = idx.env_for(fn)
+    out: List[Finding] = []
+    where = f"{fn.qualname} (tier {fn.tier})"
+    for node in fn.own_nodes():
+        _unroll_bomb(idx, fn, env, node, where, out)
+        _traced_coercion(idx, fn, env, node, where, out)
+        _traced_format(idx, fn, env, node, where, out)
+        _config_fork(idx, fn, env, node, where, out)
+    return out
+
+
+# -------------------------------------------------------------- the rules
+
+def _unroll_bomb(idx: ModuleIndex, fn: FnInfo, env: ProvEnv,
+                 node: ast.AST, where: str, out: List[Finding]) -> None:
+    if isinstance(node, ast.For):
+        it = node.iter
+        # unwrap enumerate()/reversed() around the real iterable
+        while (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+               and it.func.id in ("enumerate", "reversed")
+               and it.args):
+            it = it.args[0]
+        # only NUMERIC trip counts (range) are unroll bombs — a for
+        # over a python container (zip/items/list of invariants) walks
+        # build-time structure, which is the intended pattern here
+        if not (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"):
+            return
+        lvl = max((env.prov(a) for a in it.args), default=STATIC)
+        if lvl >= CONFIG:
+            out.append(Finding(
+                "unroll-bomb", idx.path, node.lineno,
+                f"{where}: for-loop over `{_clip(_src(node.iter))}` — "
+                f"trip count has {LEVEL_NAMES[lvl]} provenance, so the "
+                f"body unrolls per config/shape into the jaxpr; use "
+                f"lax.fori_loop/scan or hoist the bound to build time"))
+    elif isinstance(node, ast.While):
+        lvl = env.prov(node.test)
+        if lvl >= CONFIG:
+            out.append(Finding(
+                "unroll-bomb", idx.path, node.lineno,
+                f"{where}: while-loop test `{_clip(_src(node.test))}` "
+                f"has {LEVEL_NAMES[lvl]} provenance — a data-dependent "
+                f"Python while in traced code either unrolls unboundedly "
+                f"or concretizes; use lax.while_loop"))
+
+
+_COERCERS = ("int", "float", "bool")
+
+
+def _traced_coercion(idx: ModuleIndex, fn: FnInfo, env: ProvEnv,
+                     node: ast.AST, where: str,
+                     out: List[Finding]) -> None:
+    if not isinstance(node, ast.Call):
+        return
+    f = node.func
+    if (isinstance(f, ast.Name) and f.id in _COERCERS and node.args
+            and env.prov(node.args[0]) >= RUNTIME):
+        out.append(Finding(
+            "traced-coercion", idx.path, node.lineno,
+            f"{where}: {f.id}() on traced value "
+            f"`{_clip(_src(node.args[0]))}` — concretizes the tracer "
+            f"(ConcretizationTypeError under jit)"))
+    elif isinstance(f, ast.Attribute) and f.attr == "item":
+        if env.prov(f.value) >= RUNTIME:
+            out.append(Finding(
+                "traced-coercion", idx.path, node.lineno,
+                f"{where}: .item() on traced value "
+                f"`{_clip(_src(f.value))}` — host sync inside traced "
+                f"code"))
+    elif (isinstance(f, ast.Attribute)
+          and _dotted_root(f) in ("np", "numpy")):
+        hot = [a for a in node.args if env.prov(a) >= RUNTIME]
+        if hot:
+            out.append(Finding(
+                "traced-coercion", idx.path, node.lineno,
+                f"{where}: np.{f.attr}() on traced value "
+                f"`{_clip(_src(hot[0]))}` — numpy pulls the tracer to "
+                f"host; use the jnp equivalent"))
+
+
+def _traced_format(idx: ModuleIndex, fn: FnInfo, env: ProvEnv,
+                   node: ast.AST, where: str,
+                   out: List[Finding]) -> None:
+    if isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if (isinstance(v, ast.FormattedValue)
+                    and env.prov(v.value) >= RUNTIME):
+                out.append(Finding(
+                    "traced-format", idx.path, node.lineno,
+                    f"{where}: f-string interpolates traced value "
+                    f"`{_clip(_src(v.value))}` — formats the tracer "
+                    f"repr, not the runtime value"))
+                return
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "str" and node.args:
+            if env.prov(node.args[0]) >= RUNTIME:
+                out.append(Finding(
+                    "traced-format", idx.path, node.lineno,
+                    f"{where}: str() on traced value "
+                    f"`{_clip(_src(node.args[0]))}`"))
+        elif isinstance(f, ast.Attribute) and f.attr == "format":
+            hot = [a for a in list(node.args)
+                   + [kw.value for kw in node.keywords]
+                   if env.prov(a) >= RUNTIME]
+            if hot:
+                out.append(Finding(
+                    "traced-format", idx.path, node.lineno,
+                    f"{where}: .format() over traced value "
+                    f"`{_clip(_src(hot[0]))}`"))
+
+
+def _config_fork(idx: ModuleIndex, fn: FnInfo, env: ProvEnv,
+                 node: ast.AST, where: str, out: List[Finding]) -> None:
+    if not isinstance(node, ast.If):
+        return
+    for sub in ast.walk(node.test):
+        if isinstance(sub, ast.Attribute) and _is_cfg_base(sub.value):
+            out.append(Finding(
+                "config-fork", idx.path, node.lineno,
+                f"{where}: branches on `{_clip(_src(sub))}` inside a "
+                f"traced function — every distinct config traces a "
+                f"distinct program (program-shape fork); hoist the "
+                f"branch to the builder"))
+            return

@@ -1,0 +1,92 @@
+"""Host-twin drift detection.
+
+Repo-wide convention since ISSUE 10: a device function ``X`` that needs
+bit-parity validation ships a pure-Python twin ``host_X`` in the same
+module (``admit``/``host_admit``, ``update_plane``/``host_update_plane``,
+``ewma_filter``/``host_ewma_filter`` …) and a test asserts bit-equality
+over randomized streams.  The tests catch *value* drift; this rule
+catches *structural* drift the moment it is written: a twin pair whose
+parameter lists disagree, or whose integer-constant sets disagree (the
+milli-unit scale factors, clamps, and sentinels ARE the algorithm in
+this integer-arithmetic codebase — if the device side changes 1000 to
+1024 and the host side doesn't, parity is stale even if today's test
+inputs happen not to reach the changed region).
+
+Constants 0/1/-1 and the float literals are excluded from the
+comparison: both sides use them ubiquitously for masks/increments in
+ways that legitimately differ (jnp.where(m, 1, 0) vs `if m:`), and
+floats appear only in jnp dtype positions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .engine import FnInfo, ModuleIndex
+from .report import Finding
+
+
+def _params(fn: FnInfo) -> List[str]:
+    a = fn.node.args
+    names = [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+    return [n for n in names if n != "self"]
+
+
+def _const_set(fn: FnInfo, idx: ModuleIndex,
+               seen: Optional[Set[str]] = None) -> Set[int]:
+    """Integer constants referenced by ``fn``, following same-module
+    calls one hop at a time (``host_admit_dynamic`` references 1000
+    THROUGH ``host_admit`` — delegation is not drift)."""
+    seen = set() if seen is None else seen
+    if fn.qualname in seen:
+        return set()
+    seen.add(fn.qualname)
+    out: Set[int] = set()
+    for node in fn.own_nodes():
+        if (isinstance(node, ast.Constant)
+                and isinstance(node.value, int)
+                and not isinstance(node.value, bool)
+                and abs(node.value) > 1):
+            out.add(node.value)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Name)):
+            callee = _module_level(idx, node.func.id)
+            if callee is not None:
+                out |= _const_set(callee, idx, seen)
+    return out
+
+
+def _module_level(idx: ModuleIndex, name: str) -> Optional[FnInfo]:
+    for f in idx.fns:
+        if f.name == name and f.parent is None and f.cls is None:
+            return f
+    return None
+
+
+def check_twins(idx: ModuleIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for host in idx.fns:
+        if not (host.name.startswith("host_") and host.parent is None
+                and host.cls is None):
+            continue
+        dev = _module_level(idx, host.name[len("host_"):])
+        if dev is None:
+            continue              # twin lives elsewhere / free-standing
+        hp, dp = _params(host), _params(dev)
+        if hp != dp:
+            out.append(Finding(
+                "twin-drift", idx.path, host.node.lineno,
+                f"{host.name} vs {dev.name}: parameter lists diverged "
+                f"({hp} vs {dp}) — the bit-parity twin contract "
+                f"requires identical signatures"))
+        hc, dc = _const_set(host, idx), _const_set(dev, idx)
+        if hc != dc:
+            only_h = sorted(hc - dc)
+            only_d = sorted(dc - hc)
+            out.append(Finding(
+                "twin-drift", idx.path, host.node.lineno,
+                f"{host.name} vs {dev.name}: integer-constant sets "
+                f"diverged (host-only {only_h}, device-only {only_d}) "
+                f"— scale factors/clamps must match for bit parity"))
+    return out

@@ -59,8 +59,9 @@ from __future__ import annotations
 
 import ast
 import inspect
+import os
 import textwrap
-from typing import Dict, List, Set
+from typing import Dict, List, Optional, Set
 
 from ..engine import ProtocolBase
 
@@ -245,6 +246,103 @@ def static_causality(proto: ProtocolBase) -> Dict[str, List[str]]:
     for t in proto.msg_types:
         out[t] = sorted(_reachable_typs(proto, "handle_" + t))
     out["__tick__"] = sorted(_reachable_typs(proto, "tick"))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Dense-dataplane mail kinds (ISSUE 11 satellite): the dense protocols  #
+# bypass ProtocolBase entirely — no self.typ() literals to walk.  Their #
+# wire tags are the integer `kind` column of the mail block, written    #
+# only by _emit() / its functools.partial alias `emit`, always from a   #
+# module-level K_*/S_* constant.  The same superset contract therefore  #
+# holds by a different walk: collect the kind argument of every emit    #
+# site in the round builder's scope and resolve it against the module   #
+# constants.  Anything that does not resolve to a static int is an      #
+# UNBOUNDED wire tag and raises — the static map could no longer be a   #
+# superset of what the round puts on the wire.                          #
+# --------------------------------------------------------------------- #
+
+# round-builder scope per dense model; hyparview and plumtree share one
+# builder (model= is a build-time flag) and hence one kind space
+_DENSE_SCOPES = {
+    "hyparview": ("make_sharded_dense_round", "HV_KINDS"),
+    "plumtree": ("make_sharded_dense_round", "HV_KINDS"),
+    "scamp": ("_make_sharded_scamp_round", "SCAMP_KINDS"),
+}
+
+# kind-argument position: _emit(blocks, n_loc, gids, alive, part, dst,
+# kind, ...) and emit = partial(_emit, blocks, n_loc, gids)
+_EMIT_KIND_POS = {"_emit": 6, "emit": 3}
+
+
+def _dense_source() -> str:
+    # read, don't import — keeps this walk pure AST like the rest of
+    # the module (dense_dataplane pulls in the whole jax stack)
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "parallel", "dense_dataplane.py")
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def dense_static_kinds(model: str = "hyparview",
+                       source: Optional[str] = None) -> Set[int]:
+    """Superset of the integer mail kinds ``model``'s dense round can
+    put on the wire — the dense analog of :func:`static_causality`
+    (``source`` overrides the dense_dataplane module text, for tests).
+
+    Raises ValueError (named site) for an emit call whose kind is
+    neither an int literal nor a module-level int constant, and for a
+    resolved kind outside ``[0, <KINDS>)`` — either way the tag space
+    would be unbounded and the static-superset contract void."""
+    if model not in _DENSE_SCOPES:
+        raise ValueError(f"unknown dense model {model!r}; "
+                         f"one of {sorted(_DENSE_SCOPES)}")
+    scope, space_name = _DENSE_SCOPES[model]
+    tree = ast.parse(source if source is not None else _dense_source())
+    consts: Dict[str, int] = {}
+    fn = None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)):
+            consts[node.targets[0].id] = node.value.value
+        elif isinstance(node, ast.FunctionDef) and node.name == scope:
+            fn = node
+    if fn is None:
+        raise ValueError(f"dense round builder {scope!r} not found")
+    n_kinds = consts.get(space_name)
+    out: Set[int] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _EMIT_KIND_POS):
+            continue
+        kind = next((kw.value for kw in node.keywords
+                     if kw.arg == "kind"), None)
+        if kind is None:
+            pos = _EMIT_KIND_POS[node.func.id]
+            if len(node.args) <= pos:
+                raise ValueError(
+                    f"{scope}: {node.func.id}() call at line "
+                    f"{node.lineno} has no kind argument — the walk "
+                    f"cannot bound its wire tag")
+            kind = node.args[pos]
+        if isinstance(kind, ast.Constant) and isinstance(kind.value, int):
+            val = kind.value
+        elif isinstance(kind, ast.Name) and kind.id in consts:
+            val = consts[kind.id]
+        else:
+            raise ValueError(
+                f"{scope}: emit at line {node.lineno} has a non-static "
+                f"mail kind {ast.unparse(kind)!r} — unbounded wire tag "
+                f"voids the static-superset contract")
+        if n_kinds is not None and not 0 <= val < n_kinds:
+            raise ValueError(
+                f"{scope}: emit at line {node.lineno} kind {val} is "
+                f"outside [0, {space_name}={n_kinds})")
+        out.add(val)
     return out
 
 

@@ -169,6 +169,7 @@ class WorkloadRpc(Rpc):
                     or cfg.shed_max_outstanding > 0)
         if not use_shed:
             return want, row
+        # trace-lint: allow(config-fork): token refill compiles in only when shedding is configured — shed-off keeps the lean program
         if cfg.shed_token_rate_milli > 0:
             tokens = shed.refill(row.wl_tokens_milli,
                                  cfg.shed_token_rate_milli,
@@ -177,6 +178,7 @@ class WorkloadRpc(Rpc):
             tokens = jnp.int32(1000 * self.A)  # never the binding limit
         adm, tokens_out, shed_n = shed.admit(
             tokens, want, outstanding, cfg.shed_max_outstanding)
+        # trace-lint: allow(config-fork): same build-time shed gate as the refill above — token column untouched when shedding is off
         if cfg.shed_token_rate_milli > 0:
             row = row.replace(wl_tokens_milli=tokens_out)
         return adm, row.replace(wl_shed=row.wl_shed + shed_n)
@@ -216,6 +218,7 @@ class WorkloadRpc(Rpc):
         out_dst, out_ref = [], []
         issued = jnp.int32(0)
         dropped = jnp.int32(0)
+        # trace-lint: allow(unroll-bomb): A is the small static arrival slot cap and each iteration's ring.alloc depends on the previous write — the audited, intentional unroll (ISSUE 11)
         for i in range(A):
             ok, slot = ring.alloc(pv)
             ok = ok & adm[i]

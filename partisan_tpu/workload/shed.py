@@ -52,6 +52,7 @@ def admit(tokens_milli: jax.Array, want: jax.Array,
     depth = jnp.asarray(outstanding, jnp.int32)
     shed = jnp.int32(0)
     oks = []
+    # trace-lint: allow(unroll-bomb): A is small and static; token charge for slot i depends on slots < i (sequential by contract)
     for i in range(want.shape[0]):
         fits = want[i] & (tokens >= 1000)
         if max_outstanding > 0:
@@ -80,6 +81,7 @@ def admit_dynamic(tokens_milli: jax.Array, want: jax.Array,
     cap = jnp.asarray(max_outstanding, jnp.int32)
     shed = jnp.int32(0)
     oks = []
+    # trace-lint: allow(unroll-bomb): same small static A and sequential token charge as admit, with the cap comparison traced
     for i in range(want.shape[0]):
         fits = want[i] & (tokens >= 1000) & ((cap <= 0) | (depth < cap))
         oks.append(fits)

@@ -1437,6 +1437,31 @@ SKIP = {
 }
 
 
+def trace_lint_clean_test():
+    """ISSUE 11: Level-1 trace-lint over the whole package — zero
+    unsuppressed findings, every pragma reasoned and live (the pure-AST
+    pass; the no-JAX-import property is scripts/trace_lint.py's to
+    assert, this process already has the real package loaded)."""
+    import partisan_tpu
+    from partisan_tpu.verify.lint import format_report, lint_tree
+    pkg = os.path.dirname(os.path.abspath(partisan_tpu.__file__))
+    findings = lint_tree(pkg, root=os.path.dirname(pkg))
+    assert not findings, "\n" + format_report(findings)
+
+
+def fingerprint_gate_test():
+    """ISSUE 11: the lower-only compile-surface gate — re-trace and
+    re-lower all flagship entrypoints and diff jaxpr-eqn / StableHLO
+    collective counts against the committed LINT_fingerprints.json
+    (fails on any collective change or >10% eqn growth; no XLA
+    compile, so this row costs seconds, not the compile wall)."""
+    from partisan_tpu.verify.lint import fingerprint as fp
+    golden = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "LINT_fingerprints.json")
+    errors = fp.check(golden)
+    assert not errors, "\n".join(errors)
+
+
 def build_matrix():
     """(group, test, manager, path, fn_or_skipreason) rows mirroring
     all/0 + groups/0 of test/partisan_SUITE.erl:121-308.
@@ -1641,6 +1666,14 @@ def build_matrix():
         "engine", control_parity_test)
     add("control/adaptive", "control_suite_smoke", "hyparview",
         "engine", control_suite_smoke)
+
+    # ISSUE 11: trace-lint — the clean-tree AST gate and the lower-only
+    # program-fingerprint diff against LINT_fingerprints.json (the CLI
+    # equivalent is scripts/trace_lint.py --check)
+    add("analysis/lint", "trace_lint_clean", "hyparview", "engine",
+        trace_lint_clean_test)
+    add("analysis/lint", "fingerprint_gate", "hyparview", "engine",
+        fingerprint_gate_test)
 
     return M
 

@@ -58,10 +58,14 @@ _RUN_LAST_2 = ("tests/test_workload.py",)
 _RUN_LAST_3 = ("tests/test_dense_dataplane.py",)
 # tier 4: the ISSUE-10 adaptive control plane is newer still
 _RUN_LAST_4 = ("tests/test_control.py",)
+# tier 5: the ISSUE-11 trace-lint / fingerprint gate is the newest
+_RUN_LAST_5 = ("tests/test_trace_lint.py",)
 
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_5):
+            return 5
         if any(k in it.nodeid for k in _RUN_LAST_4):
             return 4
         if any(k in it.nodeid for k in _RUN_LAST_3):

@@ -20,7 +20,8 @@ import pytest
 import partisan_tpu as pt
 from partisan_tpu.engine import ProtocolBase
 from partisan_tpu.verify import analysis
-from partisan_tpu.verify.static_analysis import (merged_causality,
+from partisan_tpu.verify.static_analysis import (dense_static_kinds,
+                                                 merged_causality,
                                                  static_causality)
 
 GOLDEN_DIR = "/root/reference/annotations"
@@ -302,3 +303,48 @@ class TestCheckerWithStaticMap:
             (pruned.explored, full.explored)
         assert pruned.failed == full.failed, (pruned, full)
         assert sorted(pruned.failures) == sorted(full.failures)
+
+
+class TestDenseStaticKinds:
+    """ISSUE 11 satellite: the dense protocols' integer-mail analog of
+    the typ()-literal walk — pure AST over dense_dataplane.py."""
+
+    def test_kind_spaces_fully_covered(self):
+        # every declared kind is reachable from some emit site, and
+        # nothing outside the declared space appears
+        assert dense_static_kinds("hyparview") == {0, 1, 2, 3, 4, 5}
+        assert dense_static_kinds("plumtree") == {0, 1, 2, 3, 4, 5}
+        assert dense_static_kinds("scamp") == {0, 1, 2}
+
+    def test_unknown_model_rejected(self):
+        with pytest.raises(ValueError, match="unknown dense model"):
+            dense_static_kinds("chord")
+
+    SYNTH = """
+K_PING = 0
+HV_KINDS = 1
+
+def make_sharded_dense_round(cfg, mesh):
+    blocks = []
+    def round(st):
+        emit = None
+        {call}
+        return st
+    return round
+"""
+
+    def test_non_static_kind_is_named_error(self):
+        src = self.SYNTH.format(call="emit(1, 2, 3, st.kind_of_the_day)")
+        with pytest.raises(ValueError, match="non-static mail kind"):
+            dense_static_kinds("hyparview", source=src)
+
+    def test_out_of_space_kind_is_named_error(self):
+        src = self.SYNTH.format(call="emit(1, 2, 3, 7)")
+        with pytest.raises(ValueError, match=r"outside \[0, HV_KINDS"):
+            dense_static_kinds("hyparview", source=src)
+
+    def test_kw_and_constant_kinds_resolve(self):
+        src = self.SYNTH.format(call="_emit(b, n, g, a, p, d, K_PING)")
+        assert dense_static_kinds("hyparview", source=src) == {0}
+        src = self.SYNTH.format(call="emit(a, p, d, kind=K_PING)")
+        assert dense_static_kinds("hyparview", source=src) == {0}
