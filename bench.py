@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from partisan_tpu.models.demers import rumor_init, rumor_run
+from partisan_tpu.telemetry.observatory import CompileLedger
 from partisan_tpu.telemetry.sinks import JsonlSink
 
 
@@ -45,23 +46,34 @@ def main() -> None:
     rounds = 20_000
     trials = 5
 
+    # compile observatory (ISSUE 14): the headline bench's compile cost
+    # lands in the shared ledger, attributed per variant — after a
+    # kernel edit, scripts/observatory.py --report shows what the first
+    # trial run paid before a single timed window opened.  File-only;
+    # the stdout contract below is untouched.
+    ledger = CompileLedger(path=os.environ.get(
+        "PARTISAN_COMPILE_LEDGER", "COMPILE_ledger.jsonl")).install()
+
     # On TPU the pallas kernel MUST run — a regression there should fail
     # the bench loudly, not silently report the ~10x-slower packed number.
     # Only a non-TPU device (the CPU fallback environment) may fall back.
     variant = "pallas"
     try:
-        out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn,
-                        variant)
-        float(jnp.sum(out.infected))          # compile + real sync
+        with ledger.attribute("bench_rumor_pallas_n2e20"):
+            out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn,
+                            variant)
+            float(jnp.sum(out.infected))      # compile + real sync
     except Exception as e:                    # noqa: BLE001
         if jax.devices()[0].platform == "tpu":
             raise
         print(f"# pallas path unavailable off-TPU ({type(e).__name__}: "
               f"{e}); falling back to XLA packed scan", file=sys.stderr)
         variant = "packed"
-        out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn,
-                        variant)
-        float(jnp.sum(out.infected))
+        with ledger.attribute("bench_rumor_packed_n2e20"):
+            out = rumor_run(rumor_init(n, 0), rounds, n, fanout, 1, churn,
+                            variant)
+            float(jnp.sum(out.infected))
+    ledger.close()                            # compiles done; stop listening
 
     # one untimed priming run on a fresh input: the first post-compile
     # execution is consistently a low outlier (device/tunnel spin-up)

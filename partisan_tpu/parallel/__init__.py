@@ -3,7 +3,8 @@ from .mesh import (assert_collective_budget, collective_stats, make_mesh,
 from .dataplane import (init_sharded_world, make_sharded_run_scan,
                         make_sharded_step, place_sharded_world,
                         shard_align_msgs, sharded_out_cap)
-from .dense_dataplane import (make_sharded_dense_round, place_sharded,
+from .dense_dataplane import (make_sharded_dense_round, make_sharded_runner,
+                              place_sharded,
                               run_sharded, run_sharded_chunked,
                               run_sharded_staggered, sharded_dense_init,
                               sharded_pt_init, sharded_scamp_init, to_dense,

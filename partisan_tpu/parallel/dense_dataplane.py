@@ -1032,31 +1032,64 @@ def _make_sharded_scamp_round(cfg: Config, mesh, *, churn=0.0,
 
 # ---- runners -----------------------------------------------------------
 
-def run_sharded(step, state, n_rounds: int):
-    """Whole-launch-on-device scan over a metrics-returning sharded
-    step (flight-less programs)."""
+def make_sharded_runner(step, *, stream=None):
+    """Build the k-round whole-launch scan over a metrics-returning
+    sharded step (flight-less programs).  ``stream`` (a
+    :class:`~..telemetry.observatory.StreamSpec`) drains each round's
+    replicated metrics dict to the host MID-SCAN through an ordered
+    ``io_callback`` — the scan sits OUTSIDE shard_map and the metrics
+    are replicated, so the drain adds ZERO collectives to the budget.
+    ``stream=None`` compiles a byte-identical program (the
+    ``flight=None`` discipline); streaming programs are never
+    persistently cacheable, so the flagship runs stay ``stream=None``.
+    Exposed (rather than inlined in :func:`run_sharded`) so tests can
+    ``.lower()`` both variants and pin the byte-identity."""
+    if stream is not None:
+        drain = stream._drain_metrics
+        from jax.experimental import io_callback
+
+        def emit(m):
+            io_callback(drain, None, m, ordered=True)
+    else:
+        def emit(m):
+            return None
+
     @functools.partial(jax.jit, static_argnums=(1,))
     def run(st, k):
         def b(s, _):
-            s2, _m = step(s)
+            s2, m = step(s)
+            emit(m)
             return s2, None
         out, _ = jax.lax.scan(b, st, None, length=k)
         return out
-    return run(state, n_rounds)
+    return run
+
+
+def run_sharded(step, state, n_rounds: int, *, stream=None):
+    """Whole-launch-on-device scan over a metrics-returning sharded
+    step (flight-less programs); see :func:`make_sharded_runner` for
+    the ``stream`` heartbeat."""
+    out = make_sharded_runner(step, stream=stream)(state, n_rounds)
+    if stream is not None:
+        jax.effects_barrier()  # every streamed row has landed
+    return out
 
 
 def run_sharded_chunked(step, state, n_rounds: int,
-                        cfg: Config):
+                        cfg: Config, *, stream=None):
     """Launch-capped host loop (the TPU worker-fault medicine of the
     unsharded runners — launch_cap_for): per-LAUNCH scan lengths stay
     under the validated caps; chunk boundaries are bit-invariant
     because the state carries everything, pinned in tests."""
     cap = launch_cap_for(cfg.n_nodes)
+    run = make_sharded_runner(step, stream=stream)
     done = 0
     while done < n_rounds:
         k = min(cap, n_rounds - done)
-        state = run_sharded(step, state, k)
+        state = run(state, k)
         done += k
+    if stream is not None:
+        jax.effects_barrier()
     return state
 
 

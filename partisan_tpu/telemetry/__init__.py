@@ -44,6 +44,8 @@ from .runner import (ENGINE_KEYMAP, collect_round_metrics,
 from .sinks import JsonlSink, PrometheusSink, TelemetrySink, parse_exposition
 from .timeline import RoundTimeline, profile_trace
 from .perfetto import chrome_trace, write_chrome_trace
+from .observatory import (CompileLedger, LEDGER_SPECS, StreamSpec,
+                          bless_goldens, check_goldens, ledger_report)
 
 __all__ = [
     "COUNTER", "GAUGE", "DEFAULT_SPECS", "HOST_SPECS",
@@ -57,6 +59,8 @@ __all__ = [
     "JsonlSink", "PrometheusSink", "TelemetrySink", "parse_exposition",
     "RoundTimeline", "profile_trace",
     "chrome_trace", "write_chrome_trace",
+    "CompileLedger", "LEDGER_SPECS", "StreamSpec",
+    "bless_goldens", "check_goldens", "ledger_report",
     "add_global_sink", "remove_global_sink", "global_sinks", "emit_event",
     "note_round", "current_round",
 ]

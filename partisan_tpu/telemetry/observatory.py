@@ -1,0 +1,636 @@
+"""Compile observatory (ISSUE 14): the system watching itself compile.
+
+The ROADMAP names XLA compile time as the binding constraint, yet until
+now nothing recorded *what* compiles, for *how long*, or whether the
+persistent ``.jax_cache`` hit.  This module adds the three legs:
+
+* :class:`CompileLedger` — ``jax.monitoring`` listeners capture every
+  trace/lower/backend-compile duration and persistent-cache hit/miss,
+  attributed to a program name via the :meth:`CompileLedger.attribute`
+  context manager (the flagship-entrypoint registry of
+  ``verify/lint/fingerprint.py`` supplies the canonical names).  Rows
+  append to ``COMPILE_ledger.jsonl``; counter deltas fan out to any
+  :class:`~.sinks.TelemetrySink` (``LEDGER_SPECS`` names them for the
+  Prometheus exposition); :meth:`CompileLedger.compile_spans` renders
+  the durations as Perfetto slices (``perfetto.chrome_trace``'s
+  ``compile_spans=``).
+
+* :class:`StreamSpec` — the host end of the ordered ``io_callback``
+  drain the windowed runner / dense dataplane / explorer thread through
+  their scans: window metric rows and a round heartbeat reach host
+  sinks MID-SCAN instead of one transfer at the end.  ``stream=None``
+  compiles a byte-identical program (the ``flight=None`` /
+  ``control=None`` discipline), and streamed rows are bit-equal to the
+  windowed runner's flushed rows (same float32 ``registry.pack`` row,
+  pinned in tests).  Programs containing the callback are NOT
+  persistently cacheable (the cache key includes the host callable), so
+  the flagship ``stream=None`` programs — the ones the warm-cache
+  discipline protects — never carry it.
+
+* the recompile-regression gate — :func:`bless_goldens` /
+  :func:`check_goldens` replay the flagship entrypoints against the
+  committed ``COMPILE_goldens.json`` (lowered-module hash + canonical
+  arg shapes + a pinned cache verdict) and fail with NAMED errors on
+  program drift, shape drift, or an unexpected recompile (a persistent
+  cache miss where a hit is pinned).  Wall-clock never enters the
+  verdict, so the gate is stable in CI.  ``scripts/observatory.py`` is
+  the CLI (``--check`` / ``--bless`` / ``--report``).
+
+``jax.monitoring`` has no public listener deregistration, so a ledger's
+callbacks stay registered for the life of the process and gate on the
+ledger's ``enabled`` flag; :meth:`CompileLedger.uninstall` flips it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import time
+from typing import (Any, Callable, Dict, IO, List, Mapping, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
+
+from .registry import COUNTER, MetricSpec
+
+__all__ = [
+    "CompileLedger", "StreamSpec", "LEDGER_SPECS",
+    "GOLDEN_BASENAME", "LEDGER_BASENAME",
+    "bless_goldens", "check_goldens", "measure_entry", "configure_cache",
+    "ledger_report",
+]
+
+GOLDEN_BASENAME = "COMPILE_goldens.json"
+LEDGER_BASENAME = "COMPILE_ledger.jsonl"
+
+# jax.monitoring event name -> ledger short name.  Durations arrive via
+# record_event_duration_secs listeners, counts via record_event
+# listeners.  Verified against this jax version in tests (the names are
+# jax-internal; the ledger degrades to "nothing recorded" if they move,
+# and the attribution round-trip test catches that loudly).
+DURATION_EVENTS: Dict[str, str] = {
+    "/jax/core/compile/jaxpr_trace_duration": "jaxpr_trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
+    "/jax/compilation_cache/compile_time_saved_sec": "compile_time_saved",
+    "/jax/compilation_cache/cache_retrieval_time_sec": "cache_retrieval",
+}
+COUNT_EVENTS: Dict[str, str] = {
+    "/jax/compilation_cache/cache_hits": "cache_hit",
+    "/jax/compilation_cache/cache_misses": "cache_miss",
+    "/jax/compilation_cache/compile_requests_use_cache": "cache_request",
+}
+
+#: Prometheus families the ledger feeds through TelemetrySink.write_row
+#: (counter deltas; PrometheusSink accumulates into *_total samples).
+LEDGER_SPECS: Tuple[MetricSpec, ...] = (
+    MetricSpec("xla_backend_compiles", COUNTER,
+               "XLA backend_compile invocations observed by the ledger."),
+    MetricSpec("xla_compile_seconds", COUNTER,
+               "Wall seconds spent in XLA backend_compile."),
+    MetricSpec("xla_cache_hits", COUNTER,
+               "Persistent compilation-cache hits."),
+    MetricSpec("xla_cache_misses", COUNTER,
+               "Persistent compilation-cache misses (entry written)."),
+    MetricSpec("xla_cache_requests", COUNTER,
+               "Compile requests that consulted the persistent cache."),
+    MetricSpec("xla_compile_seconds_saved", COUNTER,
+               "Compile seconds avoided via persistent-cache hits."),
+)
+
+# short event name -> sink counter-row builder
+_SINK_ROWS: Dict[str, Callable[[Optional[float]], Dict[str, float]]] = {
+    "backend_compile": lambda d: {"xla_backend_compiles": 1.0,
+                                  "xla_compile_seconds": float(d or 0.0)},
+    "cache_hit": lambda d: {"xla_cache_hits": 1.0},
+    "cache_miss": lambda d: {"xla_cache_misses": 1.0},
+    "cache_request": lambda d: {"xla_cache_requests": 1.0},
+    "compile_time_saved": lambda d: {
+        "xla_compile_seconds_saved": float(d or 0.0)},
+}
+
+
+class CompileLedger:
+    """Per-program compile/cache ledger over ``jax.monitoring``.
+
+    ``path`` (or an open file) receives one JSON object per event;
+    ``sinks`` receive counter-delta rows named by :data:`LEDGER_SPECS`.
+    Attribution is a host-side dynamic scope::
+
+        ledger = CompileLedger(path="COMPILE_ledger.jsonl").install()
+        with ledger.attribute("engine_step_hyparview_n64", fingerprint=h):
+            step.trace(world).lower().compile()
+
+    Events outside any ``attribute`` scope record with ``program=None``
+    (jit fires compile requests for small helper programs too — multiple
+    rows per attributed program are normal and the summary counts them
+    all under the scope's name).
+    """
+
+    def __init__(self, path: Optional[Any] = None,
+                 sinks: Sequence[Any] = (), mode: str = "a"):
+        self.rows: List[Dict[str, Any]] = []
+        self.sinks = list(sinks)
+        self.run_id = f"{int(time.time() * 1000):x}"
+        self._stack: List[Tuple[str, Optional[str]]] = []
+        self._seq = 0
+        self._enabled = False
+        self._installed = False
+        self._f: Optional[IO[str]] = None
+        self._owns_f = False
+        if path is not None:
+            if isinstance(path, str):
+                self._f = open(path, mode)
+                self._owns_f = True
+            else:
+                self._f = path
+
+    # ------------------------------------------------------ installation
+
+    def install(self) -> "CompileLedger":
+        """Register the monitoring listeners (idempotent) and enable
+        recording.  Listeners survive for the process lifetime —
+        ``uninstall`` only disables them (jax.monitoring has no public
+        unregister)."""
+        from jax import monitoring
+        if not self._installed:
+            monitoring.register_event_duration_secs_listener(
+                self._on_duration)
+            monitoring.register_event_listener(self._on_event)
+            self._installed = True
+        self._enabled = True
+        return self
+
+    def uninstall(self) -> None:
+        self._enabled = False
+
+    def close(self) -> None:
+        self.uninstall()
+        if self._owns_f and self._f is not None and not self._f.closed:
+            self._f.close()
+
+    # ------------------------------------------------------- attribution
+
+    @contextlib.contextmanager
+    def attribute(self, program: str, fingerprint: Optional[str] = None):
+        """Attribute every compile/cache event in the scope to
+        ``program`` (innermost scope wins when nested)."""
+        self._stack.append((str(program), fingerprint))
+        try:
+            yield self
+        finally:
+            self._stack.pop()
+
+    def _current(self) -> Tuple[Optional[str], Optional[str]]:
+        return self._stack[-1] if self._stack else (None, None)
+
+    # --------------------------------------------------------- listeners
+
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        short = DURATION_EVENTS.get(event)
+        if self._enabled and short is not None:
+            self._record(short, float(duration))
+
+    def _on_event(self, event: str, **kw) -> None:
+        short = COUNT_EVENTS.get(event)
+        if self._enabled and short is not None:
+            self._record(short, None)
+
+    def _record(self, short: str, duration: Optional[float]) -> None:
+        program, fingerprint = self._current()
+        row: Dict[str, Any] = {
+            "event": short, "t_wall": time.time(), "seq": self._seq,
+            "run": self.run_id, "program": program,
+        }
+        self._seq += 1
+        if duration is not None:
+            row["duration_s"] = duration
+        if fingerprint is not None:
+            row["fingerprint"] = fingerprint
+        self.rows.append(row)
+        if self._f is not None:
+            self._f.write(json.dumps(row) + "\n")
+            self._f.flush()
+        mk = _SINK_ROWS.get(short)
+        if mk is not None and self.sinks:
+            srow = mk(duration)
+            for s in self.sinks:
+                s.write_row(srow)
+
+    # ----------------------------------------------------------- queries
+
+    def rows_for(self, program: Optional[str]) -> List[Dict[str, Any]]:
+        return [r for r in self.rows if r["program"] == program]
+
+    def count(self, short: str, program: Optional[str] = None) -> int:
+        return sum(1 for r in self.rows
+                   if r["event"] == short
+                   and (program is None or r["program"] == program))
+
+    def hits(self, program: str) -> int:
+        return self.count("cache_hit", program)
+
+    def misses(self, program: str) -> int:
+        return self.count("cache_miss", program)
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        """program -> {compiles, compile_s, cache_hits, cache_misses,
+        saved_s} (unattributed events under the ``None`` key)."""
+        out: Dict[Any, Dict[str, Any]] = {}
+        for r in self.rows:
+            d = out.setdefault(r["program"], {
+                "compiles": 0, "compile_s": 0.0, "cache_hits": 0,
+                "cache_misses": 0, "cache_requests": 0, "saved_s": 0.0})
+            ev = r["event"]
+            if ev == "backend_compile":
+                d["compiles"] += 1
+                d["compile_s"] += r.get("duration_s", 0.0)
+            elif ev == "cache_hit":
+                d["cache_hits"] += 1
+            elif ev == "cache_miss":
+                d["cache_misses"] += 1
+            elif ev == "cache_request":
+                d["cache_requests"] += 1
+            elif ev == "compile_time_saved":
+                d["saved_s"] += r.get("duration_s", 0.0)
+        return out
+
+    def compile_spans(self) -> List[Dict[str, Any]]:
+        """Duration rows as Perfetto slice dicts for
+        ``perfetto.chrome_trace(compile_spans=...)``: each span carries
+        its wall start/duration and the attributed program name."""
+        spans = []
+        for r in self.rows:
+            d = r.get("duration_s")
+            if d is None:
+                continue
+            prog = r["program"] or "unattributed"
+            spans.append({"name": f"{prog}:{r['event']}",
+                          "event": r["event"], "program": prog,
+                          "t_start": r["t_wall"] - d, "duration_s": d})
+        return spans
+
+
+# ------------------------------------------------------------- streaming
+
+class StreamSpec:
+    """Host drain for mid-scan telemetry (the ``io_callback`` leg).
+
+    Consumed by ``telemetry.runner.make_window_runner(stream=)``,
+    ``parallel.dense_dataplane.run_sharded(stream=)`` and
+    ``verify.explorer.Explorer(stream=)``:
+
+    * :meth:`_drain_row` — ordered callback target for the windowed
+      runner: one packed ``[K]`` float32 registry row per round,
+      decoded with the registry bound via :meth:`bind` (bit-equal to
+      the ring flush's rows — same float32 source).
+    * :meth:`_drain_metrics` — ordered callback target for the dense
+      dataplane's replicated per-round metrics dict (no registry; a
+      synthetic ``round`` counts callbacks when the dict carries none).
+    * :meth:`_beat` — UNORDERED round heartbeat for the explorer's
+      vmapped scan (ordered effects cannot be vmapped; the heartbeat's
+      operand is unbatched so it fires once per round, not B times).
+
+    Rows fan out to ``sinks`` / ``on_row``; beats to ``on_beat``.
+    ``keep_rows=True`` retains rows in memory for parity tests.  All
+    targets run on the host mid-scan — callers must
+    ``jax.effects_barrier()`` before trusting final totals (the runner
+    entry points do).
+    """
+
+    def __init__(self, *, registry: Any = None, sinks: Sequence[Any] = (),
+                 on_row: Optional[Callable[[Dict[str, float]], None]] = None,
+                 on_beat: Optional[Callable[[int], None]] = None,
+                 keep_rows: bool = False):
+        self.registry = registry
+        self.sinks = list(sinks)
+        self.on_row = on_row
+        self.on_beat = on_beat
+        self.keep_rows = keep_rows
+        self.rows: List[Dict[str, float]] = []
+        self.rows_streamed = 0
+        self.beats = 0
+        self.last_round = -1
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+
+    def bind(self, registry: Any) -> "StreamSpec":
+        """Attach the registry that decodes packed rows (the runner
+        calls this; explicit construction with ``registry=`` also
+        works)."""
+        if self.registry is None:
+            self.registry = registry
+        return self
+
+    # -------------------------------------------------- callback targets
+
+    def _note(self, rnd: float) -> None:
+        self.t_last = time.time()
+        if self.t_first is None:
+            self.t_first = self.t_last
+        r = int(rnd)
+        if r > self.last_round:
+            self.last_round = r
+
+    def _fan_out(self, row: Dict[str, float]) -> None:
+        self.rows_streamed += 1
+        if self.keep_rows:
+            self.rows.append(row)
+        for s in self.sinks:
+            s.write_row(row)
+        if self.on_row is not None:
+            self.on_row(row)
+
+    def _drain_row(self, packed) -> None:
+        if self.registry is None:
+            raise RuntimeError("StreamSpec.bind(registry) before streaming "
+                               "packed rows")
+        vals = np.asarray(packed)
+        row = dict(zip(self.registry.names, map(float, vals)))
+        self._note(row.get("round", self.rows_streamed))
+        self._fan_out(row)
+
+    def _drain_metrics(self, metrics: Mapping[str, Any]) -> None:
+        row = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        row.setdefault("round", float(self.rows_streamed))
+        self._note(row["round"])
+        self._fan_out(row)
+
+    def _beat(self, rnd) -> None:
+        r = int(np.asarray(rnd))
+        self.beats += 1
+        self._note(r)
+        if self.on_beat is not None:
+            self.on_beat(r)
+
+    # ----------------------------------------------------------- queries
+
+    def progress(self) -> Dict[str, Any]:
+        """Live view for watchdogs: the last round the device reported,
+        stream volume, and the age of the last callback."""
+        now = time.time()
+        return {
+            "last_round": self.last_round,
+            "rows_streamed": self.rows_streamed,
+            "beats": self.beats,
+            "age_s": (now - self.t_last) if self.t_last is not None
+            else None,
+        }
+
+
+# --------------------------------------------------- recompile gate core
+
+def configure_cache(cache_dir: str, *, record_all: bool = True
+                    ) -> Dict[str, Any]:
+    """Point jax at ``cache_dir`` and (with ``record_all``) drop the
+    persistent-cache write thresholds to zero so EVERY miss both writes
+    its entry and fires the ``cache_misses`` monitoring event — without
+    this, fast compiles miss silently (the event only fires when the
+    entry is actually written) and the gate cannot see them.  Returns
+    the previous config values for restore."""
+    import jax
+    prev = {
+        "jax_compilation_cache_dir":
+            jax.config.jax_compilation_cache_dir,
+        "jax_persistent_cache_min_compile_time_secs":
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+        "jax_persistent_cache_min_entry_size_bytes":
+            jax.config.jax_persistent_cache_min_entry_size_bytes,
+    }
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    if record_all:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return prev
+
+
+def restore_cache(prev: Mapping[str, Any]) -> None:
+    import jax
+    for k, v in prev.items():
+        jax.config.update(k, v)
+
+
+def _short_aval(x) -> str:
+    dt = getattr(x, "dtype", None)
+    shape = getattr(x, "shape", None)
+    if dt is None or shape is None:
+        return type(x).__name__
+    return f"{np.dtype(dt).name}{list(shape)}"
+
+
+def _arg_shapes(args) -> List[str]:
+    import jax
+    return [_short_aval(x) for x in jax.tree_util.tree_leaves(args)]
+
+
+def measure_entry(build: Callable[[], Tuple[Callable, tuple]]
+                  ) -> Tuple[Any, Dict[str, Any]]:
+    """Trace + lower one flagship entrypoint (no XLA compile); returns
+    the lowered object and its golden record: the sha256 of the lowered
+    StableHLO text (the program identity the cache key tracks — stable
+    across processes, no location metadata) plus the canonical arg
+    shapes."""
+    fn, args = build()
+    traced = fn.trace(*args)
+    lowered = traced.lower()
+    text = lowered.as_text()
+    h = hashlib.sha256(text.encode("utf-8")).hexdigest()[:16]
+    return lowered, {"module_hash": h, "arg_shapes": _arg_shapes(args),
+                     "pin": "hit"}
+
+
+def _registry(registry):
+    if registry is None:
+        from ..verify.lint.fingerprint import FLAGSHIP
+        return FLAGSHIP
+    return registry
+
+
+def bless_goldens(path: str, registry: Optional[Dict] = None,
+                  ledger: Optional[CompileLedger] = None,
+                  progress: Optional[Callable[[str], None]] = None
+                  ) -> Dict[str, Dict]:
+    """Record the golden ledger: lower every flagship entrypoint, hash
+    its module, and compile it once so the persistent cache holds the
+    entry the ``pin: hit`` verdict expects.  With a warm cache the
+    compile is a cache load; after a program change it pays the compile
+    once (which is exactly the cache-warming the pin needs)."""
+    registry = _registry(registry)
+    out: Dict[str, Dict] = {}
+    for name, build in registry.items():
+        if progress:
+            progress(name)
+        lowered, rec = measure_entry(build)
+        if ledger is not None:
+            with ledger.attribute(name, fingerprint=rec["module_hash"]):
+                lowered.compile()
+        else:
+            lowered.compile()
+        out[name] = rec
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return out
+
+
+def check_goldens(path: str, registry: Optional[Dict] = None,
+                  ledger: Optional[CompileLedger] = None,
+                  compile: bool = True,
+                  names: Optional[Sequence[str]] = None,
+                  progress: Optional[Callable[[str], None]] = None
+                  ) -> List[str]:
+    """The recompile-regression gate: -> list of NAMED failure strings
+    (empty = pass).  Per flagship entrypoint:
+
+    * missing golden / stale golden name -> failure (registry and
+      golden must stay in sync);
+    * lowered-module hash drift -> failure ("will recompile"): the
+      program changed, so every cache entry for it is dead weight and
+      every consumer pays the compile wall again — re-bless only when
+      the change is intended;
+    * canonical arg-shape drift -> failure (the entrypoint's shape
+      contract moved);
+    * with ``compile=True`` and a ``ledger``: compile the lowered
+      program and read the cache verdict from the monitoring events —
+      a ``cache_miss`` where the golden pins ``hit`` (or no cache
+      consult at all) is the planted-recompile failure.  Durations are
+      recorded but never judged (wall-clock tolerant).
+
+    ``compile=False`` is the lower-only mode ``__graft_entry__`` uses
+    (no XLA invocation, safe for cold environments); ``names`` filters
+    to a subset without tripping the stale-golden check.
+    """
+    with open(path, encoding="utf-8") as f:
+        golden = json.load(f)
+    registry = _registry(registry)
+    if names is not None:
+        registry = {k: v for k, v in registry.items() if k in names}
+        golden = {k: v for k, v in golden.items() if k in names}
+    errors: List[str] = []
+    for name in sorted(set(golden) - set(registry)):
+        errors.append(
+            f"{name}: in {GOLDEN_BASENAME} but not in the flagship "
+            f"registry — remove it or restore the entrypoint, then "
+            f"re-bless (scripts/observatory.py --bless)")
+    for name, build in registry.items():
+        if name not in golden:
+            errors.append(
+                f"{name}: flagship entrypoint has no compile golden — "
+                f"run scripts/observatory.py --bless")
+            continue
+        if progress:
+            progress(name)
+        ref = golden[name]
+        lowered, cur = measure_entry(build)
+        if cur["arg_shapes"] != ref.get("arg_shapes"):
+            errors.append(
+                f"{name}: canonical arg shapes changed "
+                f"{ref.get('arg_shapes')} -> {cur['arg_shapes']} — new "
+                f"program shape; re-bless only if intended")
+        if cur["module_hash"] != ref.get("module_hash"):
+            errors.append(
+                f"{name}: lowered module hash drifted "
+                f"{ref.get('module_hash')} -> {cur['module_hash']} — the "
+                f"program WILL recompile (persistent-cache entries are "
+                f"keyed on the module); re-bless after an intended "
+                f"program change")
+            continue  # a drifted program cannot honor the cache pin
+        if not compile or ledger is None:
+            continue
+        if ref.get("pin", "hit") != "hit":
+            continue
+        before_h, before_m = ledger.hits(name), ledger.misses(name)
+        before_r = ledger.count("cache_request", name)
+        with ledger.attribute(name, fingerprint=cur["module_hash"]):
+            lowered.compile()
+        new_m = ledger.misses(name) - before_m
+        new_h = ledger.hits(name) - before_h
+        new_r = ledger.count("cache_request", name) - before_r
+        if new_m > 0:
+            errors.append(
+                f"{name}: UNEXPECTED RECOMPILE — {new_m} persistent-"
+                f"cache miss(es) where the golden pins a hit (module "
+                f"hash unchanged, so the cache entry was evicted or "
+                f"never warmed; run scripts/warm_cache.py, then re-run "
+                f"--check)")
+        elif new_h == 0 and new_r == 0:
+            errors.append(
+                f"{name}: persistent cache was never consulted — is "
+                f"jax_compilation_cache_dir configured? (the gate "
+                f"cannot pin cache behavior without it)")
+    return errors
+
+
+# ------------------------------------------------------------- reporting
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    rows = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def ledger_report(rows: Sequence[Mapping[str, Any]], top: int = 10
+                  ) -> str:
+    """Human report over ledger rows: top compile costs, cache hit
+    rate, and a per-entrypoint trend (latest run's compile seconds vs
+    the mean of earlier runs)."""
+    per: Dict[str, Dict[str, Any]] = {}
+    runs: Dict[str, Dict[str, float]] = {}
+    hits = misses = 0
+    for r in rows:
+        prog = r.get("program") or "unattributed"
+        d = per.setdefault(prog, {"compiles": 0, "compile_s": 0.0,
+                                  "hits": 0, "misses": 0, "saved_s": 0.0})
+        ev = r.get("event")
+        if ev == "backend_compile":
+            d["compiles"] += 1
+            d["compile_s"] += r.get("duration_s", 0.0)
+            runs.setdefault(r.get("run", "?"), {}).setdefault(prog, 0.0)
+            runs[r.get("run", "?")][prog] += r.get("duration_s", 0.0)
+        elif ev == "cache_hit":
+            d["hits"] += 1
+            hits += 1
+        elif ev == "cache_miss":
+            d["misses"] += 1
+            misses += 1
+        elif ev == "compile_time_saved":
+            d["saved_s"] += r.get("duration_s", 0.0)
+    lines = ["compile observatory report", "=" * 26]
+    total = hits + misses
+    rate = (100.0 * hits / total) if total else float("nan")
+    lines.append(f"cache: {hits} hits / {misses} misses "
+                 f"({rate:.1f}% hit rate)" if total else
+                 "cache: no persistent-cache events recorded")
+    lines.append("")
+    lines.append(f"top {top} compile costs (wall seconds in "
+                 f"backend_compile):")
+    ranked = sorted(per.items(), key=lambda kv: -kv[1]["compile_s"])
+    for prog, d in ranked[:top]:
+        lines.append(
+            f"  {d['compile_s']:8.2f}s  {prog}  "
+            f"(compiles={d['compiles']} hits={d['hits']} "
+            f"misses={d['misses']} saved={d['saved_s']:.2f}s)")
+    # trend: latest run vs the mean of prior runs, per program
+    if len(runs) >= 2:
+        order = sorted(runs)  # run ids are millisecond-hex: sortable
+        latest = runs[order[-1]]
+        lines.append("")
+        lines.append("per-entrypoint trend (latest run vs mean of "
+                     "prior runs):")
+        for prog in sorted(latest):
+            prior = [runs[rid][prog] for rid in order[:-1]
+                     if prog in runs[rid]]
+            if not prior:
+                lines.append(f"  {prog}: {latest[prog]:.2f}s (new)")
+                continue
+            base = sum(prior) / len(prior)
+            delta = latest[prog] - base
+            lines.append(f"  {prog}: {latest[prog]:.2f}s vs "
+                         f"{base:.2f}s mean ({delta:+.2f}s)")
+    return "\n".join(lines)

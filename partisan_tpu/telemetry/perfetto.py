@@ -33,13 +33,23 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
 __all__ = ["chrome_trace", "write_chrome_trace"]
 
 # reserved pids: shards occupy [0, n_shards); the two host-side tracks
-# follow them
+# follow them.  Host-event instants and compile spans share the ONE
+# host process (separate named thread lanes) so a flight trace and a
+# compile ledger open in a single Perfetto view without track-name
+# collisions (ISSUE 14 small fix).
 _METRICS_TRACK = "metrics"
-_HOST_TRACK = "host events"
+_HOST_TRACK = "host"
+_HOST_EVENTS_TID = 0
+_COMPILE_TID = 1
 
 
 def _meta(pid: int, name: str) -> Dict[str, Any]:
     return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def _thread_meta(pid: int, tid: int, name: str) -> Dict[str, Any]:
+    return {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": name}}
 
 
@@ -51,6 +61,7 @@ def chrome_trace(
     metric_rows: Iterable[Mapping[str, Any]] = (),
     host_events: Iterable[Mapping[str, Any]] = (),
     collective_stats: Optional[Mapping[str, Any]] = None,
+    compile_spans: Iterable[Mapping[str, Any]] = (),
     us_per_round: int = 1000,
 ) -> Dict[str, Any]:
     """Build the Chrome trace-event dict.
@@ -66,6 +77,12 @@ def chrome_trace(
     as per-op ``collective_bytes`` / ``collectives_per_round`` counter
     tracks (one sample — the compiled round's contract, constant over
     the run).
+    ``compile_spans`` — ``observatory.CompileLedger.compile_spans()``
+    rows; rendered as complete slices on the host process's
+    "xla compile" lane.  Compile spans carry wall-clock, not rounds, so
+    their time base is microseconds from the earliest span — they share
+    the VIEW (one process group, no name collisions with host-event
+    instants), not the round axis.
     """
     upr = int(us_per_round)
     n_loc = None
@@ -85,7 +102,9 @@ def chrome_trace(
     metrics_pid = n_shards
     host_pid = n_shards + 1
     events: List[Dict[str, Any]] = [
-        _meta(metrics_pid, _METRICS_TRACK), _meta(host_pid, _HOST_TRACK)]
+        _meta(metrics_pid, _METRICS_TRACK), _meta(host_pid, _HOST_TRACK),
+        _thread_meta(host_pid, _HOST_EVENTS_TID, "events"),
+        _thread_meta(host_pid, _COMPILE_TID, "xla compile")]
     seen_shards = set()
 
     for e in entries:
@@ -138,8 +157,21 @@ def chrome_trace(
         args = {k: v for k, v in row.items()
                 if isinstance(v, (int, float, str, bool))}
         events.append({"name": str(name), "cat": "host", "ph": "i",
-                       "s": "g", "ts": ts, "pid": host_pid, "tid": 0,
-                       "args": args})
+                       "s": "g", "ts": ts, "pid": host_pid,
+                       "tid": _HOST_EVENTS_TID, "args": args})
+
+    spans = [s for s in compile_spans if s.get("duration_s") is not None]
+    if spans:
+        t0_wall = min(float(s.get("t_start", 0.0)) for s in spans)
+        for s in spans:
+            dur_us = max(int(float(s["duration_s"]) * 1e6), 1)
+            ts = int((float(s.get("t_start", 0.0)) - t0_wall) * 1e6)
+            args = {k: v for k, v in s.items()
+                    if isinstance(v, (int, float, str, bool))}
+            events.append({
+                "name": str(s.get("name", s.get("event", "compile"))),
+                "cat": "compile", "ph": "X", "ts": ts, "dur": dur_us,
+                "pid": host_pid, "tid": _COMPILE_TID, "args": args})
 
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"us_per_round": upr, "n_shards": n_shards,

@@ -109,6 +109,7 @@ def make_window_runner(
     window: int, *,
     step: Optional[Callable] = None,
     flight: Optional[Any] = None,
+    stream: Optional[Any] = None,
     **step_kw: Any,
 ) -> Callable:
     """Compile ``window`` rounds + ring recording into one jitted scan.
@@ -119,9 +120,29 @@ def make_window_runner(
     metrics ring: ``run_window(world, ring, fring)``.  With
     ``flight=None`` the compiled program is byte-identical to the
     pre-recorder harness (the recorder-off cost is zero by
-    construction, not by measurement)."""
+    construction, not by measurement).
+
+    ``stream`` (a :class:`.observatory.StreamSpec`) drains each round's
+    packed registry row to the host MID-SCAN through an ordered
+    ``io_callback`` — the same ``[K]`` float32 row the ring records, so
+    streamed rows are bit-equal to the flushed ones.  ``stream=None``
+    keeps the program byte-identical (the ``flight=None`` discipline);
+    note a streaming program is never persistently cacheable (the cache
+    key includes the host callback), so flagship programs stay
+    ``stream=None``."""
     step = step or make_step(cfg, proto, donate=False, flight=flight,
                              **step_kw)
+
+    if stream is not None:
+        stream.bind(registry)
+        drain = stream._drain_row
+        from jax.experimental import io_callback
+
+        def emit(vals):
+            io_callback(drain, None, registry.pack(vals), ordered=True)
+    else:
+        def emit(vals):
+            return None
 
     if flight is not None:
         @jax.jit
@@ -130,6 +151,7 @@ def make_window_runner(
                 w, r, fr = carry
                 w2, fr2, m = step(w, fr)
                 vals = collect_round_metrics(proto, w2, m, registry)
+                emit(vals)
                 return (w2, record(r, registry, vals), fr2), None
 
             (w2, r2, fr2), _ = jax.lax.scan(
@@ -144,6 +166,7 @@ def make_window_runner(
             w, r = carry
             w2, m = step(w)
             vals = collect_round_metrics(proto, w2, m, registry)
+            emit(vals)
             return (w2, record(r, registry, vals)), None
 
         (w2, r2), _ = jax.lax.scan(body, (world, ring), None, length=window)
@@ -164,6 +187,7 @@ def run_with_telemetry(
     step_kw: Optional[Dict[str, Any]] = None,
     flight: Optional[Any] = None,
     on_flight: Optional[Callable] = None,
+    stream: Optional[Any] = None,
 ) -> Tuple[World, RoundTimeline]:
     """Run ``n_rounds`` with in-scan telemetry, flushing every ``window``.
 
@@ -179,6 +203,12 @@ def run_with_telemetry(
     scans — still one (metrics) + one (flight) transfer per window —
     and hands each window's decoded ``TraceEntry`` list to
     ``on_flight(entries)``.
+
+    ``stream`` (a :class:`.observatory.StreamSpec`) additionally drains
+    every round's metric row to the host mid-scan (live progress for
+    long windows); the windowed flush stays authoritative for the
+    returned timeline and sink rows.  An ``effects_barrier`` before
+    return guarantees every streamed row has landed.
     """
     registry = registry or default_registry()
     world = world if world is not None else init_world(cfg, proto)
@@ -197,13 +227,13 @@ def run_with_telemetry(
     step = make_step(cfg, proto, donate=False, flight=flight,
                      **(step_kw or {}))
     runner = make_window_runner(cfg, proto, registry, window, step=step,
-                                flight=flight)
+                                flight=flight, stream=stream)
     n_full, rem = divmod(n_rounds, window)
     chunks = [(runner, window)] * n_full
     if rem:
         chunks.append((
             make_window_runner(cfg, proto, registry, rem, step=step,
-                               flight=flight), rem))
+                               flight=flight, stream=stream), rem))
 
     from . import note_round
     for wi, (run_window, length) in enumerate(chunks):
@@ -230,4 +260,6 @@ def run_with_telemetry(
             s.write_row(wrow)
         if frows is not None and on_flight is not None:
             on_flight(flight_entries(frows))
+    if stream is not None:
+        jax.effects_barrier()  # every streamed row has landed
     return world, timeline

@@ -227,8 +227,10 @@ class Explorer:
                  heal_margin: int = 12,
                  view_floor: float = 0.1,
                  hops: Optional[int] = None,
-                 mesh=None):
+                 mesh=None,
+                 stream=None):
         self.cfg, self.proto = cfg, proto
+        self.stream = stream
         self.n_rounds, self.n_events = n_rounds, n_events
         self.batch = batch
         self.heal_margin = heal_margin
@@ -250,7 +252,16 @@ class Explorer:
         # (the metrics ys cost B * n_rounds scalars — nothing — and a
         # second lean program would double the dominant cost on this
         # engine, XLA compile time)
-        self._run = jax.jit(jax.vmap(self._one, in_axes=(0, 0, 0)))
+        #
+        # ``stream`` (telemetry.observatory.StreamSpec) swaps in the
+        # heartbeat variant — same fold, plus one UNORDERED io_callback
+        # per round (ordered effects cannot be vmapped; the operand is
+        # the unbatched scan index, so the beat fires once per round,
+        # not B times).  stream=None keeps _one untouched, so the
+        # flagship checker program stays byte-identical AND
+        # persistently cacheable (callbacks poison the cache key).
+        body = self._one if stream is None else self._one_streamed
+        self._run = jax.jit(jax.vmap(body, in_axes=(0, 0, 0)))
 
     # ----------------------------------------------------------- core scan
 
@@ -278,6 +289,39 @@ class Explorer:
 
         (wf, _, ok, fb), metrics = jax.lax.scan(
             body, (world, auxs, ok0, fb0), None, length=self.n_rounds)
+        return wf, ok, fb, metrics
+
+    def _one_streamed(self, world: World, table: jax.Array,
+                      check_from: jax.Array):
+        """The stream-heartbeat variant of :meth:`_one` (selected in
+        ``__init__`` when ``stream`` is set): the identical execution +
+        invariant fold, scanned over the round index so every round
+        emits one unordered host beat — the index is unbatched under
+        the vmap, so the callback fires once per round, not B times."""
+        from jax.experimental import io_callback
+        I = len(self.invariants)
+        auxs = tuple(inv.init(world) for inv in self.invariants)
+        ok0 = jnp.ones((I,), bool)
+        fb0 = jnp.full((I,), -1, jnp.int32)
+        beat = self.stream._beat
+
+        def body(carry, x):
+            w, auxs, ok, fb = carry
+            w2, m = self.step(w, table)
+            rnd = m["round"]
+            new_auxs, viols = [], []
+            for inv, aux in zip(self.invariants, auxs):
+                aux2, viol = inv.update(aux, w2, m, rnd, check_from)
+                new_auxs.append(aux2)
+                viols.append(viol)
+            viol = jnp.stack(viols)
+            fb = jnp.where(ok & viol & (fb < 0), rnd, fb)
+            ok = ok & ~viol
+            io_callback(beat, None, x, ordered=False)
+            return (w2, tuple(new_auxs), ok, fb), m
+
+        (wf, _, ok, fb), metrics = jax.lax.scan(
+            body, (world, auxs, ok0, fb0), jnp.arange(self.n_rounds))
         return wf, ok, fb, metrics
 
     # --------------------------------------------------------- batch entry
@@ -323,6 +367,8 @@ class Explorer:
             self._pad_batch(schedules))
         _, ok, fb, _ = self._run(worldB, tables, check)
         ok, fb = np.asarray(ok), np.asarray(fb)  # the one transfer
+        if self.stream is not None:
+            jax.effects_barrier()  # every heartbeat has landed
         return BatchVerdict(self.names, ok[:n], fb[:n])
 
     def run_batch_with_metrics(self, schedules: Sequence[ChaosSchedule]):
@@ -337,6 +383,8 @@ class Explorer:
         wf, ok, fb, metrics = self._run(worldB, tables, check)
         verdict = BatchVerdict(self.names, np.asarray(ok)[:n],
                                np.asarray(fb)[:n])
+        if self.stream is not None:
+            jax.effects_barrier()
         return wf, metrics, verdict
 
     def explore(self, schedules: Sequence[ChaosSchedule],

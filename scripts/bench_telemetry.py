@@ -19,6 +19,15 @@ byte-identical program to the pre-recorder harness, so the telemetry
 arm IS the recorder-off arm — its ``overhead_pct`` vs plain is reported
 unchanged.
 
+ISSUE 14 adds the streaming column: a fourth arm drains every round's
+packed metric row to the host MID-SCAN through the ordered
+``io_callback`` (``telemetry.observatory.StreamSpec``) and reports
+``stream_overhead_pct`` against the windowed telemetry arm (the <= 5%
+streaming bar).  The stream-OFF bar is structural again: ``stream=None``
+compiles a byte-identical program.  The streaming program embeds a host
+callback, so it is never persistently cacheable — this arm recompiles
+every bench run (compile time stays outside the timed windows).
+
 Run:  JAX_PLATFORMS=cpu python scripts/bench_telemetry.py [--n 4096]
 """
 
@@ -132,6 +141,29 @@ def main() -> None:
         wf, fring2, fring, dt = flight_run(wf, fring2, fring, timed=True)
         flight_secs.append(dt)
 
+    # -- streaming arm (ISSUE 14): the same windowed scan with every
+    #    round's packed row drained to the host mid-scan; the barrier
+    #    before the clock stops makes the host-side drain part of the
+    #    timed cost (that's the price being measured)
+    stream = telemetry.StreamSpec(registry=registry)
+    stream_window = telemetry.make_window_runner(
+        cfg, proto, registry, window, step=step, stream=stream)
+
+    def stream_run(world, ring, timed):
+        t0 = time.perf_counter()
+        world, ring = stream_window(world, ring)
+        _rows, ring = telemetry.flush(ring, registry)
+        jax.effects_barrier()
+        dt = time.perf_counter() - t0
+        return world, ring, (dt if timed else None)
+
+    sring = telemetry.make_ring(registry, window)
+    ws, sring, _ = stream_run(world0, sring, timed=False)
+    stream_secs = []
+    for _ in range(args.windows):
+        ws, sring, dt = stream_run(ws, sring, timed=True)
+        stream_secs.append(dt)
+
     # -- plain arm: identical schedule from the same initial world
     wp = plain_window(world0)
     int(wp.rnd)                                   # sync (warmup/compile)
@@ -148,8 +180,10 @@ def main() -> None:
     plain_rps = window / statistics.median(plain_secs)
     telem_rps = window / statistics.median(telem_secs)
     flight_rps = window / statistics.median(flight_secs)
+    stream_rps = window / statistics.median(stream_secs)
     overhead = (plain_rps - telem_rps) / plain_rps * 100.0
     flight_overhead = (telem_rps - flight_rps) / telem_rps * 100.0
+    stream_overhead = (telem_rps - stream_rps) / telem_rps * 100.0
     summary = {
         "metric": f"telemetry overhead @ HyParView N={n}, window={window}",
         "n": n, "window": window, "timed_windows": args.windows,
@@ -161,6 +195,10 @@ def main() -> None:
         "flight_cap": args.flight_cap,
         "flight_entries": flight_entries_total,
         "flight_overflow": flight_overflow_total,
+        "stream_rounds_per_sec": round(stream_rps, 2),
+        "stream_overhead_pct": round(stream_overhead, 2),
+        "stream_rows": stream.rows_streamed,
+        "stream_last_round": stream.last_round,
         "msgs_delivered_total": sum(r["msgs_delivered"] for r in all_rows),
         "out_dropped_total": sum(r["out_dropped"] for r in all_rows),
         "isolated_max": max(r["isolated"] for r in all_rows),

@@ -139,9 +139,30 @@ class _Rows:
         pass
 
 
+def live_stream(window: int):
+    """Mid-scan heartbeat for ``--stream`` (ISSUE 14): every round's
+    metric row drains to the host through the ordered ``io_callback``
+    while the scan is still running, and one line prints per window —
+    a soak that wedges mid-scan now shows WHERE.  The streaming program
+    embeds a host callback, so it is never persistently cacheable; each
+    --stream cell pays its own compile (the documented trade)."""
+    from partisan_tpu.telemetry import StreamSpec
+
+    def on_row(row):
+        rnd = row.get("round")
+        if rnd is None or int(rnd) % max(window, 1):
+            return
+        reach = row.get("health_reach_frac")
+        extra = f" reach={reach:.3f}" if reach is not None else ""
+        print(f"    [stream] round {int(rnd)}{extra}", flush=True)
+
+    return StreamSpec(on_row=on_row)
+
+
 def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
              heal_margin: int, flight_cap: int, postmortem_dir: str,
-             shuffle_interval: int = 5, out: dict = None) -> dict:
+             shuffle_interval: int = 5, stream=None,
+             out: dict = None) -> dict:
     """Run one (seed, mix) cell; returns its JSONL row (a plain dict).
 
     ``out``, when given, receives the cell's final ``world`` and ``cfg``
@@ -170,7 +191,7 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
         cfg, proto, rounds, window=window, registry=registry,
         sinks=[sink], world=world,
         flight=FlightSpec(window=window, cap=flight_cap),
-        on_flight=on_flight,
+        on_flight=on_flight, stream=stream,
         step_kw={"chaos": sched})
     dt = time.perf_counter() - t0
     if out is not None:
@@ -219,7 +240,7 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
 
 def run_workload_cell(*, n: int, rounds: int, seed: int, window: int,
                       heal_margin: int, rate_milli: int = 1000,
-                      out: dict = None) -> dict:
+                      stream=None, out: dict = None) -> dict:
     """The ISSUE-8 workload arm: a partition_heal cell with app-level
     RPC traffic riding the overlay, asserting the latency plane RECOVERS
     after the heal — the post-heal window's p99 (folded from the in-scan
@@ -248,7 +269,8 @@ def run_workload_cell(*, n: int, rounds: int, seed: int, window: int,
     t0 = time.perf_counter()
     world, _ = telemetry.run_with_telemetry(
         cfg, proto, rounds, window=window, registry=registry,
-        sinks=[sink], world=world, step_kw={"chaos": sched})
+        sinks=[sink], world=world, stream=stream,
+        step_kw={"chaos": sched})
     dt = time.perf_counter() - t0
     if out is not None:
         out["world"], out["cfg"] = world, cfg
@@ -324,6 +346,12 @@ def main(argv=None) -> int:
     ap.add_argument("--rate-milli", type=int, default=1000,
                     help="workload arm offered load "
                          "(milli-requests/round/node)")
+    ap.add_argument("--stream", action="store_true",
+                    help="drain every round's metric row to the host "
+                         "MID-SCAN (ordered io_callback) and print a "
+                         "per-window heartbeat — live progress for "
+                         "long soaks, at the cost of an uncacheable "
+                         "program (recompiles each run)")
     ap.add_argument("--replay", metavar="FILE", default=None,
                     help="re-execute a chaos counterexample JSON "
                          "(verify.explorer / scripts/chaos_explore.py) "
@@ -368,7 +396,9 @@ def main(argv=None) -> int:
             row = run_workload_cell(n=args.n, rounds=args.rounds,
                                     seed=seed, window=args.window,
                                     heal_margin=args.heal_margin,
-                                    rate_milli=args.rate_milli)
+                                    rate_milli=args.rate_milli,
+                                    stream=(live_stream(args.window)
+                                            if args.stream else None))
             rows.append(row)
             ok = row["converged"] and row["p99_recovered"]
             print(f"{'PASS' if ok else 'FAIL'} workload seed={seed}: "
@@ -416,6 +446,8 @@ def main(argv=None) -> int:
                            heal_margin=args.heal_margin,
                            flight_cap=args.flight_cap,
                            postmortem_dir=args.postmortem_dir,
+                           stream=(live_stream(args.window)
+                                   if args.stream else None),
                            out=cell_out)
             rows.append(row)
             completed.append([mix, seed])

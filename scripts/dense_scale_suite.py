@@ -84,7 +84,8 @@ def run_implicit(model: str, n: int, rounds: int, mesh, churn: float):
     return time.perf_counter() - t0, comms
 
 
-def run_explicit(model: str, n: int, rounds: int, mesh, churn: float):
+def run_explicit(model: str, n: int, rounds: int, mesh, churn: float,
+                 stream=None):
     from partisan_tpu.parallel import dense_dataplane as dd
     from partisan_tpu.parallel.mesh import assert_collective_budget
     cfg = _cfg(model, n)
@@ -98,9 +99,22 @@ def run_explicit(model: str, n: int, rounds: int, mesh, churn: float):
         forbid=("all-gather",),
         max_counts={"all-to-all": 1, "all-reduce": 2,
                     "collective-permute": 2})
-    jax.block_until_ready(dd.run_sharded_chunked(step, st, rounds, cfg))
+    # --stream (ISSUE 14): the per-round metric drain rides OUTSIDE the
+    # shard_map'd step on already-replicated values, so the collective
+    # budget asserted above is untouched; both the warm and the timed
+    # pass run the streamed program (what streams is what's measured)
+    jax.block_until_ready(
+        dd.run_sharded_chunked(step, st, rounds, cfg, stream=stream))
+    if stream is not None:
+        # the synthetic round counter spans the warm pass too — reset so
+        # the timed heartbeat reads 0..rounds and stream_rows == rounds
+        jax.effects_barrier()
+        stream.rows_streamed, stream.last_round = 0, -1
     t0 = time.perf_counter()
-    jax.block_until_ready(dd.run_sharded_chunked(step, st, rounds, cfg))
+    jax.block_until_ready(
+        dd.run_sharded_chunked(step, st, rounds, cfg, stream=stream))
+    if stream is not None:
+        jax.effects_barrier()
     return time.perf_counter() - t0, _counts(stats)
 
 
@@ -118,6 +132,11 @@ def main():
                          "an externally killed run leaves no record)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI row: N=4096, one window, both arms")
+    ap.add_argument("--stream", action="store_true",
+                    help="explicit arm: drain per-round metrics to the "
+                         "host MID-SCAN (ordered io_callback) with a "
+                         "live heartbeat; zero extra collectives, but "
+                         "the streamed program never persistent-caches")
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "BENCH_dense_scale.jsonl"))
     ap.add_argument("--csv", default=os.path.join(REPO, "results.csv"))
@@ -141,6 +160,16 @@ def main():
                        "platform": platform, "cpu_fallback": fallback,
                        "churn": args.churn}
                 fn = run_implicit if arm == "implicit" else run_explicit
+                kw = {}
+                if args.stream and arm == "explicit":
+                    from partisan_tpu.telemetry import StreamSpec
+
+                    def _beat(mrow, _rounds=rounds):
+                        rnd = int(mrow.get("round", 0))
+                        if rnd % 16 == 0 or rnd == _rounds:
+                            print(f"    [stream] round {rnd}/{_rounds} "
+                                  f"live={mrow.get('live')}", flush=True)
+                    kw["stream"] = StreamSpec(on_row=_beat)
                 if args.arm_timeout:
                     def _alarm(signum, frame):
                         raise TimeoutError(
@@ -149,10 +178,13 @@ def main():
                     signal.signal(signal.SIGALRM, _alarm)
                     signal.alarm(args.arm_timeout)
                 try:
-                    secs, comms = fn(model, n, rounds, mesh, args.churn)
+                    secs, comms = fn(model, n, rounds, mesh, args.churn,
+                                     **kw)
                     row["seconds"] = round(secs, 4)
                     row["rounds_per_sec"] = round(rounds / secs, 4)
                     row["collectives_per_round"] = comms
+                    if "stream" in kw:
+                        row["stream_rows"] = kw["stream"].rows_streamed
                 except Exception as e:  # noqa: BLE001 — annotate, don't drop
                     traceback.print_exc()
                     row["error"] = f"{type(e).__name__}: {e}"[:300]

@@ -1,0 +1,154 @@
+"""Compile-observatory CLI (ISSUE 14): the recompile-regression gate.
+
+Replays the flagship entrypoint registry (``verify/lint/fingerprint.py``
+— the same programs the trace-lint fingerprint gate pins structurally)
+against the committed ``COMPILE_goldens.json`` and the warm
+``.jax_cache``:
+
+* ``--check`` — FAILS with a NAMED error on any lowered-module drift
+  ("this program WILL recompile"), canonical-shape change, or an
+  unexpected persistent-cache miss where the golden pins a hit (the
+  planted-recompile case).  Wall-clock never enters the verdict, so the
+  gate is CI-stable.  Every run appends its compile/cache events to
+  ``COMPILE_ledger.jsonl``.
+* ``--bless`` — regenerate the golden after an INTENDED program change;
+  compiles each entrypoint once, which also warms the cache entry the
+  new golden pins (``scripts/warm_cache.py`` is the bless-free warmer).
+* ``--report`` — human summary over the accumulated ledger: top compile
+  costs, cache hit rate, per-entrypoint trend across runs.
+
+The persistent-cache write thresholds are dropped to zero for the gate
+process (``observatory.configure_cache``): the ``cache_misses``
+monitoring event only fires when an entry is actually written, so
+without this, fast recompiles would miss invisibly.
+
+Usage:  python scripts/observatory.py --check [--entry NAME ...]
+        python scripts/observatory.py --bless
+        python scripts/observatory.py --report
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN = os.path.join(REPO, "COMPILE_goldens.json")
+LEDGER = os.path.join(REPO, "COMPILE_ledger.jsonl")
+CACHE = os.path.join(REPO, ".jax_cache")
+
+
+def _jax_env() -> None:
+    """8-device virtual CPU mesh, set BEFORE the first jax import (same
+    setup as tests/conftest.py / scripts/trace_lint.py)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--check", action="store_true",
+                   help="gate: fail on program drift or unexpected "
+                        "recompile vs the committed golden")
+    g.add_argument("--bless", action="store_true",
+                   help="regenerate COMPILE_goldens.json (and warm the "
+                        "cache entries it pins)")
+    g.add_argument("--report", action="store_true",
+                   help="summarize COMPILE_ledger.jsonl (no jax import)")
+    ap.add_argument("--entry", action="append", default=None,
+                    metavar="NAME",
+                    help="restrict --check/--bless to these flagship "
+                         "entrypoints (repeatable)")
+    ap.add_argument("--golden", default=GOLDEN)
+    ap.add_argument("--ledger", default=LEDGER)
+    ap.add_argument("--cache-dir", default=CACHE)
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the --report cost table")
+    args = ap.parse_args(argv)
+
+    if args.report:
+        # ledger-only: no jax, no compiles — readable anywhere
+        from partisan_tpu.telemetry.observatory import (ledger_report,
+                                                        read_ledger)
+        if not os.path.exists(args.ledger):
+            print(f"observatory: no ledger at {args.ledger} — run "
+                  f"--check / --bless / warm_cache.py first",
+                  file=sys.stderr)
+            return 1
+        print(ledger_report(read_ledger(args.ledger), top=args.top))
+        return 0
+
+    _jax_env()
+    from partisan_tpu.telemetry import observatory as obs
+    from partisan_tpu.verify.lint.fingerprint import FLAGSHIP
+
+    obs.configure_cache(args.cache_dir, record_all=True)
+    ledger = obs.CompileLedger(path=args.ledger, mode="a").install()
+
+    registry = FLAGSHIP
+    if args.entry:
+        unknown = set(args.entry) - set(FLAGSHIP)
+        if unknown:
+            print(f"observatory: unknown entrypoints {sorted(unknown)}; "
+                  f"known: {sorted(FLAGSHIP)}", file=sys.stderr)
+            return 2
+        registry = {k: FLAGSHIP[k] for k in args.entry}
+
+    t0 = time.time()
+
+    def progress(name):
+        print(f"  {name} ... [{time.time() - t0:5.1f}s]", flush=True)
+
+    if args.bless:
+        out = obs.bless_goldens(args.golden, registry, ledger=ledger,
+                                progress=progress)
+        s = ledger.summary()
+        for name in out:
+            d = s.get(name, {})
+            print(f"  blessed {name}: module={out[name]['module_hash']} "
+                  f"hits={d.get('cache_hits', 0)} "
+                  f"misses={d.get('cache_misses', 0)} "
+                  f"compile_s={d.get('compile_s', 0.0):.2f}")
+        print(f"blessed {len(out)} entrypoints -> {args.golden} "
+              f"({time.time() - t0:.1f}s); ledger -> {args.ledger}")
+        ledger.close()
+        return 0
+
+    if not os.path.exists(args.golden):
+        print(f"observatory: missing {args.golden} — run --bless first",
+              file=sys.stderr)
+        return 1
+    names = list(registry) if args.entry else None
+    errors = obs.check_goldens(args.golden, registry, ledger=ledger,
+                               compile=True, names=names,
+                               progress=progress)
+    summary = ledger.summary()
+    gate = {n: {"hits": d["cache_hits"], "misses": d["cache_misses"],
+                "compile_s": round(d["compile_s"], 2)}
+            for n, d in summary.items() if n in registry}
+    print(json.dumps({"gate": gate}, sort_keys=True))
+    ledger.close()
+    if errors:
+        print(f"observatory: recompile gate FAILED ({len(errors)} "
+              f"errors, {time.time() - t0:.1f}s):")
+        for e in errors:
+            print(f"  {e}")
+        return 1
+    print(f"observatory: recompile gate clean — {len(registry)} "
+          f"entrypoints, every pinned program served from "
+          f"{os.path.basename(args.cache_dir)} "
+          f"({time.time() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1462,6 +1462,70 @@ def fingerprint_gate_test():
     assert not errors, "\n".join(errors)
 
 
+def stream_parity_test():
+    """ISSUE 14: mid-scan streaming vs windowed flush — the ordered
+    io_callback drain must produce BIT-EQUAL rows (same float32 pack
+    source) and stream=None must lower byte-identically (the
+    flight=None discipline; the flagship cache entries depend on it)."""
+    import partisan_tpu as _pt
+    from partisan_tpu import peer_service, telemetry
+    from partisan_tpu.models.hyparview import HyParView
+
+    class Rows:
+        def __init__(self):
+            self.rows = []
+
+        def write_row(self, r):
+            self.rows.append(dict(r))
+
+        def close(self):
+            pass
+
+    n = 64
+    cfg = _pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    world = peer_service.cluster(
+        _pt.init_world(cfg, proto), proto,
+        [(i, (i - 1) // 2) for i in range(1, n)])
+    reg = telemetry.default_registry()
+    sink = Rows()
+    telemetry.run_with_telemetry(cfg, proto, 32, window=16, registry=reg,
+                                 sinks=[sink], world=world)
+    spec = telemetry.StreamSpec(keep_rows=True)
+    telemetry.run_with_telemetry(cfg, proto, 32, window=16, registry=reg,
+                                 sinks=[Rows()], world=world, stream=spec)
+    windowed = [r for r in sink.rows
+                if "round" in r and "rounds_per_sec" not in r]
+    assert spec.rows == windowed, "streamed rows != windowed flush rows"
+    ring = telemetry.make_ring(reg, 16)
+    t_off = telemetry.make_window_runner(
+        cfg, proto, reg, 16, stream=None).lower(world, ring).as_text()
+    t_base = telemetry.make_window_runner(
+        cfg, proto, reg, 16).lower(world, ring).as_text()
+    assert t_off == t_base, "stream=None is not byte-identical"
+
+
+def compile_ledger_gate_test():
+    """ISSUE 14: the LIVE recompile-regression gate — replay every
+    flagship entrypoint against COMPILE_goldens.json with the
+    monitoring ledger armed; any module drift or persistent-cache miss
+    where the golden pins a hit fails this row by name (the CLI
+    equivalent is scripts/observatory.py --check)."""
+    from partisan_tpu.telemetry import observatory as obs
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    golden = os.path.join(repo, "COMPILE_goldens.json")
+    assert os.path.exists(golden), \
+        "missing COMPILE_goldens.json — run scripts/observatory.py --bless"
+    prev = obs.configure_cache(os.path.join(repo, ".jax_cache"))
+    ledger = obs.CompileLedger().install()
+    try:
+        errors = obs.check_goldens(golden, ledger=ledger, compile=True)
+        assert not errors, "\n".join(errors)
+    finally:
+        ledger.close()
+        obs.restore_cache(prev)
+
+
 def build_matrix():
     """(group, test, manager, path, fn_or_skipreason) rows mirroring
     all/0 + groups/0 of test/partisan_SUITE.erl:121-308.
@@ -1674,6 +1738,15 @@ def build_matrix():
         trace_lint_clean_test)
     add("analysis/lint", "fingerprint_gate", "hyparview", "engine",
         fingerprint_gate_test)
+
+    # ISSUE 14: the compile observatory — streamed-vs-windowed row
+    # parity (+ the stream=None byte-identity the cache entries depend
+    # on) and the live recompile-regression gate over the warm
+    # .jax_cache (CLI: scripts/observatory.py --check)
+    add("observability/observatory", "stream_parity_test", "hyparview",
+        "engine", stream_parity_test)
+    add("observability/observatory", "compile_ledger_gate", "hyparview",
+        "engine", compile_ledger_gate_test)
 
     return M
 

@@ -1,0 +1,107 @@
+"""One-pass ``.jax_cache`` warmer (ISSUE 14 satellite).
+
+Compiles every flagship entrypoint (``verify/lint/fingerprint.FLAGSHIP``
+— the programs tier-1 actually exercises) in conftest TIER order, so a
+cold box reaches the suite's warm-cache steady state in ONE deliberate
+pass instead of the documented two-test-run footgun (CHANGES PR 3:
+"needs two warm-up passes" after a flight.py edit — the first run pays
+compiles mid-suite and times out before caching everything new).
+
+Every compile is attributed through the compile ledger
+(``COMPILE_ledger.jsonl``), so the warmer doubles as the measurement
+pass for the compile wall: after an engine edit, ``--report`` via
+scripts/observatory.py shows exactly which flagship programs recompiled
+and what each cost.
+
+Write thresholds are dropped to zero (``observatory.configure_cache``)
+so even sub-2s programs land in the cache — the suite's own threshold
+(2.0s in conftest) only governs what TESTS write, not what they read.
+
+Usage:  python scripts/warm_cache.py [--entry NAME ...] [--ledger PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LEDGER = os.path.join(REPO, "COMPILE_ledger.jsonl")
+CACHE = os.path.join(REPO, ".jax_cache")
+
+#: flagship entrypoint -> conftest tier of the test module exercising
+#: it (tests/conftest.py _RUN_LAST*): warm in the order the suite
+#: compiles, so an interrupted warm pass still helped the tests that
+#: run first.
+ENTRY_TIERS = {
+    "engine_step_hyparview_n64": 0,        # core engine tests
+    "sharded_dataplane_round_n64x8": 0,    # test_mesh / test_dataplane
+    "explorer_checker_hyparview_b1": 1,    # tier 1: test_explorer.py
+    "dense_hyparview_n256x8": 3,           # tier 3: test_dense_dataplane
+    "dense_scamp_n256x8": 3,
+    "dense_plumtree_n256x8": 3,
+    "engine_step_control_n16": 4,          # tier 4: test_control.py
+    "dense_hyparview_control_n256x8": 4,
+}
+
+
+def _jax_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entry", action="append", default=None,
+                    metavar="NAME", help="warm only these entrypoints")
+    ap.add_argument("--ledger", default=LEDGER)
+    ap.add_argument("--cache-dir", default=CACHE)
+    args = ap.parse_args(argv)
+
+    _jax_env()
+    from partisan_tpu.telemetry import observatory as obs
+    from partisan_tpu.verify.lint.fingerprint import FLAGSHIP
+
+    order = sorted(FLAGSHIP, key=lambda n: (ENTRY_TIERS.get(n, 99), n))
+    if args.entry:
+        unknown = set(args.entry) - set(FLAGSHIP)
+        if unknown:
+            print(f"warm_cache: unknown entrypoints {sorted(unknown)}; "
+                  f"known: {sorted(FLAGSHIP)}", file=sys.stderr)
+            return 2
+        order = [n for n in order if n in set(args.entry)]
+
+    obs.configure_cache(args.cache_dir, record_all=True)
+    ledger = obs.CompileLedger(path=args.ledger, mode="a").install()
+
+    t0 = time.time()
+    warmed = loaded = 0
+    for name in order:
+        t1 = time.time()
+        lowered, rec = obs.measure_entry(FLAGSHIP[name])
+        with ledger.attribute(name, fingerprint=rec["module_hash"]):
+            lowered.compile()
+        hits = ledger.hits(name)
+        misses = ledger.misses(name)
+        verdict = "cached" if misses == 0 and hits > 0 else "compiled"
+        warmed += int(verdict == "compiled")
+        loaded += int(verdict == "cached")
+        print(f"  [tier {ENTRY_TIERS.get(name, '?')}] {name}: {verdict} "
+              f"({time.time() - t1:.1f}s, hits={hits} misses={misses}, "
+              f"module={rec['module_hash']})", flush=True)
+    print(f"warm_cache: {loaded} served from cache, {warmed} compiled "
+          f"fresh -> {args.cache_dir} ({time.time() - t0:.1f}s); "
+          f"ledger -> {args.ledger}")
+    ledger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

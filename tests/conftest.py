@@ -58,12 +58,16 @@ _RUN_LAST_2 = ("tests/test_workload.py",)
 _RUN_LAST_3 = ("tests/test_dense_dataplane.py",)
 # tier 4: the ISSUE-10 adaptive control plane is newer still
 _RUN_LAST_4 = ("tests/test_control.py",)
-# tier 5: the ISSUE-11 trace-lint / fingerprint gate is the newest
+# tier 5: the ISSUE-11 trace-lint / fingerprint gate
 _RUN_LAST_5 = ("tests/test_trace_lint.py",)
+# tier 6: the ISSUE-14 compile observatory is the newest of all
+_RUN_LAST_6 = ("tests/test_observatory.py",)
 
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_6):
+            return 6
         if any(k in it.nodeid for k in _RUN_LAST_5):
             return 5
         if any(k in it.nodeid for k in _RUN_LAST_4):
@@ -77,3 +81,52 @@ def pytest_collection_modifyitems(config, items):
         return 0
 
     items.sort(key=tier)  # stable: relative order within tiers kept
+
+
+# --------------------------------------------------------------------------
+# Per-test wall-clock ledger (ISSUE 14 satellite): every test appends one
+# row to BENCH_suite_durations.jsonl AS IT FINISHES (an interrupted or
+# timed-out run keeps everything completed so far — the tier policy above
+# exists precisely because runs get killed), and the terminal summary
+# prints the top-10 slowest.  With the compile ledger this answers "which
+# tests pay which compiles" without a profiler.
+
+import json  # noqa: E402
+import time  # noqa: E402
+
+_DUR_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                         "BENCH_suite_durations.jsonl")
+_DURATIONS = {}  # nodeid -> summed setup+call+teardown seconds
+_OUTCOMES = {}   # nodeid -> call outcome (setup outcome for skips/errors)
+_SUITE_T0 = time.time()
+
+
+def pytest_configure(config):
+    # truncate per session so the artifact is one run's ledger
+    with open(_DUR_PATH, "w"):
+        pass
+
+
+def pytest_runtest_logreport(report):
+    d = _DURATIONS
+    d[report.nodeid] = d.get(report.nodeid, 0.0) + report.duration
+    if report.when == "call" or (report.when == "setup"
+                                 and report.outcome != "passed"):
+        _OUTCOMES[report.nodeid] = report.outcome
+    if report.when == "teardown":
+        row = {"bench": "suite_durations", "test": report.nodeid,
+               "duration_s": round(d[report.nodeid], 3),
+               "t_suite": round(time.time() - _SUITE_T0, 3),
+               "outcome": _OUTCOMES.get(report.nodeid, report.outcome)}
+        with open(_DUR_PATH, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _DURATIONS:
+        return
+    top = sorted(_DURATIONS.items(), key=lambda kv: -kv[1])[:10]
+    terminalreporter.write_sep(
+        "-", f"top {len(top)} slowest tests -> {_DUR_PATH}")
+    for nodeid, secs in top:
+        terminalreporter.write_line(f"  {secs:8.2f}s  {nodeid}")

@@ -1,0 +1,353 @@
+"""Compile observatory (ISSUE 14): streaming parity, byte-identity,
+ledger attribution, and the recompile-regression gate.
+
+Runs LAST (conftest tier 6) — the newest coverage is the first thing a
+timed-out run sheds.  The heavy flagship programs these tests lower are
+the same ones tier-1 already compiles, so with a warm ``.jax_cache``
+the marginal cost here is tracing, not XLA.
+"""
+
+import functools
+import io
+import json
+import os
+import tempfile
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service, telemetry
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.parallel import dense_dataplane as dd
+from partisan_tpu.parallel.mesh import collective_stats, make_mesh
+from partisan_tpu.telemetry.observatory import (
+    CompileLedger, LEDGER_SPECS, StreamSpec, bless_goldens, check_goldens,
+    configure_cache, ledger_report, measure_entry, restore_cache)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Rows:
+    def __init__(self):
+        self.rows = []
+
+    def write_row(self, r):
+        self.rows.append(dict(r))
+
+    def close(self):
+        pass
+
+
+class TestStreamingRunner(unittest.TestCase):
+    """The windowed runner's io_callback drain: bit-parity + identity."""
+
+    @classmethod
+    def setUpClass(cls):
+        n = 64
+        cls.cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5,
+                            seed=3)
+        cls.proto = HyParView(cls.cfg)
+        cls.world = peer_service.cluster(
+            pt.init_world(cls.cfg, cls.proto), cls.proto,
+            [(i, (i - 1) // 2) for i in range(1, n)])
+        cls.reg = telemetry.default_registry()
+
+    def test_streamed_rows_bit_equal_to_windowed_flush(self):
+        sink_w = _Rows()
+        telemetry.run_with_telemetry(
+            self.cfg, self.proto, 32, window=16, registry=self.reg,
+            sinks=[sink_w], world=self.world)
+        spec = StreamSpec(keep_rows=True)
+        telemetry.run_with_telemetry(
+            self.cfg, self.proto, 32, window=16, registry=self.reg,
+            sinks=[_Rows()], world=self.world, stream=spec)
+        windowed = [r for r in sink_w.rows
+                    if "round" in r and "rounds_per_sec" not in r]
+        self.assertEqual(spec.rows_streamed, 32)
+        # same float32 pack source -> the rows are EQUAL, not close
+        self.assertEqual(spec.rows, windowed)
+        self.assertEqual(spec.last_round, 31)
+        prog = spec.progress()
+        self.assertEqual(prog["rows_streamed"], 32)
+        self.assertIsNotNone(prog["age_s"])
+
+    def test_stream_none_is_byte_identical(self):
+        ring = telemetry.make_ring(self.reg, 16)
+        base = telemetry.make_window_runner(
+            self.cfg, self.proto, self.reg, 16)
+        off = telemetry.make_window_runner(
+            self.cfg, self.proto, self.reg, 16, stream=None)
+        t_base = base.lower(self.world, ring).as_text()
+        t_off = off.lower(self.world, ring).as_text()
+        self.assertEqual(t_base, t_off)
+        # and the streamed program genuinely differs (carries the host
+        # callback custom-call -> never persistently cacheable)
+        t_on = telemetry.make_window_runner(
+            self.cfg, self.proto, self.reg, 16,
+            stream=StreamSpec(registry=self.reg)).lower(
+                self.world, ring).as_text()
+        self.assertNotEqual(t_on, t_base)
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_fixture():
+    # module-level (NOT a class attribute: a jitted callable stored on a
+    # class binds like a method and swallows `self` as its first array)
+    mesh = make_mesh(n_devices=8)
+    cfg = pt.Config(n_nodes=256, shuffle_interval=4,
+                    random_promotion_interval=2)
+    step = dd.make_sharded_dense_round(cfg, mesh)
+    st = dd.place_sharded(dd.sharded_dense_init(cfg, 8), mesh)
+    return step, st
+
+
+class TestStreamingDense(unittest.TestCase):
+    """The sharded dense dataplane's metrics drain: parity, identity,
+    and the untouched collective budget."""
+
+    def test_streamed_metrics_match_manual_stepping(self):
+        step, st = _dense_fixture()
+        sm, manual = st, []
+        for _ in range(4):
+            sm, m = step(sm)
+            manual.append({k: float(np.asarray(v)) for k, v in m.items()})
+        spec = StreamSpec(keep_rows=True)
+        out = dd.run_sharded(step, st, 4, stream=spec)
+        self.assertEqual(len(spec.rows), 4)
+        for got, want in zip(spec.rows, manual):
+            for k, v in want.items():
+                self.assertEqual(got[k], v, k)
+        # streamed final state == unstreamed final state
+        out0 = dd.run_sharded(step, st, 4)
+        for a, b in zip(jax.tree_util.tree_leaves(out0),
+                        jax.tree_util.tree_leaves(out)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_stream_none_is_byte_identical(self):
+        step, st = _dense_fixture()
+        t_base = dd.make_sharded_runner(step).lower(st, 4).as_text()
+        t_off = dd.make_sharded_runner(step, stream=None).lower(
+            st, 4).as_text()
+        self.assertEqual(t_base, t_off)
+
+    def test_streaming_adds_zero_collectives(self):
+        # the drain rides on already-replicated metrics OUTSIDE the
+        # shard_map'd step: the dataplane budget must not move
+        step, st = _dense_fixture()
+        runner = dd.make_sharded_runner(
+            step, stream=StreamSpec(keep_rows=True))
+        counts = collective_stats(
+            runner.lower(st, 4).compile())["counts"]
+        self.assertEqual(counts.get("all-to-all", 0), 1)
+        self.assertEqual(counts.get("all-reduce", 0), 1)
+        self.assertEqual(counts.get("all-gather", 0), 0)
+
+
+class TestExplorerHeartbeat(unittest.TestCase):
+    def test_unordered_beat_fires_once_per_round(self):
+        from partisan_tpu.verify.chaos import ChaosSchedule
+        from partisan_tpu.verify.explorer import Explorer, SETUPS
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, seed=3,
+                        retransmit_interval=4,
+                        retransmit_backoff_factor=2,
+                        retransmit_max_attempts=3)
+        proto, world = SETUPS["acked_uniform"](cfg)
+        beats = []
+        spec = StreamSpec(on_beat=beats.append)
+        ex = Explorer(cfg, proto, n_rounds=12, n_events=4, batch=2,
+                      world=world, stream=spec)
+        sch = [ChaosSchedule().crash(2, (1, 2)).recover(6, (1, 2)),
+               ChaosSchedule()]
+        v = ex.run_batch(sch)
+        # once per ROUND, not per batch lane (the beat operand is
+        # unbatched, so vmap broadcasts instead of fanning out)
+        self.assertEqual(spec.beats, 12)
+        self.assertEqual(spec.last_round, 11)
+        self.assertEqual(sorted(beats), list(range(12)))
+        v0 = Explorer(cfg, proto, n_rounds=12, n_events=4, batch=2,
+                      world=world).run_batch(sch)
+        np.testing.assert_array_equal(np.asarray(v.ok), np.asarray(v0.ok))
+        np.testing.assert_array_equal(np.asarray(v.first_bad),
+                                      np.asarray(v0.first_bad))
+
+
+def _toy(c):
+    @jax.jit
+    def f(x):
+        return jnp.sin(x) * c + jnp.float32(c)
+    return f
+
+
+def _build_toy(c=3.0):
+    def build():
+        return _toy(c), (jnp.arange(16, dtype=jnp.float32),)
+    return build
+
+
+class TestCompileLedger(unittest.TestCase):
+    """Attribution round-trip against a throwaway persistent cache."""
+
+    def test_attribution_miss_then_hit(self):
+        tmp = tempfile.mkdtemp()
+        prev = configure_cache(os.path.join(tmp, "cache"))
+        try:
+            buf = io.StringIO()
+            prom = telemetry.PrometheusSink(
+                telemetry.default_registry().with_specs(LEDGER_SPECS))
+            led = CompileLedger(path=buf, sinks=[prom]).install()
+            with led.attribute("toy_a", fingerprint="abc"):
+                _toy(2.0)(jnp.arange(8, dtype=jnp.float32)
+                          ).block_until_ready()
+            self.assertGreaterEqual(led.misses("toy_a"), 1)
+            self.assertEqual(led.hits("toy_a"), 0)
+            jax.clear_caches()
+            with led.attribute("toy_a", fingerprint="abc"):
+                _toy(2.0)(jnp.arange(8, dtype=jnp.float32)
+                          ).block_until_ready()
+            self.assertGreaterEqual(led.hits("toy_a"), 1)
+            # JSONL rows carry the attribution + fingerprint
+            lines = [json.loads(line)
+                     for line in buf.getvalue().splitlines()]
+            self.assertTrue(lines)
+            self.assertTrue(all(r["program"] == "toy_a" for r in lines))
+            self.assertTrue(all(r["fingerprint"] == "abc" for r in lines))
+            # Prometheus families accumulated the deltas
+            expo = telemetry.parse_exposition(prom.expose())
+            self.assertGreaterEqual(
+                expo["partisan_xla_cache_hits_total"]["samples"][""], 1)
+            self.assertGreaterEqual(
+                expo["partisan_xla_cache_misses_total"]["samples"][""], 1)
+            s = led.summary()["toy_a"]
+            self.assertGreaterEqual(s["cache_requests"], 2)
+            # spans render on the host process's compile lane, sharing
+            # the track group with host-event instants (no collisions)
+            spans = led.compile_spans()
+            self.assertTrue(spans)
+            doc = telemetry.chrome_trace(
+                compile_spans=spans,
+                host_events=[{"event": "warm", "seq": 0}])
+            ev = doc["traceEvents"]
+            slices = [e for e in ev if e.get("cat") == "compile"]
+            instants = [e for e in ev if e.get("cat") == "host"]
+            self.assertTrue(slices and instants)
+            self.assertEqual({e["pid"] for e in slices},
+                             {instants[0]["pid"]})
+            self.assertNotEqual(slices[0]["tid"], instants[0]["tid"])
+            tnames = {(e["pid"], e["tid"]): e["args"]["name"]
+                      for e in ev if e.get("name") == "thread_name"}
+            self.assertIn("xla compile", tnames.values())
+            led.close()
+            self.assertFalse(led._enabled)
+            report = ledger_report(led.rows, top=3)
+            self.assertIn("hit rate", report)
+            self.assertIn("toy_a", report)
+        finally:
+            restore_cache(prev)
+
+    def test_uninstalled_ledger_records_nothing(self):
+        led = CompileLedger().install()
+        led.uninstall()
+        _toy(7.0)(jnp.arange(4, dtype=jnp.float32)).block_until_ready()
+        self.assertEqual(led.rows, [])
+
+
+class TestRecompileGate(unittest.TestCase):
+    """check_goldens: pass on warm, NAMED failures on every drift."""
+
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+        self.prev = configure_cache(os.path.join(self.tmp, "cache"))
+        self.led = CompileLedger().install()
+        self.golden = os.path.join(self.tmp, "g.json")
+        self.reg = {"toy": _build_toy(3.0)}
+        bless_goldens(self.golden, self.reg, ledger=self.led)
+
+    def tearDown(self):
+        self.led.close()
+        restore_cache(self.prev)
+
+    def test_pass_on_warm_cache(self):
+        jax.clear_caches()
+        self.assertEqual(
+            check_goldens(self.golden, self.reg, ledger=self.led), [])
+
+    def test_planted_recompile_fails_named(self):
+        jax.clear_caches()
+        configure_cache(os.path.join(self.tmp, "cache_empty"))
+        errs = check_goldens(self.golden, self.reg, ledger=self.led)
+        self.assertEqual(len(errs), 1)
+        self.assertIn("UNEXPECTED RECOMPILE", errs[0])
+        self.assertIn("toy", errs[0])
+
+    def test_program_drift_fails_named(self):
+        jax.clear_caches()
+        errs = check_goldens(self.golden, {"toy": _build_toy(5.0)},
+                             ledger=self.led)
+        self.assertEqual(len(errs), 1)
+        self.assertIn("hash drifted", errs[0])
+        self.assertIn("toy", errs[0])
+
+    def test_perturbed_golden_fails_named(self):
+        with open(self.golden) as f:
+            g = json.load(f)
+        g["toy"]["module_hash"] = "deadbeefdeadbeef"
+        with open(self.golden, "w") as f:
+            json.dump(g, f)
+        jax.clear_caches()
+        errs = check_goldens(self.golden, self.reg, ledger=self.led)
+        self.assertTrue(errs)
+        self.assertIn("hash drifted", errs[0])
+
+    def test_registry_golden_sync_both_directions(self):
+        errs = check_goldens(self.golden,
+                             {"toy": _build_toy(3.0),
+                              "toy_new": _build_toy(9.0)},
+                             compile=False)
+        self.assertTrue(any("no compile golden" in e for e in errs))
+        errs = check_goldens(self.golden, {}, compile=False)
+        self.assertTrue(any("not in the flagship registry" in e
+                            for e in errs))
+
+
+class TestCommittedGolden(unittest.TestCase):
+    def test_committed_golden_matches_flagship_engine_step(self):
+        """Lower-only subset check of the COMMITTED golden: the same
+        mode __graft_entry__ runs, pinned here so a program edit that
+        forgets to re-bless fails in-tree before the gate CLI does."""
+        path = os.path.join(REPO, "COMPILE_goldens.json")
+        self.assertTrue(os.path.exists(path),
+                        "run scripts/observatory.py --bless")
+        errs = check_goldens(path, compile=False,
+                             names=["engine_step_hyparview_n64"])
+        self.assertEqual(errs, [])
+
+    def test_measure_entry_is_deterministic(self):
+        from partisan_tpu.verify.lint.fingerprint import FLAGSHIP
+        build = FLAGSHIP["engine_step_hyparview_n64"]
+        _, a = measure_entry(build)
+        _, b = measure_entry(build)
+        self.assertEqual(a["module_hash"], b["module_hash"])
+        self.assertEqual(a["arg_shapes"], b["arg_shapes"])
+
+
+class TestSuiteDurations(unittest.TestCase):
+    def test_durations_ledger_is_accumulating(self):
+        """conftest streams one row per finished test; by tier 6 the
+        artifact must already hold most of the suite."""
+        path = os.path.join(REPO, "BENCH_suite_durations.jsonl")
+        self.assertTrue(os.path.exists(path))
+        with open(path) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        self.assertGreater(len(rows), 10)
+        for r in rows[:5]:
+            self.assertEqual(r["bench"], "suite_durations")
+            self.assertIn("test", r)
+            self.assertGreaterEqual(r["duration_s"], 0.0)
+            self.assertIn(r["outcome"], ("passed", "skipped", "failed"))
+
+
+if __name__ == "__main__":
+    unittest.main()
