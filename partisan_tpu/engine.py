@@ -209,6 +209,24 @@ class ProtocolBase:
         replicated plane, so identical values land on every row."""
         return state
 
+    # --- lifecycle-tracer taps (ISSUE 16 span plane) -----------------------
+    def trace_taps(self, cfg, pre, mid, post, rnd):
+        """Protocol-state lifecycle events for the message tracer
+        (``make_step(trace=)``): return an iterable of ``(event_name,
+        tap)`` where ``event_name`` is a :data:`telemetry.tracer
+        .EVENT_NAMES` string (acked / retransmitted / dead_lettered /
+        shed ...) and ``tap`` is a dict of per-node columns — ``keep``
+        ``[n, S]`` bool plus optional ``dst``/``typ``/``seq``/``born``
+        broadcastable to ``[n, S]`` (src is the tapping node itself).
+        ``pre``/``mid``/``post`` are the per-node state at round start,
+        after the deliver phase, and after tick — diffing them is how a
+        tap detects transitions (an ack landing clears a send slot,
+        a retransmit bumps an attempt counter).  Must be pure
+        shard-local arithmetic.  Called only when tracing is on; the
+        empty default keeps ``trace=None`` programs byte-identical
+        (the round_counter_names contract)."""
+        return ()
+
     # --- emission helpers (used inside handlers) ---------------------------
 
     def no_emit(self, cap: Optional[int] = None) -> Msgs:
@@ -635,6 +653,7 @@ def make_step(
     flight: Optional[Any] = None,
     chaos: Optional[Any] = None,
     control: Optional[Any] = None,
+    trace: Optional[Any] = None,
 ) -> Callable[..., Tuple]:
     """Compile one simulation round for `proto`.
 
@@ -679,6 +698,18 @@ def make_step(
     (ONE transfer per window): the returned step then takes and returns
     a :class:`telemetry.flight.FlightRing` —
     ``step(world, fring) -> (world, fring, metrics)``.
+
+    ``trace`` (a :class:`telemetry.tracer.TraceSpec`) compiles the
+    message LIFECYCLE tracer into the round: per-message span events
+    (emitted / held / delivered / chaos verdicts on the wire, plus
+    protocol-state transitions via ``proto.trace_taps``) recorded into
+    a :class:`telemetry.tracer.TraceRing` with the flight recorder's
+    exact discipline — one compaction, counted overflow, zero
+    collectives, one host transfer per window.  The returned step takes
+    and returns the ring after any flight ring:
+    ``step(world, tring)`` or ``step(world, fring, tring)``.
+    ``trace=None`` (default) traces ZERO extra ops — byte-identical
+    programs, warm-cache safe.
     """
     cfg = autotune(cfg, proto)
     N = cfg.n_nodes
@@ -709,6 +740,19 @@ def make_step(
         # lazy: telemetry.runner imports engine, so engine must not
         # import telemetry at module load
         from .telemetry.flight import flight_record
+    if trace is not None:
+        from .telemetry import tracer as _tr
+        if trace.seq_field is not None:
+            if trace.seq_field not in proto.data_spec:
+                raise ValueError(
+                    f"make_step: trace seq_field {trace.seq_field!r} is "
+                    f"not a payload field of {type(proto).__name__} "
+                    f"(has: {sorted(proto.data_spec)})")
+            if tuple(proto.data_spec[trace.seq_field][0]) != ():
+                raise ValueError(
+                    f"make_step: trace seq_field {trace.seq_field!r} "
+                    f"must be scalar per message, has trailing shape "
+                    f"{proto.data_spec[trace.seq_field][0]}")
     dynamic_chaos = False
     if chaos is not None:
         # lazy for the same reason: verify imports engine
@@ -723,6 +767,12 @@ def make_step(
                 "cannot combine (both change the step arity); run the "
                 "found schedule through the static chaos= path to "
                 "record its flight trace")
+        if dynamic_chaos and trace is not None:
+            raise ValueError(
+                "make_step: lifecycle tracing and a DynamicSchedule "
+                "cannot combine (both change the step arity); run the "
+                "found schedule through the static chaos= path to "
+                "trace its spans")
         if not dynamic_chaos:
             chaos.validate(n_nodes=N, n_types=n_types)
     if control is not None:
@@ -735,7 +785,7 @@ def make_step(
         validate_control(control, known_metrics, proto.actuator_names,
                          where="make_step")
 
-    def step(world: World, fring=None, chaos_table=None):
+    def step(world: World, fring=None, tring=None, chaos_table=None):
         rnd = world.rnd
         node_ids = jnp.arange(N, dtype=jnp.int32)
         if chaos is not None:
@@ -761,6 +811,16 @@ def make_step(
         now = msgs.replace(valid=msgs.valid & (msgs.delay <= 0))
         ready = jnp.sum(now.valid).astype(jnp.int32)
 
+        # -- lifecycle tracer (ISSUE 16): wire captures share ONE
+        #    payload-hash pass over the carried buffer — every wire
+        #    plane below edits `valid` in place, so msgs positions (and
+        #    the seq stamp) hold through held/chaos/delivery
+        tcaps = []
+        if trace is not None:
+            seq_all = _tr.msg_seq(trace, msgs)
+            tcaps.append(_tr.wire_capture(
+                trace, _tr.EV_HELD, held, keep=held.valid, seq=seq_all))
+
         # -- chaos message plane (drop / delay / duplicate events): the
         #    same pre-fault-plane capture point the sharded dataplane
         #    uses (src-shard residency), so both paths stay bit-equal
@@ -769,6 +829,16 @@ def make_step(
             if dynamic_chaos:
                 now, chaos_held, chaos_counts = apply_chaos_msgs_table(
                     chaos_table, rnd, now)
+            elif trace is not None:
+                pre_chaos = now
+                now, chaos_held, chaos_counts, cmasks = apply_chaos_msgs(
+                    chaos, rnd, now, want_masks=True)
+                tcaps.append(_tr.wire_capture(
+                    trace, _tr.EV_CHAOS_DROPPED, pre_chaos,
+                    keep=cmasks["dropped"], seq=seq_all))
+                tcaps.append(_tr.wire_capture(
+                    trace, _tr.EV_CHAOS_DELAYED, pre_chaos,
+                    keep=cmasks["delayed"], seq=seq_all))
             else:
                 now, chaos_held, chaos_counts = apply_chaos_msgs(
                     chaos, rnd, now)
@@ -831,11 +901,24 @@ def make_step(
             lambda x: jnp.concatenate(
                 [x, jnp.zeros((1,) + x.shape[1:], x.dtype)]), now)
 
+        if trace is not None:
+            # DELIVERED = the slots the router actually placed in an
+            # inbox (inbox-cap overflow excluded): scatter the index
+            # map back onto buffer positions (invalid rows land on the
+            # dump slot and are sliced off)
+            didx = jnp.where(ib_valid, ib_idx, now.cap).reshape((-1,))
+            dmask = jnp.zeros((now.cap + 1,), bool).at[didx].set(
+                True)[:now.cap]
+            tcaps.append(_tr.wire_capture(
+                trace, _tr.EV_DELIVERED, now, keep=dmask, seq=seq_all))
+            pre_state = world.state
+
         # -- deliver (per-node sequential, batched over N, type-gated)
         dkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 1)
         delivered = deliver_batch(state, nowp, ib_idx, ib_valid, dkeys,
                                   node_ids)
         state = delivered[0]
+        mid_state = state
 
         # -- tick (timer phase); emissions normalized like handler ones
         tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 2)
@@ -858,6 +941,18 @@ def make_step(
                 delay=new.delay + cfg.ingress_delay + cfg.egress_delay)
         if interpose_send is not None:
             new = _interp(interpose_send, new, rnd, world)  # once, at send
+        if trace is not None:
+            # EMITTED: post send-interposition — a message an omission
+            # hook ate never entered the network.  Fresh emissions need
+            # their own hash pass (new buffer positions).
+            tcaps.append(_tr.wire_capture(trace, _tr.EV_EMITTED, new))
+            # protocol-state transitions (acks, retransmits, dead
+            # letters, shed): diff the round-start / post-deliver /
+            # post-tick snapshots — pre-control, pure shard-local
+            for ev_name, tap in proto.trace_taps(
+                    cfg, pre_state, mid_state, state, rnd):
+                tcaps.append(_tr.tap_capture(
+                    trace, _tr.EVENT_CODES[ev_name], node_ids, tap))
         out = msgops.concat(new, held)
         out, dropped = msgops.compact(out, out_cap)
         dropped = dropped + node_dropped
@@ -912,22 +1007,36 @@ def make_step(
                                       aux=plane)
         else:
             new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
+        if trace is not None:
+            tring = _tr.trace_record(tring, trace, tcaps, rnd)
         if flight is not None:
             # same capture point as capture_wire (the routed buffer,
             # post fault plane / interposition / lane dispatch), but
             # into the in-scan ring — no per-round host transfer
             fring = flight_record(fring, flight, now, rnd)
+            if trace is not None:
+                return new_world, fring, tring, metrics
             return new_world, fring, metrics
+        if trace is not None:
+            return new_world, tring, metrics
         return new_world, metrics
 
+    if flight is not None and trace is not None:
+        return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
     if flight is not None:
         return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+    if trace is not None:
+        # step(world, tring) — keep the two-arg calling convention of
+        # the flight path (the ring is always the trailing carry)
+        def trace_step(world: World, tring):
+            return step(world, None, tring)
+        return jax.jit(trace_step, donate_argnums=(0, 1) if donate else ())
     if dynamic_chaos:
         # step(world, chaos_table) — the table is a traced argument, so
         # ONE compiled program executes any schedule of <= n_events rows
         # (verify/explorer.py vmaps this over a [B, n_events, 5] stack)
         def dyn_step(world: World, chaos_table):
-            return step(world, None, chaos_table)
+            return step(world, None, None, chaos_table)
         return jax.jit(dyn_step, donate_argnums=(0,) if donate else ())
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 

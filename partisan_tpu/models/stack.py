@@ -132,6 +132,11 @@ class Lifted(UpperProtocol):
     def apply_setpoints(self, cfg, state, values):
         return self.inner.apply_setpoints(cfg, state, values)
 
+    def trace_taps(self, cfg, pre, mid, post, rnd):
+        # Stacked hands the upper layer its .upper slices (same contract
+        # as the counter taps above) — pure delegation
+        return self.inner.trace_taps(cfg, pre, mid, post, rnd)
+
 
 class Stacked(ProtocolBase):
     def __init__(self, lower: ProtocolBase, upper: UpperProtocol):
@@ -242,3 +247,11 @@ class Stacked(ProtocolBase):
         if up_vals:
             upper = self.upper.apply_setpoints(cfg, upper, up_vals)
         return state.replace(lower=lower, upper=upper)
+
+    def trace_taps(self, cfg, pre, mid, post, rnd):
+        # each layer diffs its own state slices (the health_counters
+        # split); event-name tuples concatenate lower-first
+        return (tuple(self.lower.trace_taps(
+                    cfg, pre.lower, mid.lower, post.lower, rnd))
+                + tuple(self.upper.trace_taps(
+                    cfg, pre.upper, mid.upper, post.upper, rnd)))

@@ -244,6 +244,7 @@ def make_sharded_step(
     flight=None,
     chaos=None,
     control=None,
+    trace=None,
 ) -> Callable[..., Tuple]:
     """Compile one explicitly-sharded simulation round.
 
@@ -287,6 +288,17 @@ def make_sharded_step(
     the residency invariant.  Both planes are shard-local arithmetic:
     the 2-collective budget holds chaos-on (the metric psum stack grows
     three ``chaos_*`` rows, still ONE psum).
+
+    ``trace`` (a :class:`telemetry.tracer.TraceSpec`) turns on the
+    ISSUE-16 message lifecycle tracer: each shard records its own span
+    events (held / chaos verdicts / EXCHANGED cross-shard hops /
+    delivered / emitted / protocol-state transitions via
+    ``proto.trace_taps``) into its slice of a
+    :class:`telemetry.tracer.TraceRing` built by
+    ``make_trace_ring(spec, n_shards=D)`` + ``place_trace_ring`` — the
+    step takes the ring after any flight ring.  Recording is
+    shard-local arithmetic only: the 2-collective budget holds with the
+    tracer on, and ``trace=None`` compiles byte-identical programs.
 
     ``interpose_recv`` is rejected here (a clear ``ValueError`` at build
     time): the recv hook runs AFTER routing on the unsharded path, which
@@ -337,6 +349,21 @@ def make_sharded_step(
     if flight is not None:
         from ..telemetry.flight import (flight_partition_specs,
                                         flight_record)
+    if trace is not None:
+        from ..telemetry import tracer as _tr
+        if trace.seq_field is not None:
+            if trace.seq_field not in proto.data_spec:
+                raise ValueError(
+                    f"make_sharded_step: trace seq_field "
+                    f"{trace.seq_field!r} is not a payload field of "
+                    f"{type(proto).__name__} "
+                    f"(has: {sorted(proto.data_spec)})")
+            if tuple(proto.data_spec[trace.seq_field][0]) != ():
+                raise ValueError(
+                    f"make_sharded_step: trace seq_field "
+                    f"{trace.seq_field!r} must be scalar per message, "
+                    f"has trailing shape "
+                    f"{proto.data_spec[trace.seq_field][0]}")
     if chaos is not None:
         from ..verify.chaos import apply_chaos_msgs, apply_chaos_nodes
         chaos.validate(n_nodes=cfg.n_nodes)
@@ -370,7 +397,7 @@ def make_sharded_step(
         got, (gpart,) = _unpack(recv, proto.data_spec, n_extra=1)
         return got, gpart, xdrop
 
-    def step_body(world: World, fring=None):
+    def step_body(world: World, fring=None, tring=None):
         rnd = world.rnd
         me = jax.lax.axis_index(NODE_AXIS)
         node_base = (me * n_loc).astype(jnp.int32)
@@ -394,6 +421,17 @@ def make_sharded_step(
         now = msgs.replace(valid=msgs.valid & (msgs.delay <= 0))
         ready = jnp.sum(now.valid).astype(jnp.int32)
 
+        # -- lifecycle tracer (ISSUE 16): shard-local span events into
+        #    this shard's ring slice.  One payload-hash pass covers the
+        #    carried buffer (held/chaos captures — pre-exchange planes
+        #    edit `valid` in place); the exchange RELOCATES slots, so
+        #    the post-exchange buffer hashes separately below.
+        tcaps = []
+        if trace is not None:
+            seq_all = _tr.msg_seq(trace, msgs)
+            tcaps.append(_tr.wire_capture(
+                trace, _tr.EV_HELD, held, keep=held.valid, seq=seq_all))
+
         # -- chaos message plane, PRE-exchange: every message is still
         #    on its src's shard here, so re-holds and duplicate copies
         #    join the shard-local held traffic (residency invariant
@@ -401,8 +439,19 @@ def make_sharded_step(
         #    capture point bit for bit
         chaos_counts = None
         if chaos is not None:
-            now, chaos_held, chaos_counts = apply_chaos_msgs(
-                chaos, rnd, now)
+            if trace is not None:
+                pre_chaos = now
+                now, chaos_held, chaos_counts, cmasks = apply_chaos_msgs(
+                    chaos, rnd, now, want_masks=True)
+                tcaps.append(_tr.wire_capture(
+                    trace, _tr.EV_CHAOS_DROPPED, pre_chaos,
+                    keep=cmasks["dropped"], seq=seq_all))
+                tcaps.append(_tr.wire_capture(
+                    trace, _tr.EV_CHAOS_DELAYED, pre_chaos,
+                    keep=cmasks["delayed"], seq=seq_all))
+            else:
+                now, chaos_held, chaos_counts = apply_chaos_msgs(
+                    chaos, rnd, now)
             if chaos_held is not None:
                 held = msgops.concat(held, chaos_held)
 
@@ -428,6 +477,17 @@ def make_sharded_step(
 
         # -- THE exchange: one bucketed all_to_all
         now, gpart, xdrop = exchange(now, src_part)
+        if trace is not None:
+            # EXCHANGED: slots that just crossed a shard boundary (src
+            # resides on another shard) — the sharded-only lifecycle
+            # hop; same-shard traffic is not a hop.  Post-exchange
+            # positions are new, so hash once here and reuse for the
+            # DELIVERED capture (route preserves positions).
+            seq_got = _tr.msg_seq(trace, now)
+            xmask = now.valid & (jnp.clip(now.src, 0, N - 1)
+                                 // n_loc != me)
+            tcaps.append(_tr.wire_capture(
+                trace, _tr.EV_EXCHANGED, now, keep=xmask, seq=seq_got))
 
         # -- dst-side fault plane (receiver aliveness + partition),
         #    local rows again
@@ -459,6 +519,15 @@ def make_sharded_step(
         nowp = jax.tree_util.tree_map(
             lambda x: jnp.concatenate(
                 [x, jnp.zeros((1,) + x.shape[1:], x.dtype)]), now)
+        if trace is not None:
+            # DELIVERED: the engine's scatter of the index map back
+            # onto (post-exchange) buffer positions
+            didx = jnp.where(ib_valid, ib_idx, now.cap).reshape((-1,))
+            dmask = jnp.zeros((now.cap + 1,), bool).at[didx].set(
+                True)[:now.cap]
+            tcaps.append(_tr.wire_capture(
+                trace, _tr.EV_DELIVERED, now, keep=dmask, seq=seq_got))
+            pre_state = world.state
 
         # -- deliver + tick + collect: the engine's own kernels over the
         #    local rows (handlers see global node ids)
@@ -466,6 +535,7 @@ def make_sharded_step(
         delivered = kernels.deliver_batch(state, nowp, ib_idx, ib_valid,
                                           dkeys, node_ids)
         state = delivered[0]
+        mid_state = state
         tkeys = jax.vmap(prng.decision_key, in_axes=(0, None))(rkeys, 2)
 
         def tick(i, r, k):
@@ -482,6 +552,15 @@ def make_sharded_step(
                 delay=new.delay + cfg.ingress_delay + cfg.egress_delay)
         if interpose_send is not None:
             new = _interp(interpose_send, new, rnd, world)
+        if trace is not None:
+            # EMITTED (post send-interposition) + protocol-state taps —
+            # identical shapes to engine.make_step, over local rows
+            tcaps.append(_tr.wire_capture(trace, _tr.EV_EMITTED, new))
+            for ev_name, tap in proto.trace_taps(
+                    cfg, pre_state, mid_state, state, rnd):
+                tcaps.append(_tr.tap_capture(
+                    trace, _tr.EVENT_CODES[ev_name], node_ids, tap))
+            tring = _tr.trace_record(tring, trace, tcaps, rnd)
         out = msgops.concat(new, held)
         out, dropped = msgops.compact(out, m_loc)
         dropped = dropped + node_dropped
@@ -529,7 +608,11 @@ def make_sharded_step(
         else:
             new_world = world.replace(state=state, msgs=out, rnd=rnd + 1)
         if flight is not None:
+            if trace is not None:
+                return new_world, fring, tring, metrics
             return new_world, fring, metrics
+        if trace is not None:
+            return new_world, tring, metrics
         return new_world, metrics
 
     sum_keys = _SUM_KEYS + (_CHAOS_KEYS if chaos is not None else ()) \
@@ -554,6 +637,22 @@ def make_sharded_step(
                          proto.actuator_names, where="make_sharded_step")
         metric_specs.update({k: P() for k in ctl_metric_names(control)})
 
+    if flight is not None and trace is not None:
+        fr_specs = flight_partition_specs(NODE_AXIS)
+        tr_specs = _tr.trace_partition_specs(NODE_AXIS)
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(0, 1, 2) if donate else ())
+        def sharded_flight_trace_step(world: World, fring, tring):
+            in_specs = world_specs(world)
+            return shard_map(step_body, mesh=mesh,
+                             in_specs=(in_specs, fr_specs, tr_specs),
+                             out_specs=(in_specs, fr_specs, tr_specs,
+                                        metric_specs),
+                             check_rep=False)(world, fring, tring)
+
+        return sharded_flight_trace_step
+
     if flight is not None:
         fr_specs = flight_partition_specs(NODE_AXIS)
 
@@ -568,6 +667,24 @@ def make_sharded_step(
                              check_rep=False)(world, fring)
 
         return sharded_flight_step
+
+    if trace is not None:
+        tr_specs = _tr.trace_partition_specs(NODE_AXIS)
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(0, 1) if donate else ())
+        def sharded_trace_step(world: World, tring):
+            in_specs = world_specs(world)
+
+            def body(world, tring):
+                return step_body(world, None, tring)
+            return shard_map(body, mesh=mesh,
+                             in_specs=(in_specs, tr_specs),
+                             out_specs=(in_specs, tr_specs,
+                                        metric_specs),
+                             check_rep=False)(world, tring)
+
+        return sharded_trace_step
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def sharded_step(world: World):

@@ -244,6 +244,33 @@ class AckedDelivery(ProtocolBase):
                 "ack_send_dropped": jnp.sum(state.send_dropped),
                 "ack_dead_lettered": jnp.sum(state.dead_lettered)}
 
+    def trace_taps(self, cfg, pre, mid, post, rnd):
+        """Lifecycle-tracer taps (ISSUE 16) over the send-ring diffs.
+        Pair with ``TraceSpec(seq_field="seq")`` so wire spans and these
+        sender-side transitions share the ``(src, seq)`` trace id.
+
+        * ``acked`` — a slot valid at round start whose deliver phase
+          freed it (an ``app_ack`` landed) or re-stored it under a new
+          seq (freed AND reused within the same round);
+        * ``retransmitted`` — tick bumped the slot's attempt counter
+          (the re-emission itself also shows as a fresh ``emitted``);
+        * ``dead_lettered`` — tick abandoned the slot at the backoff
+          give-up threshold."""
+        app = self.typ("app")
+        acked = pre.out_valid & (~mid.out_valid
+                                 | (mid.out_seq != pre.out_seq))
+        retrans = (mid.out_valid & post.out_valid
+                   & (post.out_attempt > mid.out_attempt))
+        dead = mid.out_valid & ~post.out_valid
+        return (
+            ("acked", dict(keep=acked, dst=pre.out_dst, typ=app,
+                           seq=pre.out_seq)),
+            ("retransmitted", dict(keep=retrans, dst=post.out_dst,
+                                   typ=app, seq=post.out_seq)),
+            ("dead_lettered", dict(keep=dead, dst=mid.out_dst, typ=app,
+                                   seq=mid.out_seq)),
+        )
+
 
 # ================= adaptive retransmission (ISSUE 10 control plane) ======
 

@@ -39,6 +39,13 @@ from .ring import TelemetryRing, flush, make_ring, record
 from .flight import (FlightRing, FlightSpec, flight_entries, flight_flush,
                      flight_mask, flight_record, make_flight_ring,
                      place_flight_ring)
+from .tracer import (Span, SpanEvent, TraceRing, TraceSpec, critical_path,
+                     deliveries, make_trace_ring, place_trace_ring,
+                     read_spans, trace_events, trace_flush, trace_record,
+                     trace_spans, wire_deliveries, write_spans)
+from .alerts import (ALERT_NAMES, AlertFirer, AlertSpec, alert_registry,
+                     alert_specs, alerts_exposition, make_alert_plane,
+                     make_alert_state)
 from .runner import (ENGINE_KEYMAP, collect_round_metrics,
                      make_window_runner, run_with_telemetry)
 from .sinks import JsonlSink, PrometheusSink, TelemetrySink, parse_exposition
@@ -54,6 +61,13 @@ __all__ = [
     "FlightRing", "FlightSpec", "flight_entries", "flight_flush",
     "flight_mask", "flight_record", "make_flight_ring",
     "place_flight_ring",
+    "Span", "SpanEvent", "TraceRing", "TraceSpec", "critical_path",
+    "deliveries", "make_trace_ring", "place_trace_ring", "read_spans",
+    "trace_events", "trace_flush", "trace_record", "trace_spans",
+    "wire_deliveries", "write_spans",
+    "ALERT_NAMES", "AlertFirer", "AlertSpec", "alert_registry",
+    "alert_specs", "alerts_exposition", "make_alert_plane",
+    "make_alert_state",
     "ENGINE_KEYMAP", "collect_round_metrics", "make_window_runner",
     "run_with_telemetry",
     "JsonlSink", "PrometheusSink", "TelemetrySink", "parse_exposition",

@@ -1,0 +1,278 @@
+"""In-scan alerting — device-side detectors over the metrics plane.
+
+Alerting in the reference deployment is a Prometheus rule engine
+polling the exporter: rules like ``rate(partisan_rpc_latency_bucket
+{le="4"}[1m])`` fire minutes after the regression.  Here the detectors
+run INSIDE the jitted round step, folding over the same scalar taps the
+metrics ring records — so an alert asserts in the very round its
+condition sustains, is visible in the next window flush, and costs a
+handful of scalar compares (no extra collectives, no host hops,
+program shape unchanged when disabled).
+
+Three detectors, each a *sustained-condition* counter (``consec`` in
+:class:`AlertState`): the per-round boolean must hold for ``k``
+consecutive rounds before the alert bit asserts, which is exactly the
+Prometheus ``for:`` clause moved on-device.
+
+* **convergence stall** — deliveries flatlined while traffic is still
+  in flight (``msgs_delivered == 0 and inflight > 0``).  The classic
+  gossip failure mode: the overlay wedged, nothing makes progress.
+* **SLO burn** — the per-round *delta* of the PR-8 latency histogram
+  columns shows more than ``slo_burn_milli``/1000 of completions
+  landing past the deadline bucket.  Burn-rate alerting (the SRE
+  workbook shape) over cumulative bucket counters: :class:`AlertState`
+  snapshots ``(above, total)`` so the detector sees per-round rates,
+  not lifetime averages.
+* **partition suspicion** — the health plane's reachability fraction
+  (``health_reach_frac``, a [0, 1] gauge from the PR-13 BFS probe)
+  sits below ``partition_frac_milli``/1000: some alive node cannot
+  reach the probe root, sustained — the overlay is likely split.
+
+Each detector is gated at BUILD time on its input columns being
+present in the registry (Python ``if``, not ``lax.cond``), so an
+engine-only registry gets a stall detector and nothing else, and the
+jitted program never carries a dead detector's arithmetic.
+
+Host side, :class:`AlertFirer` edge-detects the flushed alert columns
+and emits ``telemetry.emit_event`` rows on each firing/resolved
+transition; :func:`alerts_exposition` renders the currently-firing set
+in the Prometheus ``ALERTS{alertname=...}`` convention so scrapers
+treat the in-scan detectors exactly like rule-engine alerts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..workload import latency
+from .registry import GAUGE, MetricRegistry, MetricSpec
+
+# Alert bit positions (stable: ``alerts_active`` is the OR of
+# ``1 << code`` over firing alerts).
+ALERT_STALL = 0
+ALERT_SLO_BURN = 1
+ALERT_PARTITION = 2
+N_ALERTS = 3
+
+ALERT_NAMES: Tuple[str, ...] = (
+    "convergence_stall", "slo_burn", "partition_suspected")
+
+# Ring column per alert, index-aligned with the codes above.
+ALERT_COLUMNS: Tuple[str, ...] = (
+    "alert_stall", "alert_slo_burn", "alert_partition")
+
+ALERT_SPECS: Tuple[MetricSpec, ...] = (
+    MetricSpec("alert_stall", GAUGE,
+               "1 while the convergence-stall alert is firing "
+               "(msgs_delivered == 0 with inflight > 0, sustained)."),
+    MetricSpec("alert_slo_burn", GAUGE,
+               "1 while the SLO burn-rate alert is firing (per-round "
+               "fraction of completions past the deadline bucket above "
+               "threshold, sustained)."),
+    MetricSpec("alert_partition", GAUGE,
+               "1 while the partition-suspicion alert is firing "
+               "(health_reach_frac below threshold, sustained)."),
+    MetricSpec("alerts_active", GAUGE,
+               "Bitmask of firing alerts (bit i = alert code i)."),
+)
+
+
+def alert_specs() -> Tuple[MetricSpec, ...]:
+    """The ring columns the alert plane records (append via
+    ``registry.with_specs(alert_specs())``)."""
+    return ALERT_SPECS
+
+
+def alert_registry(registry: MetricRegistry) -> MetricRegistry:
+    """``registry`` plus the alert columns."""
+    return registry.with_specs(ALERT_SPECS)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertSpec:
+    """Compile-time alert-plane configuration (thresholds in integer
+    milli-units — the device compares pure int32/float32 scalars, no
+    host floats baked beyond these constants).
+
+    ``*_rounds`` fields are the Prometheus ``for:`` durations: the
+    round-condition must hold that many CONSECUTIVE rounds to fire.
+    """
+    stall_rounds: int = 8
+    slo_family: str = "rpc_latency"
+    slo_deadline_rounds: int = 4
+    slo_burn_milli: int = 500
+    slo_burn_rounds: int = 4
+    partition_frac_milli: int = 990
+    partition_rounds: int = 4
+
+    def __post_init__(self) -> None:
+        for f in ("stall_rounds", "slo_burn_rounds", "partition_rounds"):
+            if int(getattr(self, f)) < 1:
+                raise ValueError(f"AlertSpec.{f} must be >= 1")
+        if not (0 < int(self.slo_burn_milli) <= 1000):
+            raise ValueError("AlertSpec.slo_burn_milli must be in (0, 1000]")
+        if not (0 < int(self.partition_frac_milli) <= 1000):
+            raise ValueError(
+                "AlertSpec.partition_frac_milli must be in (0, 1000]")
+        if int(self.slo_deadline_rounds) < 0:
+            raise ValueError("AlertSpec.slo_deadline_rounds must be >= 0")
+
+
+@struct.dataclass
+class AlertState:
+    """Scan-carried detector state: consecutive-round counters per
+    alert plus the previous round's ``(above_deadline, total)``
+    histogram snapshot (the burn detector differentiates cumulative
+    bucket counters)."""
+    consec: jax.Array     # [N_ALERTS] int32
+    prev_hist: jax.Array  # [2] int32: (above deadline, total completions)
+
+
+def make_alert_state() -> AlertState:
+    return AlertState(consec=jnp.zeros((N_ALERTS,), jnp.int32),
+                      prev_hist=jnp.zeros((2,), jnp.int32))
+
+
+def _deadline_split(spec: AlertSpec) -> Tuple[Tuple[str, ...],
+                                              Tuple[str, ...]]:
+    """Partition the histogram family's bucket columns into (within
+    deadline, past deadline).  A bucket whose inclusive upper edge is
+    <= the deadline holds only in-SLO completions; every other bucket
+    (including +Inf) counts as burn.  Edge-straddling samples land in
+    the conservative (burn) side — same rounding the Prometheus rule
+    over ``le`` buckets makes."""
+    within: List[str] = []
+    above: List[str] = []
+    for i, b in enumerate(latency.BUCKET_NAMES):
+        name = f"{spec.slo_family}__bucket_{b}"
+        edge_ok = (i < latency.N_BUCKETS - 1
+                   and latency.BUCKET_EDGES[i] <= spec.slo_deadline_rounds)
+        (within if edge_ok else above).append(name)
+    return tuple(within), tuple(above)
+
+
+def make_alert_plane(
+    spec: AlertSpec, registry: MetricRegistry,
+) -> Tuple[Callable[[AlertState, Mapping[str, jax.Array]],
+                    Tuple[AlertState, Dict[str, jax.Array]]],
+           Tuple[str, ...]]:
+    """Build the in-scan alert update.
+
+    Returns ``(update, detectors)`` where ``update(astate, vals)``
+    takes the round's registry-named scalar taps (the dict the runner
+    packs into the metrics ring) and returns the advanced state plus
+    the alert columns to merge into that dict, and ``detectors`` names
+    the alerts whose input columns the registry actually carries
+    (build-time gating — absent detectors contribute constant 0
+    columns, which the registry mask then folds away if disabled).
+    """
+    names = set(registry.names)
+    stall_on = {"msgs_delivered", "inflight"} <= names
+    within, above = _deadline_split(spec)
+    fam_cols = within + above
+    burn_on = set(fam_cols) <= names
+    part_on = "health_reach_frac" in names
+    detectors = tuple(n for n, on in zip(
+        ALERT_NAMES, (stall_on, burn_on, part_on)) if on)
+
+    thresh = jnp.asarray(
+        [spec.stall_rounds, spec.slo_burn_rounds, spec.partition_rounds],
+        jnp.int32)
+    false = jnp.asarray(False)
+
+    def update(astate: AlertState, vals: Mapping[str, jax.Array]
+               ) -> Tuple[AlertState, Dict[str, jax.Array]]:
+        prev_hist = astate.prev_hist
+        stall = false
+        burn = false
+        part = false
+        if stall_on:
+            stall = ((jnp.asarray(vals["msgs_delivered"], jnp.int32) == 0)
+                     & (jnp.asarray(vals["inflight"], jnp.int32) > 0))
+        if burn_on:
+            hi = sum(jnp.asarray(vals[n], jnp.int32) for n in above)
+            tot = hi + sum(jnp.asarray(vals[n], jnp.int32) for n in within)
+            d_hi = hi - prev_hist[0]
+            d_tot = tot - prev_hist[1]
+            # per-round burn rate in milli: d_hi/d_tot > milli/1000,
+            # cross-multiplied to stay in int32 (no division)
+            burn = ((d_tot > 0)
+                    & (d_hi * 1000 > jnp.int32(spec.slo_burn_milli) * d_tot))
+            prev_hist = jnp.stack([hi, tot])
+        if part_on:
+            frac = jnp.asarray(vals["health_reach_frac"], jnp.float32)
+            part = frac * 1000.0 < jnp.float32(spec.partition_frac_milli)
+        conds = jnp.stack([stall, burn, part])
+        consec = jnp.where(conds, astate.consec + 1, 0).astype(jnp.int32)
+        firing = (consec >= thresh).astype(jnp.int32)
+        bits = jnp.asarray([1 << i for i in range(N_ALERTS)], jnp.int32)
+        cols = {c: firing[i] for i, c in enumerate(ALERT_COLUMNS)}
+        cols["alerts_active"] = jnp.sum(firing * bits)
+        return AlertState(consec=consec, prev_hist=prev_hist), cols
+
+    return update, detectors
+
+
+# ------------------------------------------------------------------ host
+
+class AlertFirer:
+    """Edge-detector over flushed metric rows: emits one
+    ``telemetry.emit_event`` row per firing/resolved TRANSITION (never
+    per round — a sustained alert is one event, like a Prometheus
+    notification, not a log line per evaluation)."""
+
+    def __init__(self) -> None:
+        self.active: Dict[str, bool] = {n: False for n in ALERT_NAMES}
+
+    def observe(self, row: Mapping[str, Any]
+                ) -> List[Tuple[str, str, Optional[int]]]:
+        """Fold one flushed ring row; returns the transitions as
+        ``(alertname, "firing"|"resolved", round)`` tuples (also
+        emitted as host events)."""
+        from . import emit_event
+        rnd = row.get("round")
+        rnd = int(rnd) if rnd is not None else None
+        out: List[Tuple[str, str, Optional[int]]] = []
+        for name, col in zip(ALERT_NAMES, ALERT_COLUMNS):
+            v = row.get(col)
+            if v is None:
+                continue
+            firing = float(v) >= 1.0
+            if firing == self.active[name]:
+                continue
+            self.active[name] = firing
+            state = "firing" if firing else "resolved"
+            emit_event("alert", alertname=name, alertstate=state,
+                       **({"round": rnd} if rnd is not None else {}))
+            out.append((name, state, rnd))
+        return out
+
+    def observe_rows(self, rows) -> List[Tuple[str, str, Optional[int]]]:
+        out: List[Tuple[str, str, Optional[int]]] = []
+        for r in rows:
+            out.extend(self.observe(r))
+        return out
+
+    def firing(self) -> Tuple[str, ...]:
+        return tuple(n for n in ALERT_NAMES if self.active[n])
+
+
+def alerts_exposition(firer: AlertFirer, namespace: str = "partisan") -> str:
+    """Render the currently-firing set in the Prometheus rule-engine
+    convention: an ``ALERTS{alertname=..., alertstate="firing"} 1``
+    gauge family (the exact series a real Prometheus server synthesizes
+    for active rules, so dashboards written against rule alerts read
+    in-scan alerts unchanged)."""
+    lines = [f"# HELP {namespace}_ALERTS In-scan alert plane "
+             f"(device-evaluated detectors).",
+             f"# TYPE {namespace}_ALERTS gauge"]
+    for name in ALERT_NAMES:
+        if firer.active[name]:
+            lines.append(f'{namespace}_ALERTS{{alertname="{name}",'
+                         f'alertstate="firing"}} 1')
+    return "\n".join(lines) + "\n"

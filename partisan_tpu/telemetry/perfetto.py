@@ -39,6 +39,7 @@ __all__ = ["chrome_trace", "write_chrome_trace"]
 # collisions (ISSUE 14 small fix).
 _METRICS_TRACK = "metrics"
 _HOST_TRACK = "host"
+_SPANS_TRACK = "message spans"
 _HOST_EVENTS_TID = 0
 _COMPILE_TID = 1
 
@@ -62,6 +63,7 @@ def chrome_trace(
     host_events: Iterable[Mapping[str, Any]] = (),
     collective_stats: Optional[Mapping[str, Any]] = None,
     compile_spans: Iterable[Mapping[str, Any]] = (),
+    spans: Iterable[Any] = (),
     us_per_round: int = 1000,
 ) -> Dict[str, Any]:
     """Build the Chrome trace-event dict.
@@ -83,6 +85,13 @@ def chrome_trace(
     their time base is microseconds from the earliest span — they share
     the VIEW (one process group, no name collisions with host-event
     instants), not the round axis.
+    ``spans`` — :class:`.tracer.Span` message lifecycles (the values of
+    ``tracer.trace_spans``); each renders one complete slice on a
+    "message spans" process (one thread lane per SOURCE node) from
+    birth round to terminal event, carrying the latency decomposition
+    in ``args``, plus one instant per lifecycle event on the same lane
+    — the per-message drill-down the wire track cannot give (it shows
+    hops, not lifetimes).
     """
     upr = int(us_per_round)
     n_loc = None
@@ -160,10 +169,31 @@ def chrome_trace(
                        "s": "g", "ts": ts, "pid": host_pid,
                        "tid": _HOST_EVENTS_TID, "args": args})
 
-    spans = [s for s in compile_spans if s.get("duration_s") is not None]
-    if spans:
-        t0_wall = min(float(s.get("t_start", 0.0)) for s in spans)
-        for s in spans:
+    span_list = list(spans)
+    if span_list:
+        spans_pid = n_shards + 2
+        events.append(_meta(spans_pid, _SPANS_TRACK))
+        for sp in span_list:
+            start = sp.born if sp.born >= 0 else sp.first_rnd
+            end = max(sp.last_rnd, start) + 1
+            name = (f"{typ_name(sp.typ)} #{sp.seq}" if sp.typ >= 0
+                    else f"msg #{sp.seq}")
+            args = {"src": sp.src, "dst": sp.dst, "seq": sp.seq,
+                    "attempts": sp.attempts, **sp.latency()}
+            events.append({
+                "name": name, "cat": "span", "ph": "X",
+                "ts": start * upr, "dur": (end - start) * upr,
+                "pid": spans_pid, "tid": sp.src, "args": args})
+            for e in sp.events:
+                events.append({
+                    "name": e.name, "cat": "span", "ph": "i", "s": "t",
+                    "ts": e.rnd * upr, "pid": spans_pid, "tid": sp.src,
+                    "args": {"round": e.rnd, "dst": e.dst}})
+
+    cspans = [s for s in compile_spans if s.get("duration_s") is not None]
+    if cspans:
+        t0_wall = min(float(s.get("t_start", 0.0)) for s in cspans)
+        for s in cspans:
             dur_us = max(int(float(s["duration_s"]) * 1e6), 1)
             ts = int((float(s.get("t_start", 0.0)) - t0_wall) * 1e6)
             args = {k: v for k, v in s.items()

@@ -110,6 +110,8 @@ def make_window_runner(
     step: Optional[Callable] = None,
     flight: Optional[Any] = None,
     stream: Optional[Any] = None,
+    trace: Optional[Any] = None,
+    alerts: Optional[Any] = None,
     **step_kw: Any,
 ) -> Callable:
     """Compile ``window`` rounds + ring recording into one jitted scan.
@@ -122,6 +124,16 @@ def make_window_runner(
     pre-recorder harness (the recorder-off cost is zero by
     construction, not by measurement).
 
+    ``trace`` (a :class:`.tracer.TraceSpec`) likewise co-carries the
+    message lifecycle span ring, and ``alerts`` (an
+    :class:`.alerts.AlertSpec`) folds the in-scan alert detectors over
+    each round's metric taps before they are packed (the alert columns
+    must be in ``registry`` — see :func:`.alerts.alert_registry`).
+    When either is set the runner takes/returns the EXTENDED carry
+    ``run_window(world, ring, fring, tring, astate)`` with ``None``
+    placeholders for absent planes; with both ``None`` the two legacy
+    signatures (and their compiled programs) are untouched.
+
     ``stream`` (a :class:`.observatory.StreamSpec`) drains each round's
     packed registry row to the host MID-SCAN through an ordered
     ``io_callback`` — the same ``[K]`` float32 row the ring records, so
@@ -131,7 +143,7 @@ def make_window_runner(
     key includes the host callback), so flagship programs stay
     ``stream=None``."""
     step = step or make_step(cfg, proto, donate=False, flight=flight,
-                             **step_kw)
+                             trace=trace, **step_kw)
 
     if stream is not None:
         stream.bind(registry)
@@ -143,6 +155,46 @@ def make_window_runner(
     else:
         def emit(vals):
             return None
+
+    if trace is not None or alerts is not None:
+        alert_update = None
+        if alerts is not None:
+            from .alerts import make_alert_plane
+            alert_update, _ = make_alert_plane(alerts, registry)
+
+        def call_step(w, fr, tr):
+            # step signature varies with the compiled planes; normalize
+            # to (world, fring, tring, metrics) with None placeholders
+            if flight is not None and trace is not None:
+                return step(w, fr, tr)
+            if flight is not None:
+                w2, fr2, m = step(w, fr)
+                return w2, fr2, None, m
+            if trace is not None:
+                w2, tr2, m = step(w, tr)
+                return w2, None, tr2, m
+            w2, m = step(w)
+            return w2, None, None, m
+
+        @jax.jit
+        def run_window_ext(world: World, ring: TelemetryRing,
+                           fring, tring, astate):
+            def body(carry, _):
+                w, r, fr, tr, a = carry
+                w2, fr2, tr2, m = call_step(w, fr, tr)
+                vals = collect_round_metrics(proto, w2, m, registry)
+                if alert_update is not None:
+                    a, acols = alert_update(a, vals)
+                    vals.update(acols)
+                emit(vals)
+                return (w2, record(r, registry, vals), fr2, tr2, a), None
+
+            (w2, r2, fr2, tr2, a2), _ = jax.lax.scan(
+                body, (world, ring, fring, tring, astate), None,
+                length=window)
+            return w2, r2, fr2, tr2, a2
+
+        return run_window_ext
 
     if flight is not None:
         @jax.jit
@@ -188,6 +240,10 @@ def run_with_telemetry(
     flight: Optional[Any] = None,
     on_flight: Optional[Callable] = None,
     stream: Optional[Any] = None,
+    trace: Optional[Any] = None,
+    on_trace: Optional[Callable] = None,
+    alerts: Optional[Any] = None,
+    alert_firer: Optional[Any] = None,
 ) -> Tuple[World, RoundTimeline]:
     """Run ``n_rounds`` with in-scan telemetry, flushing every ``window``.
 
@@ -209,8 +265,20 @@ def run_with_telemetry(
     long windows); the windowed flush stays authoritative for the
     returned timeline and sink rows.  An ``effects_barrier`` before
     return guarantees every streamed row has landed.
+
+    ``trace`` (a :class:`.tracer.TraceSpec`; its ``window`` must match)
+    co-carries the message lifecycle span ring — one extra transfer per
+    window — handing each window's decoded :class:`.tracer.SpanEvent`
+    list to ``on_trace(events)``.  ``alerts`` (an
+    :class:`.alerts.AlertSpec`) runs the in-scan detectors each round;
+    the alert columns are appended to the registry automatically when
+    absent, and an :class:`.alerts.AlertFirer` (``alert_firer``, or an
+    internal one) edge-detects the flushed rows into host alert events.
     """
     registry = registry or default_registry()
+    if alerts is not None and "alerts_active" not in registry:
+        from .alerts import alert_registry
+        registry = alert_registry(registry)
     world = world if world is not None else init_world(cfg, proto)
     timeline = timeline or RoundTimeline()
     ring = make_ring(registry, window)
@@ -223,17 +291,34 @@ def run_with_telemetry(
                 f"flight.window {flight.window} != runner window "
                 f"{window}: the rings flush together")
         fring = make_flight_ring(flight)
+    tring = None
+    if trace is not None:
+        from .tracer import make_trace_ring, trace_events, trace_flush
+        if trace.window != window:
+            raise ValueError(
+                f"trace.window {trace.window} != runner window "
+                f"{window}: the rings flush together")
+        tring = make_trace_ring(trace)
+    astate = None
+    if alerts is not None:
+        from .alerts import AlertFirer, make_alert_state
+        astate = make_alert_state()
+        if alert_firer is None:
+            alert_firer = AlertFirer()
+    ext = trace is not None or alerts is not None
     # one compiled step shared by the full- and partial-window scans
     step = make_step(cfg, proto, donate=False, flight=flight,
-                     **(step_kw or {}))
+                     trace=trace, **(step_kw or {}))
     runner = make_window_runner(cfg, proto, registry, window, step=step,
-                                flight=flight, stream=stream)
+                                flight=flight, stream=stream,
+                                trace=trace, alerts=alerts)
     n_full, rem = divmod(n_rounds, window)
     chunks = [(runner, window)] * n_full
     if rem:
         chunks.append((
             make_window_runner(cfg, proto, registry, rem, step=step,
-                               flight=flight, stream=stream), rem))
+                               flight=flight, stream=stream,
+                               trace=trace, alerts=alerts), rem))
 
     from . import note_round
     for wi, (run_window, length) in enumerate(chunks):
@@ -242,7 +327,10 @@ def run_with_telemetry(
                else contextlib.nullcontext())
         t0 = time.perf_counter()
         with ctx:
-            if flight is not None:
+            if ext:
+                world, ring, fring, tring, astate = run_window(
+                    world, ring, fring, tring, astate)
+            elif flight is not None:
                 world, ring, fring = run_window(world, ring, fring)
             else:
                 world, ring = run_window(world, ring)
@@ -250,6 +338,9 @@ def run_with_telemetry(
             frows = None
             if flight is not None:  # the flight transfer is TIMED too
                 frows, _overflow, fring = flight_flush(fring)
+            trows = None
+            if trace is not None:  # ... and the trace transfer
+                trows, _toverflow, tring = trace_flush(tring)
         dt = time.perf_counter() - t0
         note_round(int(world.rnd))
         wrow = timeline.observe(length, dt)
@@ -258,8 +349,13 @@ def run_with_telemetry(
                 s.write_row(row)
         for s in sinks:
             s.write_row(wrow)
+        if alert_firer is not None:
+            for row in rows:
+                alert_firer.observe(row)
         if frows is not None and on_flight is not None:
             on_flight(flight_entries(frows))
+        if trows is not None and on_trace is not None:
+            on_trace(trace_events(trows))
     if stream is not None:
         jax.effects_barrier()  # every streamed row has landed
     return world, timeline

@@ -1,0 +1,504 @@
+"""Device-side message lifecycle tracer (ISSUE 16 tentpole) — the span
+plane on top of the flight recorder's wire capture.
+
+The flight recorder (:mod:`.flight`) answers "what was on the wire in
+round r".  This module answers the question operators actually ask:
+"what happened to THIS message, and why did convergence take 14
+rounds?" — the reference's causal-context metadata plus
+``partisan_trace_orchestrator``'s per-message reconstruction (SURVEY
+§5.1/§5.3), rebuilt as in-scan int32 arithmetic:
+
+  * every traced message carries a compact trace id ``(src, born,
+    seq)`` — source node, birth round (``Msgs.born``, stable across
+    held/retransmit lifetimes) and a sequence stamp.  ``seq`` is either
+    a protocol payload field named by ``TraceSpec.seq_field`` (e.g.
+    ``"seq"`` for qos.ack streams, ``"ref"`` for workload promises) or,
+    by default, the ``wire_hash`` payload digest bitcast to int32 — the
+    SAME identity the legacy wire observer records, which is what makes
+    the critical-path ground-truth comparison exact.
+  * lifecycle events (EMITTED, HELD, EXCHANGED, DELIVERED, ACKED,
+    RETRANSMITTED, DEAD_LETTERED, SHED, CHAOS_DROPPED/DELAYED) are
+    recorded into a flight-ring-style ``[window, cap, 7]`` int32 ring
+    carried through the scan: ONE gather-shaped compaction per round
+    over the concatenated event captures, ONE ``dynamic_update_slice``
+    at the cursor, counted overflow, ONE device->host transfer per
+    window, ZERO collectives (each shard records its own slots under
+    the dataplane — identical discipline to :func:`.flight
+    .flight_record`).
+  * the event set is a COMPILE-TIME filter (``TraceSpec.events``):
+    disabled events never build a capture, so a narrow spec costs only
+    what it keeps.  ``trace=None`` compiles byte-identical programs
+    (the flight recorder's off-path contract).
+
+Host side, :func:`trace_spans` folds the flushed rows into per-message
+span trees, :func:`critical_path` walks the delivery DAG backward from
+the last delivery to the chain that determined the convergence round,
+and :meth:`Span.latency` decomposes end-to-end rounds into
+queue / retry / transit / partition-wait segments.  Spans join the
+existing Perfetto view via :func:`partisan_tpu.telemetry.perfetto
+.chrome_trace(spans=...)`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops import msg as msgops
+from ..ops.msg import Msgs
+
+# ---------------------------------------------------------------------------
+# lifecycle event codes (the `ev` column) — int32 constants baked into
+# the program, decoded by name on the host
+
+EV_EMITTED = 0         # entered the network (post send-interposition)
+EV_HELD = 1            # sat a round in the delay/hold buffer
+EV_EXCHANGED = 2       # crossed a shard boundary (sharded dataplane only)
+EV_DELIVERED = 3       # routed into a destination inbox row
+EV_ACKED = 4           # sender saw the ack / promise completed
+EV_RETRANSMITTED = 5   # sender re-emitted after backoff
+EV_DEAD_LETTERED = 6   # sender gave up (max attempts)
+EV_SHED = 7            # admission control dropped the request at issue
+EV_CHAOS_DROPPED = 8   # chaos schedule dropped it on the wire
+EV_CHAOS_DELAYED = 9   # chaos schedule delayed (or duplicated) it
+
+EVENT_NAMES: Tuple[str, ...] = (
+    "emitted", "held", "exchanged", "delivered", "acked",
+    "retransmitted", "dead_lettered", "shed", "chaos_dropped",
+    "chaos_delayed")
+EVENT_CODES: Dict[str, int] = {n: i for i, n in enumerate(EVENT_NAMES)}
+
+# columns of one trace slot, in order
+COLUMNS = ("rnd", "ev", "src", "dst", "typ", "born", "seq")
+N_COLS = len(COLUMNS)
+
+
+@struct.dataclass
+class TraceRing:
+    """Device state of the tracer, carried through the scan.  Same shape
+    discipline as :class:`.flight.FlightRing`: ``buf[w, s]`` holds slot
+    ``s`` of window-row ``w`` (empty slots have ``rnd == -1``),
+    ``overflow`` is ``[n_shards]`` so the dataplane counts per shard
+    without a collective."""
+    buf: jax.Array       # [window, cap, 7] int32
+    cursor: jax.Array    # scalar int32 — rows recorded since last flush
+    overflow: jax.Array  # [n_shards] int32 — head-capped slots, cumulative
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Host-side tracer config — every field is a compile-time constant
+    of the jitted step.
+
+    ``cap`` is the per-round EVENT budget (per shard under the
+    dataplane).  ``events=None`` records the full lifecycle; otherwise
+    only the listed codes, and disabled events never build a capture
+    (Python-level gating, the registry enable-mask pattern).  ``typs``
+    and ``node_mod``/``node_phase`` are the flight recorder's wire
+    filters applied per event.  ``seq_field`` names an int32 payload
+    field to use as the sequence stamp; ``None`` falls back to the
+    ``wire_hash`` digest (bitcast to int32)."""
+    window: int
+    cap: int
+    events: Optional[Tuple[int, ...]] = None
+    typs: Optional[Tuple[int, ...]] = None
+    node_mod: int = 1
+    node_phase: int = 0
+    seq_field: Optional[str] = None
+
+    def __post_init__(self):
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.cap < 1:
+            raise ValueError(f"cap must be >= 1, got {self.cap}")
+        if self.node_mod < 1:
+            raise ValueError(f"node_mod must be >= 1, got {self.node_mod}")
+        if not (0 <= self.node_phase < self.node_mod):
+            raise ValueError(
+                f"node_phase {self.node_phase} outside [0, {self.node_mod})")
+        if self.events is not None:
+            bad = [e for e in self.events
+                   if not (0 <= int(e) < len(EVENT_NAMES))]
+            if bad:
+                raise ValueError(
+                    f"unknown event codes {bad}; valid: "
+                    f"{dict(enumerate(EVENT_NAMES))}")
+
+
+def event_enabled(spec: TraceSpec, ev: int) -> bool:
+    """Compile-time (host) check — callers skip building a capture for
+    a disabled event entirely, so the filter costs zero device ops."""
+    return spec.events is None or ev in spec.events
+
+
+def make_trace_ring(spec: TraceSpec, n_shards: int = 1) -> TraceRing:
+    """An empty ring; ``n_shards > 1`` concatenates per-shard cap slices
+    exactly like :func:`.flight.make_flight_ring` (place with
+    :func:`place_trace_ring` before a sharded run)."""
+    return TraceRing(
+        buf=jnp.full((spec.window, n_shards * spec.cap, N_COLS), -1,
+                     jnp.int32),
+        cursor=jnp.int32(0),
+        overflow=jnp.zeros((n_shards,), jnp.int32),
+    )
+
+
+def trace_partition_specs(NODE_AXIS: str) -> TraceRing:
+    """shard_map in/out specs: cap axis sharded, cursor replicated,
+    one overflow counter per shard."""
+    from jax.sharding import PartitionSpec as P
+    return TraceRing(buf=P(None, NODE_AXIS), cursor=P(),
+                     overflow=P(NODE_AXIS))
+
+
+def place_trace_ring(ring: TraceRing, mesh) -> TraceRing:
+    """device_put the ring with its dataplane shardings."""
+    from jax.sharding import NamedSharding
+    from ..parallel.mesh import NODE_AXIS
+    specs = trace_partition_specs(NODE_AXIS)
+    return TraceRing(
+        buf=jax.device_put(ring.buf, NamedSharding(mesh, specs.buf)),
+        cursor=jax.device_put(ring.cursor,
+                              NamedSharding(mesh, specs.cursor)),
+        overflow=jax.device_put(ring.overflow,
+                                NamedSharding(mesh, specs.overflow)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# device-side captures: each event contributes one capture dict of flat
+# int32 columns + a keep mask; trace_record compacts ALL of a round's
+# captures in ONE gather
+
+
+def msg_seq(spec: TraceSpec, m: Msgs) -> jax.Array:
+    """[M] int32 sequence stamp for a wire buffer: the named payload
+    field when ``seq_field`` is set, else the wire_hash digest bitcast
+    (value-preserving — the legacy observer's ``TraceEntry.hash``)."""
+    if spec.seq_field is not None:
+        s = m.data[spec.seq_field]
+        return s.reshape((m.cap,)).astype(jnp.int32)
+    return jax.lax.bitcast_convert_type(msgops.wire_hash(m), jnp.int32)
+
+
+def _filter(spec: TraceSpec, keep: jax.Array, src: jax.Array,
+            dst: jax.Array, typ: jax.Array) -> jax.Array:
+    if spec.typs is not None:
+        tt = jnp.asarray(tuple(spec.typs), jnp.int32)
+        keep = keep & jnp.any(typ[:, None] == tt[None, :], axis=1)
+    if spec.node_mod > 1:
+        phase = jnp.int32(spec.node_phase)
+        mod = jnp.int32(spec.node_mod)
+        keep = keep & ((jnp.maximum(src, 0) % mod == phase)
+                       | (jnp.maximum(dst, 0) % mod == phase))
+    return keep
+
+
+def wire_capture(spec: TraceSpec, ev: int, m: Msgs,
+                 keep: Optional[jax.Array] = None,
+                 seq: Optional[jax.Array] = None) -> Optional[dict]:
+    """Capture for a wire-buffer event.  ``keep`` defaults to
+    ``m.valid`` — callers pass the exact slot mask for the event (e.g.
+    the chaos drop mask over the pre-chaos buffer).  ``seq`` lets the
+    caller reuse one :func:`msg_seq` across events that share buffer
+    positions (one hash per buffer per round, the <=5% overhead bar).
+    Returns ``None`` when the event is compile-time disabled."""
+    if not event_enabled(spec, ev):
+        return None
+    k = m.valid if keep is None else keep
+    k = _filter(spec, k, m.src, m.dst, m.typ)
+    s = msg_seq(spec, m) if seq is None else seq
+    M = m.cap
+    return dict(keep=k, ev=jnp.full((M,), ev, jnp.int32), src=m.src,
+                dst=m.dst, typ=m.typ, born=m.born, seq=s)
+
+
+def tap_capture(spec: TraceSpec, ev: int, node_ids: jax.Array,
+                tap: dict) -> Optional[dict]:
+    """Capture for a protocol-state event (``ProtocolBase.trace_taps``).
+    ``tap`` holds per-node per-slot columns: ``keep`` ``[n, S]`` bool
+    (or ``[n]``), and optional ``dst``/``typ``/``seq``/``born`` arrays
+    broadcastable to ``[n, S]`` (missing -> -1).  ``src`` is implied:
+    the tapping node itself (``node_ids``)."""
+    if not event_enabled(spec, ev):
+        return None
+    keep = jnp.asarray(tap["keep"])
+    if keep.ndim == 1:
+        keep = keep[:, None]
+    n, S = keep.shape
+    src = jnp.broadcast_to(node_ids.astype(jnp.int32)[:, None], (n, S))
+
+    def col(name):
+        v = tap.get(name)
+        if v is None:
+            return jnp.full((n, S), -1, jnp.int32)
+        v = jnp.asarray(v, jnp.int32)
+        if v.ndim == 1:
+            v = v[:, None]
+        return jnp.broadcast_to(v, (n, S))
+
+    dst, typ, seq, born = col("dst"), col("typ"), col("seq"), col("born")
+    flat = lambda x: x.reshape((n * S,))  # noqa: E731
+    keep, src, dst, typ, seq, born = map(
+        flat, (keep, src, dst, typ, seq, born))
+    keep = _filter(spec, keep, src, dst, typ)
+    return dict(keep=keep, ev=jnp.full((n * S,), ev, jnp.int32), src=src,
+                dst=dst, typ=typ, born=born, seq=seq)
+
+
+def trace_record(ring: TraceRing, spec: TraceSpec,
+                 caps: Sequence[Optional[dict]],
+                 rnd: jax.Array) -> TraceRing:
+    """Write one round's lifecycle events into the ring (device, inside
+    the scan / shard_map body).  All captures concatenate into one flat
+    column set and compact with ONE cumsum + searchsorted gather into
+    the ``[cap, 7]`` row — the flight recorder's O(cap log M) shape;
+    slots past ``cap`` increment ``overflow`` (never silent).  Pure
+    shard-local arithmetic, zero collectives."""
+    window, cap = ring.buf.shape[0], ring.buf.shape[1]
+    caps = [c for c in caps if c is not None]
+    rnd_col = jnp.broadcast_to(jnp.asarray(rnd, jnp.int32), (cap,))
+    if not caps:  # everything compile-time filtered: an empty row
+        row = jnp.full((cap, N_COLS), -1, jnp.int32)
+        ovf = ring.overflow
+    else:
+        cat = {k: jnp.concatenate([c[k] for c in caps])
+               for k in ("keep", "ev", "src", "dst", "typ", "born", "seq")}
+        keep = cat["keep"]
+        csum = jnp.cumsum(keep.astype(jnp.int32))     # [M] inclusive
+        total = csum[-1]
+        n_kept = jnp.minimum(total, cap)
+        slots = jnp.arange(cap, dtype=jnp.int32)
+        ok = slots < n_kept
+        gi = jnp.where(ok, jnp.searchsorted(csum, slots + 1)
+                       .astype(jnp.int32), 0)
+        cols = jnp.stack([
+            rnd_col, cat["ev"][gi], cat["src"][gi], cat["dst"][gi],
+            cat["typ"][gi], cat["born"][gi], cat["seq"][gi]], axis=1)
+        row = jnp.where(ok[:, None], cols, -1)
+        ovf = ring.overflow + (total - n_kept)
+    slot = jnp.mod(ring.cursor, window)               # wrap = keep-latest
+    buf = jax.lax.dynamic_update_slice(
+        ring.buf, row[None], (slot, jnp.int32(0), jnp.int32(0)))
+    return ring.replace(buf=buf, cursor=ring.cursor + 1, overflow=ovf)
+
+
+def trace_flush(ring: TraceRing) -> Tuple[np.ndarray, int, TraceRing]:
+    """ONE device->host transfer of the whole window; returns
+    ``(rows, overflow, reset_ring)`` exactly like :func:`.flight
+    .flight_flush` (wrap degrades to keep-latest; only counters reset)."""
+    buf = np.asarray(jax.device_get(ring.buf))
+    n = int(ring.cursor)
+    window = buf.shape[0]
+    if n > window:
+        start = n % window
+        buf = np.concatenate([buf[start:], buf[:start]])
+        n = window
+    overflow = int(np.asarray(jax.device_get(ring.overflow)).sum())
+    reset = ring.replace(cursor=jnp.int32(0),
+                         overflow=jnp.zeros_like(ring.overflow))
+    return buf[:n], overflow, reset
+
+
+# ---------------------------------------------------------------------------
+# host side: decode -> span trees -> critical path
+
+
+class SpanEvent(NamedTuple):
+    """One decoded lifecycle event (one kept ring slot)."""
+    rnd: int
+    ev: int
+    src: int
+    dst: int
+    typ: int
+    born: int
+    seq: int
+
+    @property
+    def name(self) -> str:
+        return EVENT_NAMES[self.ev]
+
+
+def trace_events(rows: np.ndarray) -> List[SpanEvent]:
+    """Decode flushed rows (``rnd == -1`` slots are padding) into the
+    flat event stream, oldest round first, slot order within a round."""
+    out: List[SpanEvent] = []
+    rows = np.asarray(rows)
+    if rows.size == 0:
+        return out
+    flat = rows.reshape((-1, N_COLS))
+    for r in flat[flat[:, 0] >= 0]:
+        out.append(SpanEvent(*(int(v) for v in r)))
+    return out
+
+
+#: span key: the trace id minus the birth round — ``(src, seq)`` joins
+#: wire events with protocol-tap events that cannot see ``Msgs.born``
+#: (e.g. qos.ack rows); ``born`` is recovered from the first wire event.
+SpanKey = Tuple[int, int]
+
+
+@dataclasses.dataclass
+class Span:
+    """Per-message lifecycle reconstructed from the event stream."""
+    src: int
+    seq: int
+    typ: int = -1
+    dst: int = -1
+    born: int = -1
+    events: List[SpanEvent] = dataclasses.field(default_factory=list)
+
+    def rounds(self, ev: int) -> List[int]:
+        return [e.rnd for e in self.events if e.ev == ev]
+
+    @property
+    def first_rnd(self) -> int:
+        return min(e.rnd for e in self.events)
+
+    @property
+    def last_rnd(self) -> int:
+        return max(e.rnd for e in self.events)
+
+    @property
+    def delivered_rnd(self) -> Optional[int]:
+        d = self.rounds(EV_DELIVERED)
+        return min(d) if d else None
+
+    @property
+    def acked_rnd(self) -> Optional[int]:
+        a = self.rounds(EV_ACKED)
+        return min(a) if a else None
+
+    @property
+    def attempts(self) -> int:
+        return 1 + len(self.rounds(EV_RETRANSMITTED))
+
+    def latency(self) -> Dict[str, int]:
+        """Decompose end-to-end rounds into segments: ``queue`` (rounds
+        spent held in the delay buffer), ``retry`` (first emission to
+        last re-emission), ``transit`` (the delivery hop itself),
+        ``partition_wait`` (the unexplained remainder — rounds the
+        message's fate was gated on reachability, e.g. a partition
+        healing or a peer's inbox draining)."""
+        born = self.born if self.born >= 0 else self.first_rnd
+        end_r = self.acked_rnd
+        if end_r is None:
+            end_r = self.delivered_rnd
+        if end_r is None:
+            end_r = self.last_rnd
+        total = max(0, end_r - born)
+        queue = len(self.rounds(EV_HELD))
+        emits = sorted(self.rounds(EV_EMITTED)
+                       + self.rounds(EV_RETRANSMITTED))
+        retry = (emits[-1] - emits[0]) if len(emits) > 1 else 0
+        transit = 1 if self.delivered_rnd is not None else 0
+        wait = max(0, total - queue - retry - transit)
+        return {"total": total, "queue": queue, "retry": retry,
+                "transit": transit, "partition_wait": wait}
+
+
+def trace_spans(events: Iterable[SpanEvent]) -> Dict[SpanKey, Span]:
+    """Fold the event stream into per-message spans keyed by
+    ``(src, seq)``.  ``typ``/``dst``/``born`` fill from the first event
+    that knows them (protocol taps record -1 for columns their state
+    row cannot see)."""
+    spans: Dict[SpanKey, Span] = {}
+    for e in events:
+        sp = spans.get((e.src, e.seq))
+        if sp is None:
+            sp = spans[(e.src, e.seq)] = Span(src=e.src, seq=e.seq)
+        sp.events.append(e)
+        if sp.typ < 0 and e.typ >= 0:
+            sp.typ = e.typ
+        if sp.dst < 0 and e.dst >= 0:
+            sp.dst = e.dst
+        if sp.born < 0 and e.born >= 0:
+            sp.born = e.born
+    return spans
+
+
+#: a delivery fact: ``(rnd, src, dst, typ, seq)`` — the unit both the
+#: tracer and the legacy wire observer can produce, so critical_path
+#: runs identically on either side of the ground-truth comparison.
+Delivery = Tuple[int, int, int, int, int]
+
+
+def deliveries(events: Iterable[SpanEvent]) -> List[Delivery]:
+    """DELIVERED events as delivery facts."""
+    return [(e.rnd, e.src, e.dst, e.typ, e.seq)
+            for e in events if e.ev == EV_DELIVERED]
+
+
+def wire_deliveries(entries) -> List[Delivery]:
+    """Legacy wire-observer recomputation: a
+    :class:`partisan_tpu.verify.trace.TraceEntry` stream (the
+    ``capture_wire`` path records each round's wire buffer — with no
+    inbox overflow that IS the delivered set) mapped onto the same
+    delivery facts.  The uint32 entry hash bitcasts to the tracer's
+    int32 ``seq`` stamp."""
+    out: List[Delivery] = []
+    for e in entries:
+        h = int(e.hash) & 0xFFFFFFFF
+        seq = h - (1 << 32) if h >= (1 << 31) else h
+        out.append((int(e.rnd), int(e.src), int(e.dst), int(e.typ), seq))
+    return out
+
+
+def critical_path(deliv: Iterable[Delivery]) -> List[Delivery]:
+    """The dependency chain that determined the convergence round: walk
+    backward from the LAST delivery (max by the full tuple — a total
+    order, so recomputations agree exactly), each step picking the
+    latest earlier delivery INTO the current link's source node (the
+    information arrival that enabled it to send).  Returns the chain
+    oldest-first."""
+    deliv = sorted(set(deliv))
+    if not deliv:
+        return []
+    by_dst: Dict[int, List[Delivery]] = {}
+    for d in deliv:
+        by_dst.setdefault(d[2], []).append(d)   # sorted order preserved
+    cur = deliv[-1]
+    path = [cur]
+    while True:
+        prior = [d for d in by_dst.get(cur[1], ()) if d[0] < cur[0]]
+        if not prior:
+            break
+        cur = prior[-1]                          # max (rnd, src, dst, ...)
+        path.append(cur)
+    return path[::-1]
+
+
+# ---------------------------------------------------------------------------
+# persistence (scripts/trace_report.py): one JSON object per event
+
+
+def write_spans(path: str, events: Iterable[SpanEvent]) -> int:
+    n = 0
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps({"rnd": e.rnd, "ev": e.name, "src": e.src,
+                                "dst": e.dst, "typ": e.typ, "born": e.born,
+                                "seq": e.seq}) + "\n")
+            n += 1
+    return n
+
+
+def read_spans(path: str) -> List[SpanEvent]:
+    out: List[SpanEvent] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            out.append(SpanEvent(int(d["rnd"]), EVENT_CODES[d["ev"]],
+                                 int(d["src"]), int(d["dst"]),
+                                 int(d["typ"]), int(d["born"]),
+                                 int(d["seq"])))
+    return out

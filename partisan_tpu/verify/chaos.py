@@ -353,7 +353,8 @@ def _match(m: Msgs, src: int, dst: int) -> jax.Array:
     return hit
 
 
-def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
+def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
+                     want_masks: bool = False):
     """Apply drop / delay / duplicate events to the READY buffer (post
     held-split, pre fault-plane — the point where both execution paths
     still hold every message on its src's shard).  Returns
@@ -369,6 +370,13 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
         "chaos_duplicated"}`` int32 scalars over THIS buffer (the
         sharded step psums them; the totals match the unsharded run).
 
+    ``want_masks=True`` (the lifecycle tracer's tap, ISSUE 16) appends a
+    fourth element: ``{"dropped", "delayed"}`` — [cap] bool masks
+    positionally ALIGNED to the INPUT buffer (every plane here edits
+    ``valid`` in place, never moves slots), where ``delayed`` covers
+    re-holds and duplicate copies.  Python-level gating: the default
+    call builds the exact pre-existing program.
+
     Order inside the plane: drops first, then delays on the survivors,
     then duplication of the remaining ready slots — one deterministic
     pipeline, identical on both paths.
@@ -377,8 +385,12 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
     counts = {"chaos_dropped": zero, "chaos_delayed": zero,
               "chaos_duplicated": zero}
     if not sched.has_msg_events:
+        if want_masks:
+            z = jnp.zeros((now.cap,), bool)
+            return now, None, counts, {"dropped": z, "delayed": z}
         return now, None, counts
 
+    drop = None
     if sched.has_drop:
         drop = jnp.zeros((now.cap,), bool)
         for ev_rnd, kind, a, b, c in sched._kinds((KIND_DROP,
@@ -395,6 +407,7 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
         now = now.replace(valid=now.valid & ~drop)
 
     parts = []
+    re_held = copy = None
     if sched.has_delay:
         bump = jnp.zeros((now.cap,), jnp.int32)
         for ev_rnd, _k, a, b, c in sched._kinds((KIND_DELAY,)):
@@ -422,9 +435,19 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs):
         counts["chaos_duplicated"] = jnp.sum(copy.valid).astype(jnp.int32)
         parts.append(copy)
 
-    if not parts:
-        return now, None, counts
-    extra_held = msgops.concat(*parts) if len(parts) > 1 else parts[0]
+    extra_held = None
+    if parts:
+        extra_held = msgops.concat(*parts) if len(parts) > 1 else parts[0]
+    if want_masks:
+        z = jnp.zeros((now.cap,), bool)
+        delayed = z
+        if re_held is not None:
+            delayed = delayed | re_held.valid
+        if copy is not None:
+            delayed = delayed | copy.valid
+        masks = {"dropped": drop if drop is not None else z,
+                 "delayed": delayed}
+        return now, extra_held, counts, masks
     return now, extra_held, counts
 
 

@@ -186,6 +186,36 @@ def _dense_hv_control():
     return step, (st, spec.init_plane())
 
 
+def _engine_step_tracer():
+    import partisan_tpu as pt
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.telemetry import tracer
+    cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    spec = tracer.TraceSpec(window=16, cap=256)
+    world = pt.init_world(cfg, proto)
+    tring = tracer.make_trace_ring(spec)
+    return pt.make_step(cfg, proto, donate=False, trace=spec), (world, tring)
+
+
+def _sharded_dataplane_tracer():
+    import partisan_tpu as pt
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                 make_sharded_step)
+    from partisan_tpu.parallel.mesh import make_mesh
+    from partisan_tpu.telemetry import tracer
+    cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    spec = tracer.TraceSpec(window=16, cap=256)
+    mesh = make_mesh(n_devices=8)
+    world = init_sharded_world(cfg, proto, mesh)
+    tring = tracer.place_trace_ring(
+        tracer.make_trace_ring(spec, n_shards=8), mesh)
+    return (make_sharded_step(cfg, proto, mesh, donate=False, trace=spec),
+            (world, tring))
+
+
 def _explorer_checker_b1():
     import partisan_tpu as pt
     from partisan_tpu.verify.chaos import ChaosSchedule
@@ -210,6 +240,8 @@ FLAGSHIP: Dict[str, Callable[[], Tuple[Callable, tuple]]] = {
     "dense_plumtree_n256x8": lambda: _dense("plumtree"),
     "dense_hyparview_control_n256x8": _dense_hv_control,
     "explorer_checker_hyparview_b1": _explorer_checker_b1,
+    "engine_step_tracer_n64": _engine_step_tracer,
+    "sharded_dataplane_tracer_n64x8": _sharded_dataplane_tracer,
 }
 
 

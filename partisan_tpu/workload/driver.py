@@ -275,6 +275,43 @@ class WorkloadRpc(Rpc):
         of cumulative per-node counters."""
         return dict(self.health_counters(state))
 
+    def trace_taps(self, cfg, pre, mid, post, rnd):
+        """Lifecycle-tracer taps (ISSUE 16) over the promise-ring
+        diffs.  Pair with ``TraceSpec(seq_field="ref")`` so request
+        wire spans and these client-side transitions share the
+        ``(src, ref)`` trace id.
+
+        * ``acked`` — the promise completed this round (a reply flipped
+          ``prom_done`` in the deliver phase);
+        * ``retransmitted`` — tick re-armed the slot (same ref, bumped
+          attempt);
+        * ``dead_lettered`` — tick abandoned the slot (freed outright,
+          or reused under a NEW ref by the issue unroll in the same
+          tick — refs are monotone, so a ref change marks the old
+          promise dead);
+        * ``shed`` — admission control refused this many arrivals
+          (``wl_shed`` delta), a count event with no peer identity."""
+        req = self.typ("rpc_req")
+        acked = mid.prom_done & ~pre.prom_done
+        retrans = (mid.prom_valid & post.prom_valid
+                   & (post.prom_ref == mid.prom_ref)
+                   & (post.prom_attempt > mid.prom_attempt))
+        dead = mid.prom_valid & (~post.prom_valid
+                                 | (post.prom_ref != mid.prom_ref))
+        shed_n = (post.wl_shed - mid.wl_shed)[:, None]
+        shed_keep = jnp.arange(self.A, dtype=jnp.int32)[None, :] < shed_n
+        return (
+            ("acked", dict(keep=acked, dst=pre.prom_dst, typ=req,
+                           seq=pre.prom_ref, born=pre.prom_birth)),
+            ("retransmitted", dict(keep=retrans, dst=post.prom_dst,
+                                   typ=req, seq=post.prom_ref,
+                                   born=post.prom_birth)),
+            ("dead_lettered", dict(keep=dead, dst=mid.prom_dst, typ=req,
+                                   seq=mid.prom_ref,
+                                   born=mid.prom_birth)),
+            ("shed", dict(keep=shed_keep, typ=req, born=rnd)),
+        )
+
     # ------------------------------------------------------ host helpers
 
     def set_rate(self, state: WlRow, rate_milli: int) -> WlRow:

@@ -28,6 +28,14 @@ compiles a byte-identical program.  The streaming program embeds a host
 callback, so it is never persistently cacheable — this arm recompiles
 every bench run (compile time stays outside the timed windows).
 
+ISSUE 16 adds the tracer column: a fifth arm co-carries the message
+lifecycle span ring (``--trace-cap`` event slots/round, head-capped +
+counted) through the same scans and reports ``tracer_overhead_pct``
+against the FLIGHT arm (the <= 5% span-plane bar: both arms carry one
+recorder ring, so the delta prices the per-event id arithmetic + the
+lifecycle captures, not the ring itself).  The tracer-OFF bar is
+structural once more: ``trace=None`` compiles a byte-identical program.
+
 Run:  JAX_PLATFORMS=cpu python scripts/bench_telemetry.py [--n 4096]
 """
 
@@ -55,6 +63,9 @@ def main() -> None:
     ap.add_argument("--flight-cap", type=int, default=4096,
                     help="flight-recorder slots per round (head-capped "
                          "+ counted beyond)")
+    ap.add_argument("--trace-cap", type=int, default=4096,
+                    help="lifecycle-tracer event slots per round "
+                         "(head-capped + counted beyond)")
     args = ap.parse_args()
     n, window = args.n, args.window
 
@@ -141,6 +152,35 @@ def main() -> None:
         wf, fring2, fring, dt = flight_run(wf, fring2, fring, timed=True)
         flight_secs.append(dt)
 
+    # -- tracer arm (ISSUE 16): telemetry + the message lifecycle span
+    #    ring co-carried through the same windowed scan; one extra
+    #    [window, cap, 7] transfer per window (timed), head-cap counted
+    tspec = telemetry.TraceSpec(window=window, cap=args.trace_cap)
+    trace_window = telemetry.make_window_runner(
+        cfg, proto, registry, window, trace=tspec)
+    tring = telemetry.make_trace_ring(tspec)
+    trace_events_total = 0
+    trace_overflow_total = 0
+
+    def trace_run(world, ring, tring, timed):
+        nonlocal trace_events_total, trace_overflow_total
+        t0 = time.perf_counter()
+        world, ring, _fr, tring, _a = trace_window(
+            world, ring, None, tring, None)
+        _rows, ring = telemetry.flush(ring, registry)
+        trows, tovf, tring = telemetry.trace_flush(tring)
+        dt = time.perf_counter() - t0
+        trace_events_total += int((trows[..., 0] >= 0).sum())
+        trace_overflow_total += tovf
+        return world, ring, tring, (dt if timed else None)
+
+    tring2 = telemetry.make_ring(registry, window)
+    wtr, tring2, tring, _ = trace_run(world0, tring2, tring, timed=False)
+    trace_secs = []
+    for _ in range(args.windows):
+        wtr, tring2, tring, dt = trace_run(wtr, tring2, tring, timed=True)
+        trace_secs.append(dt)
+
     # -- streaming arm (ISSUE 14): the same windowed scan with every
     #    round's packed row drained to the host mid-scan; the barrier
     #    before the clock stops makes the host-side drain part of the
@@ -181,9 +221,11 @@ def main() -> None:
     telem_rps = window / statistics.median(telem_secs)
     flight_rps = window / statistics.median(flight_secs)
     stream_rps = window / statistics.median(stream_secs)
+    tracer_rps = window / statistics.median(trace_secs)
     overhead = (plain_rps - telem_rps) / plain_rps * 100.0
     flight_overhead = (telem_rps - flight_rps) / telem_rps * 100.0
     stream_overhead = (telem_rps - stream_rps) / telem_rps * 100.0
+    tracer_overhead = (flight_rps - tracer_rps) / flight_rps * 100.0
     summary = {
         "metric": f"telemetry overhead @ HyParView N={n}, window={window}",
         "n": n, "window": window, "timed_windows": args.windows,
@@ -195,6 +237,11 @@ def main() -> None:
         "flight_cap": args.flight_cap,
         "flight_entries": flight_entries_total,
         "flight_overflow": flight_overflow_total,
+        "tracer_rounds_per_sec": round(tracer_rps, 2),
+        "tracer_overhead_pct": round(tracer_overhead, 2),
+        "trace_cap": args.trace_cap,
+        "trace_events": trace_events_total,
+        "trace_overflow": trace_overflow_total,
         "stream_rounds_per_sec": round(stream_rps, 2),
         "stream_overhead_pct": round(stream_overhead, 2),
         "stream_rows": stream.rows_streamed,

@@ -17,8 +17,14 @@ stderr with ``--pretty``:
     all_to_all load picture — off-diagonal mass is cross-chip traffic,
     the diagonal stays on-device.
 
+``--message SRC,SEQ`` reports ONE message instead of the summary: every
+wire hop carrying that trace id (``seq`` is the tracer's int32 stamp —
+the signed bitcast of the entry hash, the convention
+``telemetry.tracer.wire_deliveries`` pins), oldest first.
+
 Run:  python scripts/flight_report.py TRACE.jsonl [--shards 8]
           [--nodes N] [--top 10] [--typ-names a,b,c] [--pretty]
+          [--message 3,-123456789]
 """
 
 import argparse
@@ -72,6 +78,30 @@ def summarize(entries, n_shards=1, n_nodes=None, top=10, typ_names=None):
     }
 
 
+def signed_seq(h):
+    """Entry hash (uint32) -> the tracer's int32 seq stamp (value-
+    preserving bitcast — telemetry.tracer.wire_deliveries)."""
+    h = int(h) & 0xFFFFFFFF
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def message_report(entries, src, seq, typ_names=None):
+    """Every wire hop carrying trace id (src, seq), oldest first."""
+    def typ_label(t):
+        if typ_names is not None and 0 <= t < len(typ_names):
+            return typ_names[t]
+        return t
+    hops = sorted((e for e in entries
+                   if e.src == src and signed_seq(e.hash) == seq),
+                  key=lambda e: (e.rnd, e.dst))
+    return {
+        "src": src, "seq": seq, "found": bool(hops), "hops": len(hops),
+        "round_span": [hops[0].rnd, hops[-1].rnd] if hops else [],
+        "path": [{"rnd": e.rnd, "dst": e.dst, "typ": typ_label(e.typ),
+                  "channel": e.channel} for e in hops],
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("trace", help="wire-trace JSONL (write_trace format)")
@@ -83,10 +113,19 @@ def main():
                     help="comma-separated wire-tag names")
     ap.add_argument("--pretty", action="store_true",
                     help="human-readable table on stderr")
+    ap.add_argument("--message", default=None, metavar="SRC,SEQ",
+                    help="report one message's wire hops (tracer id)")
     args = ap.parse_args()
 
     entries = read_trace(args.trace)
     typ_names = args.typ_names.split(",") if args.typ_names else None
+    if args.message is not None:
+        src, seq = (int(x) for x in args.message.split(","))
+        m = message_report(entries, src, seq, typ_names=typ_names)
+        print(json.dumps(m))
+        if not m["found"]:
+            sys.exit(1)
+        return
     s = summarize(entries, n_shards=args.shards, n_nodes=args.nodes,
                   top=args.top, typ_names=typ_names)
     print(json.dumps(s))

@@ -1526,6 +1526,82 @@ def compile_ledger_gate_test():
         obs.restore_cache(prev)
 
 
+def span_parity_test():
+    """ISSUE 16 tentpole contract: the message lifecycle tracer records
+    the SAME span-event multiset (EXCHANGED excluded — it only exists
+    where an exchange exists) through the unsharded engine and the
+    8-device shard_map dataplane, with zero overflow on both sides, and
+    ``trace=None`` lowers the byte-identical program on both paths."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel import make_mesh
+    from partisan_tpu.parallel.dataplane import (
+        make_sharded_step, place_sharded_world, sharded_out_cap)
+    from partisan_tpu.telemetry import tracer as tr
+    n, rounds = 16, 12
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = _manager("hyparview", cfg)
+    mesh = make_mesh(n_devices=8)
+    out_cap = sharded_out_cap(cfg, proto, 8)
+    spec = tr.TraceSpec(window=rounds, cap=4 * out_cap)
+    pairs = [(i, i - 1) for i in range(1, n)]
+    w = ps.cluster(pt.init_world(cfg, proto, out_cap=out_cap), proto,
+                   pairs)
+
+    def drain(step, world, ring):
+        for _ in range(rounds):
+            world, ring, _m = step(world, ring)
+        rows, overflow, _ = tr.trace_flush(ring)
+        return tr.trace_events(rows), overflow
+
+    ustep = pt.make_step(cfg, proto, donate=False, trace=spec)
+    uevents, uov = drain(ustep, w, tr.make_trace_ring(spec))
+    sstep = make_sharded_step(cfg, proto, mesh, donate=False,
+                              trace=spec)
+    sevents, sov = drain(
+        sstep, place_sharded_world(w, cfg, mesh),
+        tr.place_trace_ring(tr.make_trace_ring(spec, 8), mesh))
+    assert uov == 0 and sov == 0
+    key = lambda e: (e.rnd, e.ev, e.src, e.dst, e.typ, e.born, e.seq)
+    um = sorted(key(e) for e in uevents if e.ev != tr.EV_EXCHANGED)
+    sm = sorted(key(e) for e in sevents if e.ev != tr.EV_EXCHANGED)
+    assert um == sm and um
+    assert any(e.ev == tr.EV_EXCHANGED for e in sevents)
+    # off-path: trace=None is byte-identical on both dataplanes
+    base = pt.make_step(cfg, proto, donate=False)
+    off = pt.make_step(cfg, proto, donate=False, trace=None)
+    assert base.lower(w).as_text() == off.lower(w).as_text()
+
+
+def alert_smoke():
+    """ISSUE 16: the in-scan alert plane — a standing partition drives
+    the partition-suspicion detector over its ``for:`` window, the
+    firing transition reaches the host event bus through the runner,
+    and the alert gauge round-trips through PrometheusSink text
+    exposition."""
+    from partisan_tpu import telemetry
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.telemetry import alerts as al
+    from partisan_tpu.verify import health as vh
+    n = 16
+    cfg = pt.Config(n_nodes=n, inbox_cap=16)
+    proto = _manager("hyparview", cfg)
+    world = ps.cluster(pt.init_world(cfg, proto), proto,
+                       [(i, (i + 1) % n) for i in range(n)])
+    world = world.replace(partition=jnp.where(
+        jnp.arange(n) < n // 2, 1, 2).astype(jnp.int32))
+    reg = vh.health_registry()
+    firer = al.AlertFirer()
+    sink = telemetry.PrometheusSink(al.alert_registry(reg))
+    telemetry.run_with_telemetry(
+        cfg, proto, 16, window=8, registry=reg, world=world,
+        sinks=[sink], alerts=al.AlertSpec(partition_rounds=3),
+        alert_firer=firer)
+    assert "partition_suspected" in firer.firing()
+    parsed = telemetry.parse_exposition(sink.expose())
+    assert parsed["partisan_alert_partition"]["samples"][""] == 1.0
+    assert 'alertname="partition_suspected"' in al.alerts_exposition(firer)
+
+
 def build_matrix():
     """(group, test, manager, path, fn_or_skipreason) rows mirroring
     all/0 + groups/0 of test/partisan_SUITE.erl:121-308.
@@ -1747,6 +1823,15 @@ def build_matrix():
         "engine", stream_parity_test)
     add("observability/observatory", "compile_ledger_gate", "hyparview",
         "engine", compile_ledger_gate_test)
+
+    # ISSUE 16: the message lifecycle tracer — sharded/unsharded span
+    # multiset parity (+ the trace=None byte-identity) and the in-scan
+    # alert plane's Prometheus round-trip (span CLI:
+    # scripts/trace_report.py)
+    add("observability/tracer", "span_parity_test", "hyparview",
+        "engine", span_parity_test)
+    add("observability/tracer", "alert_smoke", "hyparview", "engine",
+        alert_smoke)
 
     return M
 

@@ -1,0 +1,141 @@
+"""Message-lifecycle trace summarizer: span stats, latency breakdown,
+critical path, per-message drill-down, Perfetto export.
+
+Consumes a span-event stream in the JSONL format
+``telemetry.tracer.write_spans`` persists (one ``{"rnd", "ev", "src",
+"dst", "typ", "born", "seq"}`` object per line, ``ev`` as the lifecycle
+event NAME) and prints ONE JSON summary line:
+
+  * ``events`` / ``spans`` — stream size and distinct (src, seq) spans;
+  * ``per_event`` — event count by lifecycle stage (emitted, held,
+    delivered, acked, retransmitted, dead_lettered, shed, chaos_*);
+  * ``latency`` — the span latency decomposition aggregated over
+    completed spans: mean/max total plus mean queue / retry / transit /
+    partition_wait rounds (where the rounds went, not just how many);
+  * ``critical_path`` — the delivery dependency chain that determined
+    the last delivery (oldest first).
+
+Modes:
+  * ``--message SRC,SEQ`` reports ONE span instead: its full event
+    timeline, attempts, and latency decomposition;
+  * ``--perfetto OUT.json`` additionally writes the Chrome-trace view
+    (message-span slices + lifecycle instants) for ui.perfetto.dev.
+
+Run:  python scripts/trace_report.py SPANS.jsonl [--top 10]
+          [--typ-names a,b,c] [--message 3,42] [--perfetto out.json]
+          [--pretty]
+"""
+
+import argparse
+import collections
+import json
+import sys
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from partisan_tpu.telemetry import tracer  # noqa: E402
+
+
+def span_row(sp, typ_names=None):
+    """One span as a JSON-ready dict (the --message drill-down body)."""
+    def typ_label(t):
+        if typ_names is not None and 0 <= t < len(typ_names):
+            return typ_names[t]
+        return t
+    return {
+        "src": sp.src, "seq": sp.seq, "typ": typ_label(sp.typ),
+        "dst": sp.dst, "born": sp.born, "attempts": sp.attempts,
+        "delivered_rnd": sp.delivered_rnd, "acked_rnd": sp.acked_rnd,
+        "latency": sp.latency(),
+        "timeline": [{"rnd": e.rnd, "ev": e.name, "dst": e.dst}
+                     for e in sorted(sp.events,
+                                     key=lambda e: (e.rnd, e.ev))],
+    }
+
+
+def summarize(events, top=10, typ_names=None):
+    spans = tracer.trace_spans(events)
+    per_event = collections.Counter(e.name for e in events)
+    done = [sp for sp in spans.values()
+            if sp.delivered_rnd is not None or sp.acked_rnd is not None]
+    lats = [sp.latency() for sp in done]
+
+    def mean(key):
+        return (round(sum(l[key] for l in lats) / len(lats), 2)
+                if lats else 0.0)
+
+    slow = sorted(done, key=lambda sp: -sp.latency()["total"])[:top]
+    path = tracer.critical_path(tracer.deliveries(events))
+    return {
+        "events": len(events),
+        "spans": len(spans),
+        "completed": len(done),
+        "per_event": dict(sorted(per_event.items())),
+        "latency": {
+            "mean_total": mean("total"),
+            "max_total": max((l["total"] for l in lats), default=0),
+            "mean_queue": mean("queue"),
+            "mean_retry": mean("retry"),
+            "mean_transit": mean("transit"),
+            "mean_partition_wait": mean("partition_wait"),
+        },
+        "slowest": [{"src": sp.src, "seq": sp.seq,
+                     "total": sp.latency()["total"]} for sp in slow],
+        "critical_path": [list(d) for d in path],
+        "critical_path_len": len(path),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("spans", help="span-event JSONL (write_spans format)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--typ-names", default=None,
+                    help="comma-separated wire-tag names")
+    ap.add_argument("--message", default=None, metavar="SRC,SEQ",
+                    help="drill into one span (trace-id src,seq)")
+    ap.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="also write the Chrome-trace span view")
+    ap.add_argument("--pretty", action="store_true",
+                    help="human-readable table on stderr")
+    args = ap.parse_args()
+
+    events = tracer.read_spans(args.spans)
+    typ_names = args.typ_names.split(",") if args.typ_names else None
+
+    if args.perfetto:
+        from partisan_tpu.telemetry import perfetto
+        perfetto.write_chrome_trace(
+            args.perfetto, spans=tracer.trace_spans(events).values(),
+            typ_names=typ_names)
+
+    if args.message is not None:
+        src, seq = (int(x) for x in args.message.split(","))
+        sp = tracer.trace_spans(events).get((src, seq))
+        if sp is None:
+            print(json.dumps({"src": src, "seq": seq, "found": False}))
+            sys.exit(1)
+        print(json.dumps({"found": True, **span_row(sp, typ_names)}))
+        return
+
+    s = summarize(events, top=args.top, typ_names=typ_names)
+    print(json.dumps(s))
+
+    if args.pretty:
+        p = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
+        p(f"{s['events']} events, {s['spans']} spans "
+          f"({s['completed']} completed)")
+        p("per event: " + ", ".join(f"{k}={v}"
+                                    for k, v in s["per_event"].items()))
+        lat = s["latency"]
+        p(f"latency: mean {lat['mean_total']} rounds "
+          f"(queue {lat['mean_queue']}, retry {lat['mean_retry']}, "
+          f"transit {lat['mean_transit']}, partition_wait "
+          f"{lat['mean_partition_wait']}), max {lat['max_total']}")
+        p(f"critical path ({s['critical_path_len']} links):")
+        for rnd, src, dst, typ, seq in s["critical_path"]:
+            p(f"  r{rnd:4d}  {src} -> {dst}  typ={typ} seq={seq}")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,8 @@ ENTRY_TIERS = {
     "dense_plumtree_n256x8": 3,
     "engine_step_control_n16": 4,          # tier 4: test_control.py
     "dense_hyparview_control_n256x8": 4,
+    "engine_step_tracer_n64": 7,           # tier 7: test_tracer.py
+    "sharded_dataplane_tracer_n64x8": 7,
 }
 
 

@@ -60,12 +60,16 @@ _RUN_LAST_3 = ("tests/test_dense_dataplane.py",)
 _RUN_LAST_4 = ("tests/test_control.py",)
 # tier 5: the ISSUE-11 trace-lint / fingerprint gate
 _RUN_LAST_5 = ("tests/test_trace_lint.py",)
-# tier 6: the ISSUE-14 compile observatory is the newest of all
+# tier 6: the ISSUE-14 compile observatory
 _RUN_LAST_6 = ("tests/test_observatory.py",)
+# tier 7: the ISSUE-16 message lifecycle tracer is the newest of all
+_RUN_LAST_7 = ("tests/test_tracer.py",)
 
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_7):
+            return 7
         if any(k in it.nodeid for k in _RUN_LAST_6):
             return 6
         if any(k in it.nodeid for k in _RUN_LAST_5):

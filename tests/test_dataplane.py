@@ -239,98 +239,73 @@ class TestTransitiveRelay:
 class TestChaosFaultParity:
     """ISSUE 4: the fault-PARITY extension of TestShardMapDataplane
     (tests/test_mesh.py), which covers only the fault-free case — the
-    same compiled ChaosSchedule (crash + partition + heal + recover
-    mid-run, plus message-level drop/delay/duplicate events) through
-    the sharded dataplane must bit-match the unsharded chaos run."""
+    same compiled ChaosSchedule (crash + drop/delay/duplicate + heal +
+    recover) through the sharded dataplane must preserve the program
+    properties the unsharded bit-match depends on.  Since ISSUE 16 both
+    tests are lowered-text twins (no execute): the 60-round executed
+    bit-match ran unchanged from PR 4 through PR 15."""
+
+    @staticmethod
+    def _sched():
+        from partisan_tpu.verify.chaos import ChaosSchedule
+        return (ChaosSchedule().crash(2, (1, 2)).drop(3, dst=1)
+                .delay(4, src=0, extra=1).duplicate(5).heal(8)
+                .recover(9, (1, 2)))
 
     def test_sharded_chaos_run_bit_matches_unsharded(self):
-        """60-round HyParView on the 8-device mesh under one schedule:
-        every per-round metric (incl. the chaos counters), every state
-        leaf AND both fault planes are bit-identical across paths, and
-        the overlay re-knits after the heal."""
-        from partisan_tpu.ops import graph
-        from partisan_tpu.parallel import make_mesh
-        from partisan_tpu.parallel.dataplane import (
-            make_sharded_step, place_sharded_world, sharded_out_cap)
-        from partisan_tpu.verify.chaos import ChaosSchedule
-        n, rounds = 128, 60
-        sched = (ChaosSchedule()
-                 .crash(12, (3, 10))
-                 .partition(16, (0, 63), 1)
-                 .partition(16, (64, 127), 2)
-                 .drop(18, dst=20, rounds=4)  # a live node's inbox dark
-                 .delay(20, extra=2)        # wildcard: all ready traffic
-                 .duplicate(22, copy_delay=1)
-                 .heal(30)
-                 .recover(32, (3, 10)))
-        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
-        proto = HyParView(cfg)
-        mesh = make_mesh(n_devices=8)
-        # binary-tree contacts: the overlay is knitted well before the
-        # first chaos event fires (a trickled chain bootstrap would
-        # still be joining at round 12)
-        pairs = [(i, (i - 1) // 2) for i in range(1, n)]
-        w = ps.cluster(pt.init_world(cfg, proto), proto, pairs)
-        step = pt.make_step(cfg, proto, donate=False, chaos=sched)
-        w2 = ps.cluster(
-            pt.init_world(cfg, proto,
-                          out_cap=sharded_out_cap(cfg, proto, 8)),
-            proto, pairs)
-        w2 = place_sharded_world(w2, cfg, mesh)
-        sstep = make_sharded_step(cfg, proto, mesh, donate=False,
-                                  chaos=sched)
-        chaos_totals = {"chaos_dropped": 0, "chaos_delayed": 0,
-                        "chaos_duplicated": 0}
-        fault_total = 0
-        for r in range(rounds):
-            w, mp = step(w)
-            w2, msh = sstep(w2)
-            assert all(int(msh[k]) == int(v) for k, v in mp.items()), \
-                (r, {k: int(v) for k, v in mp.items()},
-                 {k: int(v) for k, v in msh.items()})
-            assert int(msh["xshard_dropped"]) == 0
-            for k in chaos_totals:
-                chaos_totals[k] += int(mp[k])
-            fault_total += int(mp["fault_dropped"])
-        # the schedule actually exercised every message-event kind and
-        # the fault plane ate cross-partition traffic
-        assert all(v > 0 for v in chaos_totals.values()), chaos_totals
-        assert fault_total > 0
-        for lp, lsh in zip(
-                jax.tree_util.tree_leaves((w.state, w.alive,
-                                           w.partition)),
-                jax.tree_util.tree_leaves((w2.state, w2.alive,
-                                           w2.partition))):
-            np.testing.assert_array_equal(np.asarray(lp),
-                                          np.asarray(lsh))
-        # post-heal the sharded overlay is whole again
-        assert bool(np.asarray(w2.alive).all())
-        adj = graph.adjacency_from_views(w2.state.active, n)
-        from partisan_tpu.ops.graph import is_connected
-        assert bool(is_connected(adj)), "overlay did not re-knit"
-
-    def test_chaos_on_budget_unchanged(self):
-        """The asserted 2-collective budget (one all_to_all + one psum,
-        zero all-gathers) holds with the chaos plane compiled in."""
+        """Lowered-text twin of the executed 60-round chaos bit-match
+        (tier-1 velocity, ISSUE 16 — this was the suite's slowest test
+        at 97 s; the fault-free executed sharded-vs-unsharded parity
+        stays in tests/test_mesh.py).  The bit-match held because the
+        chaos plane is shard-local, and THAT is a program property:
+        compiling the schedule in must leave the collective multiset of
+        the sharded program unchanged (no new cross-shard traffic), and
+        the chaos program must lower byte-identically across
+        independent builds (the schedule bakes in deterministically, so
+        two paths fed the same bits compute the same bits)."""
+        import collections
         from partisan_tpu.parallel import make_mesh
         from partisan_tpu.parallel.dataplane import (init_sharded_world,
                                                      make_sharded_step)
-        from partisan_tpu.parallel.mesh import assert_collective_budget
-        from partisan_tpu.verify.chaos import ChaosSchedule
-        sched = (ChaosSchedule().crash(2, (1, 2)).drop(3, dst=1)
-                 .delay(4, src=0, extra=1).duplicate(5).heal(8)
-                 .recover(9, (1, 2)))
+        from partisan_tpu.verify.lint.fingerprint import _COLLECTIVE_RE
         cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5)
         proto = HyParView(cfg)
         mesh = make_mesh(n_devices=8)
         w = init_sharded_world(cfg, proto, mesh)
-        comp = make_sharded_step(cfg, proto, mesh, donate=False,
-                                 chaos=sched).lower(w).compile()
-        st = assert_collective_budget(comp, max_collectives=2,
-                                      max_bytes=32 * 1024 * 1024,
-                                      forbid=("all-gather",))
-        assert st["counts"]["all-to-all"] == 1
-        assert st["counts"]["all-reduce"] == 1
+        base = make_sharded_step(cfg, proto, mesh,
+                                 donate=False).lower(w).as_text()
+        ctext = make_sharded_step(cfg, proto, mesh, donate=False,
+                                  chaos=self._sched()).lower(w).as_text()
+        ctext2 = make_sharded_step(cfg, proto, mesh, donate=False,
+                                   chaos=self._sched()).lower(w).as_text()
+        assert ctext == ctext2, "chaos lowering is not deterministic"
+        assert ctext != base  # the plane IS compiled in
+
+        def collectives(text):
+            return collections.Counter(
+                m.group(1) for m in _COLLECTIVE_RE.finditer(text))
+
+        assert collectives(ctext) == collectives(base)
+
+    def test_chaos_on_budget_unchanged(self):
+        """The asserted 2-collective budget (one all_to_all + one psum,
+        zero all-gathers) holds with the chaos plane compiled in —
+        counted on the lowered StableHLO with the fingerprint gate's
+        regex, no compile (tier-1 velocity, ISSUE 16)."""
+        import collections
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                     make_sharded_step)
+        from partisan_tpu.verify.lint.fingerprint import _COLLECTIVE_RE
+        cfg = pt.Config(n_nodes=64, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        w = init_sharded_world(cfg, proto, mesh)
+        text = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 chaos=self._sched()).lower(w).as_text()
+        counts = collections.Counter(
+            m.group(1) for m in _COLLECTIVE_RE.finditer(text))
+        assert counts == {"all_to_all": 1, "all_reduce": 1}, counts
 
 
 @needs_mesh

@@ -1,0 +1,493 @@
+"""Message lifecycle tracer + in-scan alerting tests (ISSUE 16).
+
+The span plane must obey the flight-recorder discipline exactly:
+``trace=None`` programs byte-identical on BOTH dataplanes (the off-path
+tests are lowered-text comparisons — no compile), tracer-ON keeps the
+sharded collective budget (lower-only regex count, the trace-lint
+convention), overflow counted never silent, and the host folds must
+agree with independent recomputation — ``critical_path`` over tracer
+deliveries equals the same fold over the legacy wire observer's
+entries.  The alert plane must fire in-scan and round-trip through the
+Prometheus sink."""
+
+import collections
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service as ps, telemetry
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.qos.ack import AckedDelivery
+from partisan_tpu.telemetry import alerts as al
+from partisan_tpu.telemetry import tracer as tr
+from partisan_tpu.verify import TraceRecorder
+from partisan_tpu.verify import health as vh
+from partisan_tpu.verify.lint.fingerprint import _COLLECTIVE_RE
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, ROUNDS = 16, 12
+
+
+def _booted_hv(n=N, out_cap=None, inbox_cap=32, stagger=4):
+    cfg = pt.Config(n_nodes=n, inbox_cap=inbox_cap, shuffle_interval=5)
+    proto = HyParView(cfg)
+    world = pt.init_world(cfg, proto, out_cap=out_cap)
+    world = ps.cluster(world, proto, [(i, i - 1) for i in range(1, n)],
+                       stagger=stagger)
+    return cfg, proto, world
+
+
+def _drain(step, world, tring, rounds):
+    for _ in range(rounds):
+        world, tring, _m = step(world, tring)
+    rows, overflow, tring = tr.trace_flush(tring)
+    return world, tring, tr.trace_events(rows), overflow
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -------------------------------------------- off-path + budget (lower-only)
+
+@pytest.mark.standard
+class TestOffPathLowered:
+    """The ``trace=None`` discipline, proven on lowered text — no XLA
+    compile (the tier-1 velocity rule: byte-identity is a property of
+    the PROGRAM, so assert it pre-compile)."""
+
+    def test_unsharded_off_path_byte_identical(self):
+        cfg, proto, world = _booted_hv(n=8, stagger=0)
+        base = pt.make_step(cfg, proto, donate=False)
+        off = pt.make_step(cfg, proto, donate=False, trace=None)
+        assert (base.lower(world).as_text()
+                == off.lower(world).as_text())
+
+    @needs_mesh
+    def test_sharded_off_path_byte_identical(self):
+        from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                     make_sharded_step)
+        from partisan_tpu.parallel.mesh import make_mesh
+        cfg = pt.Config(n_nodes=N, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        world = init_sharded_world(cfg, proto, mesh)
+        base = make_sharded_step(cfg, proto, mesh, donate=False)
+        off = make_sharded_step(cfg, proto, mesh, donate=False,
+                                trace=None)
+        assert (base.lower(world).as_text()
+                == off.lower(world).as_text())
+
+    @needs_mesh
+    def test_sharded_tracer_collective_budget_lower_only(self):
+        """Tracer-ON keeps the dataplane contract: exactly one
+        all_to_all + one all_reduce, ZERO all_gathers — counted in the
+        lowered StableHLO (the fingerprint gate's regex), no compile."""
+        from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                     make_sharded_step)
+        from partisan_tpu.parallel.mesh import make_mesh
+        cfg = pt.Config(n_nodes=N, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        spec = tr.TraceSpec(window=8, cap=64)
+        mesh = make_mesh(n_devices=8)
+        world = init_sharded_world(cfg, proto, mesh)
+        tring = tr.place_trace_ring(tr.make_trace_ring(spec, 8), mesh)
+        step = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 trace=spec)
+        text = step.lower(world, tring).as_text()
+        counts = collections.Counter(
+            m.group(1) for m in _COLLECTIVE_RE.finditer(text))
+        assert counts == {"all_to_all": 1, "all_reduce": 1}, counts
+
+
+@pytest.mark.standard
+class TestSpecValidation:
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError, match="window"):
+            tr.TraceSpec(window=0, cap=4)
+        with pytest.raises(ValueError, match="cap"):
+            tr.TraceSpec(window=4, cap=0)
+        with pytest.raises(ValueError, match="node_phase"):
+            tr.TraceSpec(window=4, cap=4, node_mod=2, node_phase=2)
+        with pytest.raises(ValueError, match="event codes"):
+            tr.TraceSpec(window=4, cap=4, events=(99,))
+
+    def test_unknown_seq_field_rejected(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="seq_field"):
+            pt.make_step(cfg, proto, donate=False,
+                         trace=tr.TraceSpec(window=4, cap=8,
+                                            seq_field="nope"))
+
+    def test_event_filter_gates_captures(self):
+        spec = tr.TraceSpec(window=4, cap=4, events=(tr.EV_DELIVERED,))
+        assert tr.event_enabled(spec, tr.EV_DELIVERED)
+        assert not tr.event_enabled(spec, tr.EV_EMITTED)
+
+
+# ------------------------------------------------ unsharded lifecycle
+
+@pytest.mark.standard
+class TestUnshardedLifecycle:
+    """Executed N=16 HyParView runs: bit parity, span reconstruction,
+    the wire-observer ground truth, counted overflow."""
+
+    @pytest.fixture(scope="class")
+    def traced(self):
+        cfg, proto, world = _booted_hv()
+        spec = tr.TraceSpec(window=ROUNDS, cap=4 * world.msgs.cap)
+        step = pt.make_step(cfg, proto, donate=False, trace=spec)
+        tring = tr.make_trace_ring(spec)
+        w2, tring, events, overflow = _drain(step, world, tring, ROUNDS)
+        return cfg, proto, world, w2, events, overflow
+
+    def test_tracer_on_off_bit_parity(self):
+        """30 rounds traced vs plain from the same world: identical
+        final states bit-for-bit (the tracer observes, never
+        perturbs)."""
+        cfg, proto, world = _booted_hv()
+        spec = tr.TraceSpec(window=30, cap=world.msgs.cap)
+        plain = pt.make_step(cfg, proto, donate=False)
+        traced = pt.make_step(cfg, proto, donate=False, trace=spec)
+        wp, wt = world, world
+        tring = tr.make_trace_ring(spec)
+        for _ in range(30):
+            wp, _m = plain(wp)
+            wt, tring, _m2 = traced(wt, tring)
+        for a, b in zip(jax.tree_util.tree_leaves(wp),
+                        jax.tree_util.tree_leaves(wt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_lossless_capture_and_spans(self, traced):
+        _cfg, _proto, _w0, _w2, events, overflow = traced
+        assert int(overflow) == 0       # cap chosen lossless
+        per = collections.Counter(e.name for e in events)
+        assert per["emitted"] > 0 and per["delivered"] > 0, per
+        spans = tr.trace_spans(events)
+        assert len(spans) > 0
+        for (src, seq), sp in spans.items():
+            assert sp.src == src and sp.seq == seq
+            lat = sp.latency()
+            assert lat["total"] >= 0
+            assert (lat["queue"] + lat["retry"] + lat["transit"]
+                    + lat["partition_wait"]) <= max(lat["total"], lat["queue"]
+                                                    + lat["retry"]
+                                                    + lat["transit"])
+
+    def test_critical_path_matches_wire_observer(self, traced):
+        """The acceptance pin: critical_path over tracer DELIVERED
+        events == the same fold over the legacy per-round wire
+        observer's TraceEntry stream (independent recomputation — the
+        observer transfers every round's buffer, the tracer compacts
+        in-scan)."""
+        cfg, proto, w0, _w2, events, _ov = traced
+        rec = TraceRecorder(cfg, proto)
+        rec.run(w0, ROUNDS)
+        wire = sorted(set(tr.wire_deliveries(rec.entries)))
+        mine = sorted(set(tr.deliveries(events)))
+        assert mine == wire
+        assert tr.critical_path(mine) == tr.critical_path(wire)
+        assert len(tr.critical_path(mine)) >= 1
+
+    def test_overflow_counted_never_silent(self):
+        cfg, proto, world = _booted_hv()
+        spec = tr.TraceSpec(window=4, cap=2)   # tiny: must overflow
+        step = pt.make_step(cfg, proto, donate=False, trace=spec)
+        tring = tr.make_trace_ring(spec)
+        _w, tring2, events, overflow = _drain(step, world, tring, 4)
+        assert int(overflow) > 0
+        assert len(events) <= 4 * 2
+        # flush reset the counter, kept the buffer
+        assert int(tring2.overflow.sum()) == 0
+
+
+# ----------------------------------------------------- protocol taps
+
+@pytest.mark.standard
+class TestAckTaps:
+    """AckedDelivery's trace_taps: the ACKED / RETRANSMITTED /
+    DEAD_LETTERED diffs reconstruct the retry story of an omission
+    fault (the test_qos scenario, now as one span)."""
+
+    def test_retransmit_span(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8, retransmit_interval=3)
+        proto = AckedDelivery(cfg)
+
+        def interpose(m, rnd):
+            drop = (m.typ == proto.typ("app")) & (rnd < 7)
+            return m.replace(valid=m.valid & ~drop)
+
+        spec = tr.TraceSpec(window=16, cap=32, seq_field="seq")
+        world = pt.init_world(cfg, proto)
+        step = pt.make_step(cfg, proto, donate=False, trace=spec,
+                            interpose_send=interpose)
+        world = ps.send_ctl(world, proto, 0, "ctl_send", peer=2,
+                            payload=9)
+        tring = tr.make_trace_ring(spec)
+        _w, _t, events, _ov = _drain(step, world, tring, 16)
+        spans = [sp for sp in tr.trace_spans(events).values()
+                 if sp.rounds(tr.EV_ACKED)]
+        assert len(spans) == 1, tr.trace_spans(events)
+        sp = spans[0]
+        assert sp.attempts >= 2            # retransmitted through drops
+        assert sp.rounds(tr.EV_RETRANSMITTED)
+        assert sp.acked_rnd is not None
+        assert sp.delivered_rnd is not None
+        assert sp.delivered_rnd <= sp.acked_rnd
+        assert not sp.rounds(tr.EV_DEAD_LETTERED)
+        assert sp.latency()["retry"] > 0
+
+
+# ------------------------------------------------------ sharded parity
+
+@needs_mesh
+@pytest.mark.standard
+class TestShardedParity:
+    """Sharded vs unsharded span-event multisets on the 8-device mesh:
+    identical lifecycles (EXCHANGED excluded — it only exists where an
+    exchange exists), zero overflow both sides."""
+
+    @pytest.fixture(scope="class")
+    def both(self):
+        from partisan_tpu.parallel import dataplane as dp
+        from partisan_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(n_devices=8)
+        cfg = pt.Config(n_nodes=N, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        out_cap = dp.sharded_out_cap(cfg, proto, 8)
+        cfg2, proto2, world = _booted_hv(out_cap=out_cap, inbox_cap=16,
+                                         stagger=0)
+        spec = tr.TraceSpec(window=ROUNDS, cap=4 * out_cap)
+
+        ustep = pt.make_step(cfg2, proto2, donate=False, trace=spec)
+        _w, _t, uevents, uov = _drain(ustep, world,
+                                      tr.make_trace_ring(spec), ROUNDS)
+
+        sworld = dp.place_sharded_world(world, cfg2, mesh)
+        sstep = dp.make_sharded_step(cfg2, proto2, mesh, donate=False,
+                                     trace=spec)
+        string = tr.place_trace_ring(tr.make_trace_ring(spec, 8), mesh)
+        _w2, _t2, sevents, sov = _drain(sstep, sworld, string, ROUNDS)
+        return uevents, int(uov), sevents, int(sov)
+
+    def test_span_multisets_match(self, both):
+        uevents, uov, sevents, sov = both
+        assert uov == 0 and sov == 0
+
+        def key(e):
+            return (e.rnd, e.ev, e.src, e.dst, e.typ, e.born, e.seq)
+
+        um = collections.Counter(
+            key(e) for e in uevents if e.ev != tr.EV_EXCHANGED)
+        sm = collections.Counter(
+            key(e) for e in sevents if e.ev != tr.EV_EXCHANGED)
+        assert um == sm
+
+    def test_exchanged_only_sharded_and_present(self, both):
+        uevents, _uo, sevents, _so = both
+        assert not [e for e in uevents if e.ev == tr.EV_EXCHANGED]
+        assert [e for e in sevents if e.ev == tr.EV_EXCHANGED]
+
+
+# ------------------------------------------------------------- alerts
+
+@pytest.mark.standard
+class TestAlertPlane:
+    def _vals(self, reg, **over):
+        vals = {n: jnp.int32(0) for n in reg.names}
+        vals["health_reach_frac"] = jnp.float32(1.0)
+        vals.update({k: jnp.asarray(v) for k, v in over.items()})
+        return vals
+
+    def test_detector_gating_follows_registry(self):
+        upd, det = al.make_alert_plane(al.AlertSpec(),
+                                       vh.health_registry())
+        assert det == ("convergence_stall", "partition_suspected")
+        upd2, det2 = al.make_alert_plane(al.AlertSpec(),
+                                         vh.workload_registry())
+        assert det2 == ("convergence_stall", "slo_burn",
+                        "partition_suspected")
+
+    def test_stall_and_partition_need_sustained_condition(self):
+        reg = al.alert_registry(vh.health_registry())
+        upd, _ = al.make_alert_plane(
+            al.AlertSpec(stall_rounds=2, partition_rounds=3), reg)
+        st = al.make_alert_state()
+        seen = []
+        for _ in range(4):
+            st, cols = upd(st, self._vals(
+                reg, msgs_delivered=0, inflight=4,
+                health_reach_frac=0.5))
+            seen.append((int(cols["alert_stall"]),
+                         int(cols["alert_partition"]),
+                         int(cols["alerts_active"])))
+        # for: clauses — stall after 2 rounds, partition after 3
+        assert seen == [(0, 0, 0), (1, 0, 1), (1, 1, 5), (1, 1, 5)]
+        # condition clears -> counter resets, bits drop
+        st, cols = upd(st, self._vals(reg, msgs_delivered=3, inflight=4))
+        assert int(cols["alerts_active"]) == 0
+
+    def test_slo_burn_differentiates_cumulative_buckets(self):
+        """The burn detector sees per-round DELTAS of the cumulative
+        histogram columns: all-violating rounds fire, an all-within
+        round resets."""
+        reg = al.alert_registry(vh.workload_registry())
+        spec = al.AlertSpec(slo_deadline_rounds=4, slo_burn_milli=500,
+                            slo_burn_rounds=2)
+        upd, _ = al.make_alert_plane(spec, reg)
+        st = al.make_alert_state()
+        ok_col = "rpc_latency__bucket_4"     # edge 4 <= deadline 4
+        bad_col = "rpc_latency__bucket_64"   # past the deadline
+        bad = ok = 0
+        fired = []
+        for burn_round in (True, True, True, False):
+            if burn_round:
+                bad += 3
+            else:
+                ok += 10
+            st, cols = upd(st, self._vals(
+                reg, **{bad_col: bad, ok_col: ok}))
+            fired.append(int(cols["alert_slo_burn"]))
+        assert fired == [0, 1, 1, 0]
+
+    def test_firer_edge_detects_and_exposes(self):
+        firer = al.AlertFirer()
+        rows = [{"round": 1, "alert_partition": 0.0},
+                {"round": 2, "alert_partition": 1.0},
+                {"round": 3, "alert_partition": 1.0},   # no new event
+                {"round": 4, "alert_partition": 0.0}]
+        trans = firer.observe_rows(rows)
+        assert trans == [("partition_suspected", "firing", 2),
+                         ("partition_suspected", "resolved", 4)]
+        firer.observe({"round": 5, "alert_partition": 1.0})
+        expo = al.alerts_exposition(firer)
+        assert 'alertname="partition_suspected"' in expo
+        assert 'alertstate="firing"' in expo
+
+
+@pytest.mark.standard
+class TestAlertRoundTrip:
+    """The acceptance drive: a standing partition makes the in-scan
+    detector fire, the firing round-trips through the runner, the host
+    event bus, and the Prometheus text exposition."""
+
+    def test_partition_alert_fires_through_runner(self):
+        cfg = pt.Config(n_nodes=N, inbox_cap=16)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto,
+                           [(i, (i + 1) % N) for i in range(N)])
+        part = jnp.where(jnp.arange(N) < N // 2, 1, 2).astype(jnp.int32)
+        world = world.replace(partition=part)
+
+        reg = vh.health_registry()
+        firer = al.AlertFirer()
+        sink = telemetry.PrometheusSink(al.alert_registry(reg))
+        captured = []
+
+        class Capture:
+            def write_row(self, row):
+                captured.append(row)
+
+        cap_sink = telemetry.add_global_sink(Capture())
+        try:
+            events = []
+            telemetry.run_with_telemetry(
+                cfg, proto, 16, window=8, registry=reg, world=world,
+                sinks=[sink],
+                trace=tr.TraceSpec(window=8, cap=256),
+                on_trace=events.extend,
+                alerts=al.AlertSpec(partition_rounds=3,
+                                    partition_frac_milli=990),
+                alert_firer=firer)
+        finally:
+            telemetry.remove_global_sink(cap_sink)
+
+        assert "partition_suspected" in firer.firing()
+        assert events and tr.trace_spans(events)       # trace co-ran
+        # host event bus saw the firing transition
+        alert_rows = [r for r in captured if r.get("event") == "alert"]
+        assert any(r["alertname"] == "partition_suspected"
+                   and r["alertstate"] == "firing" for r in alert_rows)
+        # Prometheus round-trip: the alert gauge parses back as 1
+        parsed = telemetry.parse_exposition(sink.expose())
+        assert parsed["partisan_alert_partition"]["samples"][""] == 1.0
+        assert telemetry.parse_exposition(al.alerts_exposition(firer))
+
+
+# ------------------------------------------------------------ reports
+
+@pytest.mark.standard
+class TestReports:
+    def test_span_jsonl_round_trip(self, tmp_path):
+        evs = [tr.SpanEvent(2, tr.EV_EMITTED, 1, 3, 0, 2, 42),
+               tr.SpanEvent(3, tr.EV_DELIVERED, 1, 3, 0, 2, 42)]
+        p = str(tmp_path / "spans.jsonl")
+        assert tr.write_spans(p, evs) == 2
+        assert tr.read_spans(p) == evs
+
+    def test_trace_report_summary_and_drilldown(self):
+        mod = _load_script("trace_report")
+        evs = [tr.SpanEvent(2, tr.EV_EMITTED, 1, 3, 0, 2, 42),
+               tr.SpanEvent(3, tr.EV_DELIVERED, 1, 3, 0, 2, 42),
+               tr.SpanEvent(4, tr.EV_ACKED, 1, 3, 0, 2, 42),
+               tr.SpanEvent(5, tr.EV_EMITTED, 3, 2, 0, 5, 7),
+               tr.SpanEvent(6, tr.EV_DELIVERED, 3, 2, 0, 5, 7)]
+        s = mod.summarize(evs)
+        assert s["spans"] == 2 and s["completed"] == 2
+        assert s["per_event"]["delivered"] == 2
+        # last delivery chains back through node 3's enabling arrival
+        assert s["critical_path"] == [[3, 1, 3, 0, 42],
+                                      [6, 3, 2, 0, 7]]
+        sp = tr.trace_spans(evs)[(1, 42)]
+        row = mod.span_row(sp, typ_names=["app"])
+        assert row["typ"] == "app" and row["attempts"] == 1
+        assert [e["ev"] for e in row["timeline"]] == [
+            "emitted", "delivered", "acked"]
+
+    def test_flight_report_message_mode(self):
+        """The --message regression: hops selected by the tracer's
+        (src, signed-seq) id, hash bitcast convention included."""
+        mod = _load_script("flight_report")
+        from partisan_tpu.verify.trace import TraceEntry
+        entries = [TraceEntry(2, 1, 3, 0, 0, 42),
+                   TraceEntry(4, 3, 5, 0, 0, 0xFFFFFFF9),   # seq -7
+                   TraceEntry(5, 3, 6, 0, 0, 0xFFFFFFF9)]
+        assert mod.signed_seq(0xFFFFFFF9) == -7
+        m = mod.message_report(entries, 3, -7)
+        assert m["found"] and m["hops"] == 2
+        assert [h["dst"] for h in m["path"]] == [5, 6]
+        assert m["round_span"] == [4, 5]
+        miss = mod.message_report(entries, 9, 9)
+        assert not miss["found"] and miss["hops"] == 0
+
+    def test_perfetto_span_track(self):
+        from partisan_tpu.telemetry.perfetto import chrome_trace
+        evs = [tr.SpanEvent(2, tr.EV_EMITTED, 1, 3, 0, 2, 42),
+               tr.SpanEvent(3, tr.EV_DELIVERED, 1, 3, 0, 2, 42)]
+        doc = chrome_trace(spans=tr.trace_spans(evs).values(),
+                           typ_names=("app",))
+        span = [e for e in doc["traceEvents"]
+                if e.get("cat") == "span" and e["ph"] == "X"]
+        inst = [e for e in doc["traceEvents"]
+                if e.get("cat") == "span" and e["ph"] == "i"]
+        assert len(span) == 1 and len(inst) == 2
+        assert span[0]["name"] == "app #42"
+        assert span[0]["args"]["total"] == 1
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert "message spans" in names
