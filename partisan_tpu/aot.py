@@ -1,0 +1,579 @@
+"""AOT export plane (ISSUE 17): kill the compile wall by shipping the
+flagship programs as on-disk artifacts instead of retracing them.
+
+XLA compile time is the binding constraint on everything this repo
+claims (ROADMAP; the explorer's HyParView checker compiles ~13-15 min
+cold on this box).  PRs 11-12 built the measurement layer — the
+flagship registry (``verify/lint/fingerprint.FLAGSHIP``), the compile
+ledger (``COMPILE_ledger.jsonl``) and the recompile-regression gate
+(``COMPILE_goldens.json``).  This module is the *doing*: each flagship
+entrypoint is ``jax.export``-serialized into a versioned artifact that
+a cold process deserializes-and-calls in seconds where tracing +
+backend compile took minutes (measured 2.2 s vs 41.9 s for the sharded
+dataplane round — see BASELINE.md).
+
+An artifact bundle (``aot_artifacts/`` at the repo root) holds, per
+program name:
+
+* ``<name>.jexp``       — the serialized :func:`jax.export.export` of a
+  *flat* wrapper over the tree-flattened canonical args (export
+  serialization cannot carry the repo's custom pytrees, so the
+  treedefs travel separately);
+* ``<name>.trees.pkl``  — pickled ``(in_tree, out_tree)`` treedefs;
+* ``jit_aot_<name>-<cachekey>-cache`` — the persistent-compilation-
+  cache entry for the deserialized program, captured at build time by
+  calling it once through a jit wrapper *named* ``aot_<name>`` (the
+  name lands in the module ``sym_name`` and therefore in the cache
+  key, which is what makes the entry identifiable and shippable);
+* one ``MANIFEST.json`` for the bundle: per-entry module hash (the
+  observatory's lowered-StableHLO sha), file digests, plus the jax /
+  jaxlib versions, platform, device count and **canonical cache-dir
+  path** they were built against.
+
+The cache-dir path is part of the contract, not a detail: jax embeds
+``<cache_dir>/xla_gpu_per_fusion_autotune_cache_dir`` in the compile
+options that enter the persistent-cache key, so an entry staged under
+one directory is unreachable from another.  Build and load therefore
+both pin ``<repo>/.jax_cache`` (``canonical_cache_dir``), and the
+manifest records the absolute path so a moved checkout fails NAMED
+instead of silently recompiling.
+
+Staleness is NAMED, never silent (SURVEY §7.3 discipline): every load
+check that fails raises :class:`AotStale` with a human reason and —
+when a ledger is attached — emits an ``aot_stale`` row through the
+PR-12 ledger; callers fall back to tracing.  Freshness against the
+*code* is delegated to the observatory: :func:`load` compares the
+manifest's module hash against ``COMPILE_goldens.json`` (kept honest
+by ``scripts/observatory.py --check``), so adopting an artifact never
+requires the trace it exists to avoid.
+
+Consumers: ``scripts/warm_cache.py`` (artifact hit -> load, miss ->
+compile-and-export), ``bridge/port_server.py`` and ``verify/explorer``
+cold starts (:func:`attach` / :func:`adopt`), and the
+``scripts/aot_pack.py --build/--verify`` CLI which proves every
+deserialized program executes bit-identical to its freshly-traced
+twin.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "AotStale", "AotProgram", "MANIFEST_BASENAME", "ARTIFACT_DIRNAME",
+    "artifact_dir", "canonical_cache_dir", "read_manifest",
+    "export_entry", "build_bundle", "load", "maybe_load", "adopt",
+    "attach", "verify_entry",
+]
+
+MANIFEST_BASENAME = "MANIFEST.json"
+ARTIFACT_DIRNAME = "aot_artifacts"
+MANIFEST_VERSION = 1
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def artifact_dir(root: Optional[str] = None) -> str:
+    """Default bundle location: ``<repo>/aot_artifacts``."""
+    return os.path.join(root or _REPO, ARTIFACT_DIRNAME)
+
+
+def canonical_cache_dir(root: Optional[str] = None) -> str:
+    """The ONE persistent-cache path artifacts are keyed against (the
+    cache-dir path leaks into the compile-options hash — module
+    docstring)."""
+    return os.path.join(root or _REPO, ".jax_cache")
+
+
+class AotStale(RuntimeError):
+    """A named reason an artifact cannot be adopted (fall back to
+    tracing; the reason also lands in the ledger as ``aot_stale``)."""
+
+    def __init__(self, name: str, reason: str):
+        super().__init__(f"aot[{name}]: {reason}")
+        self.name = name
+        self.reason = reason
+
+
+# ----------------------------------------------------------- small utils
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _env_record() -> Dict[str, Any]:
+    import jax
+    import jaxlib
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": jax.default_backend(),
+        "device_count": jax.device_count(),
+    }
+
+
+def _mesh_shape(leaves: Sequence[Any]) -> Optional[List[int]]:
+    """Best-effort mesh shape from the first NamedSharding-committed
+    leaf (part of the manifest's staleness key for sharded programs)."""
+    for x in leaves:
+        sh = getattr(x, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and getattr(mesh, "shape", None):
+            return [int(v) for v in dict(mesh.shape).values()]
+    return None
+
+
+def read_manifest(art_dir: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = os.path.join(art_dir or artifact_dir(), MANIFEST_BASENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _write_manifest(art_dir: str, manifest: Mapping[str, Any]) -> None:
+    path = os.path.join(art_dir, MANIFEST_BASENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _ensure_cache(cache_dir: str) -> None:
+    """Point jax's persistent cache at ``cache_dir`` (the canonical
+    path) with zeroed write thresholds, matching what the warm-cache /
+    observatory discipline already does."""
+    import jax
+    os.makedirs(cache_dir, exist_ok=True)
+    if jax.config.jax_compilation_cache_dir != cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def _module_hash(fn: Callable, args: tuple) -> str:
+    """The observatory's program identity: sha256 of the lowered
+    StableHLO text, truncated to 16 hex chars (matches
+    ``telemetry.observatory.measure_entry``)."""
+    lowered = fn.trace(*args).lower()
+    text = lowered.as_text()
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:16]
+
+
+def _ledger_aot(ledger: Any, event: str, program: str,
+                duration: Optional[float] = None,
+                reason: Optional[str] = None,
+                fingerprint: Optional[str] = None) -> None:
+    if ledger is not None and hasattr(ledger, "record_aot"):
+        ledger.record_aot(event, program, duration=duration,
+                          reason=reason, fingerprint=fingerprint)
+
+
+# ---------------------------------------------------------------- build
+
+def export_entry(name: str, fn: Callable, args: tuple,
+                 art_dir: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 ledger: Any = None) -> Dict[str, Any]:
+    """Export ONE program into the bundle: serialize the flat wrapper,
+    pickle the treedefs, compile the *deserialized* program once under
+    the canonical cache dir to capture its ``jit_aot_<name>-*-cache``
+    entry, and return the manifest record.  The original ``fn`` is
+    lowered (for the module hash) but never backend-compiled — the only
+    XLA compile paid here is the exported program's own, which is
+    exactly the entry being shipped."""
+    import jax
+    from jax import export as jexport
+
+    art_dir = art_dir or artifact_dir()
+    cache_dir = cache_dir or canonical_cache_dir()
+    os.makedirs(art_dir, exist_ok=True)
+    _ensure_cache(cache_dir)
+
+    t0 = time.time()
+    leaves, in_tree = jax.tree_util.tree_flatten(args)
+    mhash = _module_hash(fn, args)
+
+    box: Dict[str, Any] = {}
+
+    def flat(*flat_leaves):
+        out = fn(*jax.tree_util.tree_unflatten(in_tree, flat_leaves))
+        out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+        box["out_tree"] = out_tree
+        return tuple(out_leaves)
+
+    exp = jexport.export(jax.jit(flat))(*leaves)
+    blob = exp.serialize()
+    out_tree = box["out_tree"]
+
+    exp_file = f"{name}.jexp"
+    trees_file = f"{name}.trees.pkl"
+    with open(os.path.join(art_dir, exp_file), "wb") as f:
+        f.write(blob)
+    with open(os.path.join(art_dir, trees_file), "wb") as f:
+        pickle.dump((in_tree, out_tree), f)
+
+    # compile the DESERIALIZED program (what loaders will run) through a
+    # jit wrapper named aot_<name>: the name reaches the module sym_name
+    # and hence the persistent-cache key, making the new entry
+    # identifiable below.  This is the one real compile of the build.
+    exp2 = jexport.deserialize(blob)
+
+    def caller(*flat_leaves):
+        return exp2.call(*flat_leaves)
+    caller.__name__ = f"aot_{name}"
+
+    before = set(os.listdir(cache_dir))
+    out = jax.jit(caller)(*leaves)
+    jax.block_until_ready(out)
+    new = sorted(p for p in set(os.listdir(cache_dir)) - before
+                 if p.startswith(f"jit_aot_{name}-") and p.endswith("-cache"))
+    cache_file: Optional[str] = None
+    if new:
+        cache_file = new[-1]
+        shutil.copy(os.path.join(cache_dir, cache_file),
+                    os.path.join(art_dir, cache_file))
+    else:
+        # already cached from a previous build of the same program —
+        # find the existing entry so the bundle still ships it
+        have = sorted(p for p in os.listdir(cache_dir)
+                      if p.startswith(f"jit_aot_{name}-")
+                      and p.endswith("-cache"))
+        if have:
+            cache_file = have[-1]
+            shutil.copy(os.path.join(cache_dir, cache_file),
+                        os.path.join(art_dir, cache_file))
+    built_s = time.time() - t0
+
+    files = {"export": exp_file, "trees": trees_file}
+    if cache_file is not None:
+        files["cache"] = cache_file
+    entry = {
+        "module_hash": mhash,
+        "files": files,
+        "sha256": {k: _sha256_file(os.path.join(art_dir, v))
+                   for k, v in files.items()},
+        "mesh_shape": _mesh_shape(leaves),
+        "n_leaves": len(leaves),
+        "built_s": round(built_s, 2),
+    }
+
+    manifest = read_manifest(art_dir) or {
+        "version": MANIFEST_VERSION, "entries": {}}
+    manifest.update(_env_record())
+    manifest["version"] = MANIFEST_VERSION
+    manifest["cache_dir"] = os.path.abspath(cache_dir)
+    manifest.setdefault("entries", {})[name] = entry
+    _write_manifest(art_dir, manifest)
+    _ledger_aot(ledger, "aot_export", name, duration=built_s,
+                fingerprint=mhash)
+    return entry
+
+
+def build_bundle(names: Optional[Sequence[str]] = None,
+                 art_dir: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 ledger: Any = None,
+                 progress: Optional[Callable[[str], None]] = None,
+                 registry: Optional[Mapping[str, Callable]] = None
+                 ) -> Dict[str, Dict[str, Any]]:
+    """Export every flagship entrypoint (or ``names``) into the bundle."""
+    if registry is None:
+        from .verify.lint.fingerprint import FLAGSHIP
+        registry = FLAGSHIP
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, build in registry.items():
+        if names is not None and name not in names:
+            continue
+        if progress:
+            progress(name)
+        fn, args = build()
+        out[name] = export_entry(name, fn, args, art_dir=art_dir,
+                                 cache_dir=cache_dir, ledger=ledger)
+    return out
+
+
+# ----------------------------------------------------------------- load
+
+class AotProgram:
+    """A loaded artifact: callable with the ORIGINAL (pytree) calling
+    convention of its flagship twin.  ``in_tree`` / ``in_avals`` let
+    adopters check compatibility before committing."""
+
+    def __init__(self, name: str, exported: Any, in_tree: Any,
+                 out_tree: Any, module_hash: str):
+        import jax
+        self.name = name
+        self.exported = exported
+        self.in_tree = in_tree
+        self.out_tree = out_tree
+        self.module_hash = module_hash
+        self.in_avals = tuple(exported.in_avals)
+
+        def caller(*flat_leaves):
+            return exported.call(*flat_leaves)
+        caller.__name__ = f"aot_{name}"
+        self._jcall = jax.jit(caller)
+
+    def matches(self, args: tuple) -> bool:
+        """True when ``args`` flatten to this program's treedef and
+        leaf shapes/dtypes (the adoption precondition)."""
+        import jax
+        leaves, tree = jax.tree_util.tree_flatten(args)
+        if tree != self.in_tree or len(leaves) != len(self.in_avals):
+            return False
+        for x, av in zip(leaves, self.in_avals):
+            if (tuple(getattr(x, "shape", ())) != tuple(av.shape)
+                    or getattr(x, "dtype", None) != av.dtype):
+                return False
+        return True
+
+    def __call__(self, *args):
+        import jax
+        leaves, tree = jax.tree_util.tree_flatten(args)
+        if tree != self.in_tree:
+            raise AotStale(self.name,
+                           "call args do not flatten to the exported "
+                           "treedef — program/caller drift")
+        out = self._jcall(*leaves)
+        return jax.tree_util.tree_unflatten(self.out_tree, out)
+
+
+def _golden_hash(name: str, root: Optional[str] = None) -> Optional[str]:
+    """Module hash ``COMPILE_goldens.json`` pins for ``name`` (None when
+    the goldens file or entry is absent)."""
+    path = os.path.join(root or _REPO, "COMPILE_goldens.json")
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        golden = json.load(f)
+    rec = golden.get(name)
+    return rec.get("module_hash") if isinstance(rec, dict) else None
+
+
+def load(name: str, art_dir: Optional[str] = None,
+         cache_dir: Optional[str] = None,
+         expect_module_hash: Optional[str] = "goldens",
+         ledger: Any = None) -> AotProgram:
+    """Deserialize one artifact, after the staleness gauntlet.  Every
+    failure raises :class:`AotStale` with a NAMED reason (and ledgers
+    ``aot_stale``); success seeds the canonical cache with the shipped
+    entry so the first call is a cache load, not a compile.
+
+    ``expect_module_hash="goldens"`` (default) checks the manifest hash
+    against ``COMPILE_goldens.json`` — the cheap no-trace freshness
+    check, honest as long as the observatory gate keeps goldens == code.
+    Pass an explicit hash (e.g. from ``measure_entry``) for a hard
+    check, or ``None`` to skip."""
+    import jax
+    from jax import export as jexport
+
+    art_dir = art_dir or artifact_dir()
+    cache_dir = cache_dir or canonical_cache_dir()
+
+    def stale(reason: str) -> AotStale:
+        _ledger_aot(ledger, "aot_stale", name, reason=reason)
+        return AotStale(name, reason)
+
+    manifest = read_manifest(art_dir)
+    if manifest is None:
+        # no bundle at all: nothing is stale, there is just nothing —
+        # still a named condition, but not ledgered as aot_stale
+        raise AotStale(name, f"no artifact bundle at {art_dir}")
+    entry = manifest.get("entries", {}).get(name)
+    if entry is None:
+        raise stale(f"bundle has no artifact for {name} "
+                    f"(run scripts/aot_pack.py --build)")
+
+    env = _env_record()
+    for key in ("jax", "jaxlib", "platform", "device_count"):
+        want, have = manifest.get(key), env[key]
+        if want != have:
+            raise stale(f"{key} mismatch: artifact built for {want!r}, "
+                        f"process has {have!r}")
+    want_cache = manifest.get("cache_dir")
+    if want_cache and os.path.abspath(cache_dir) != want_cache:
+        raise stale(
+            f"cache_dir mismatch: artifacts keyed against {want_cache}, "
+            f"process uses {os.path.abspath(cache_dir)} (the cache-dir "
+            f"path enters the compile-options hash; rebuild the bundle "
+            f"for this checkout)")
+
+    ms = entry.get("mesh_shape")
+    if ms:
+        need = 1
+        for v in ms:
+            need *= int(v)
+        if need > env["device_count"]:
+            raise stale(f"mesh shape mismatch: artifact built on a "
+                        f"{ms} mesh ({need} devices), process has "
+                        f"{env['device_count']}")
+
+    if expect_module_hash == "goldens":
+        expect_module_hash = _golden_hash(name)
+    if (expect_module_hash is not None
+            and entry["module_hash"] != expect_module_hash):
+        raise stale(
+            f"module hash drift: artifact serialized "
+            f"{entry['module_hash']}, current program is "
+            f"{expect_module_hash} — the code moved; rebuild "
+            f"(scripts/aot_pack.py --build) after re-blessing")
+
+    for kind, fname in entry["files"].items():
+        path = os.path.join(art_dir, fname)
+        if not os.path.exists(path):
+            raise stale(f"artifact file missing: {fname}")
+        if _sha256_file(path) != entry["sha256"][kind]:
+            raise stale(f"artifact file corrupt (sha256 mismatch): "
+                        f"{fname}")
+
+    _ensure_cache(cache_dir)
+    cache_file = entry["files"].get("cache")
+    if cache_file is not None:
+        dst = os.path.join(cache_dir, cache_file)
+        if not os.path.exists(dst):
+            shutil.copy(os.path.join(art_dir, cache_file), dst)
+
+    with open(os.path.join(art_dir, entry["files"]["export"]), "rb") as f:
+        blob = f.read()
+    with open(os.path.join(art_dir, entry["files"]["trees"]), "rb") as f:
+        in_tree, out_tree = pickle.load(f)
+    try:
+        exported = jexport.deserialize(blob)
+    except Exception as e:  # deserialization is version-sensitive
+        raise stale(f"export blob failed to deserialize: {e!r}")
+    return AotProgram(name, exported, in_tree, out_tree,
+                      entry["module_hash"])
+
+
+def maybe_load(name: str, **kw: Any) -> Optional[AotProgram]:
+    """:func:`load`, with staleness collapsed to ``None`` (the reason
+    was already ledgered when a ledger is attached)."""
+    try:
+        return load(name, **kw)
+    except AotStale:
+        return None
+
+
+def adopt(args: tuple, names: Optional[Sequence[str]] = None,
+          art_dir: Optional[str] = None, ledger: Any = None
+          ) -> Optional[Tuple[str, AotProgram]]:
+    """Find a bundle entry whose exported signature matches ``args``
+    (treedef + leaf avals) — the port server's cold-start hook, which
+    knows its world but not which flagship name (if any) it equals.
+    Returns ``(name, program)`` or None.  Candidate loads that fail the
+    staleness gauntlet are skipped (already ledgered)."""
+    manifest = read_manifest(art_dir)
+    if manifest is None:
+        return None
+    for name in sorted(manifest.get("entries", {})):
+        if names is not None and name not in names:
+            continue
+        prog = maybe_load(name, art_dir=art_dir, ledger=ledger)
+        if prog is not None and prog.matches(args):
+            return name, prog
+    return None
+
+
+def attach(name: str, fallback: Callable, art_dir: Optional[str] = None,
+           ledger: Any = None,
+           on_adopt: Optional[Callable[[AotProgram], None]] = None,
+           gate: Optional[Callable[[AotProgram, tuple], bool]] = None
+           ) -> Callable:
+    """Wrap ``fallback`` with a lazy AOT fast path: the first call
+    tries to :func:`load` artifact ``name`` and adopts it if its
+    signature matches the actual args; otherwise (or on any named
+    staleness) every call goes to ``fallback``.  The adoption attempt
+    happens once — cold-start consumers (explorer) pay zero tracing
+    when the artifact is fresh and exactly the old path when not.
+
+    ``gate``, when given, runs after the signature match and must
+    return True for adoption — the hook where a caller adds a hard
+    module-hash check (trace the fallback, compare) when equal avals
+    alone cannot distinguish two programs."""
+    state: Dict[str, Any] = {"tried": False, "prog": None}
+
+    def dispatch(*args):
+        if not state["tried"]:
+            state["tried"] = True
+            prog = maybe_load(name, art_dir=art_dir, ledger=ledger)
+            if prog is not None and prog.matches(args) \
+                    and (gate is None or gate(prog, args)):
+                state["prog"] = prog
+                if on_adopt is not None:
+                    on_adopt(prog)
+        if state["prog"] is not None:
+            return state["prog"](*args)
+        return fallback(*args)
+
+    dispatch.__name__ = f"aot_dispatch_{name}"
+    dispatch.aot_state = state
+    return dispatch
+
+
+# --------------------------------------------------------------- verify
+
+def verify_entry(name: str, art_dir: Optional[str] = None,
+                 cache_dir: Optional[str] = None, ledger: Any = None,
+                 registry: Optional[Mapping[str, Callable]] = None
+                 ) -> Dict[str, Any]:
+    """The bit-identity proof behind ``aot_pack.py --verify``: load the
+    artifact, retrace the flagship twin, check the module hash still
+    matches the manifest, execute BOTH, and compare every output leaf
+    bitwise.  Returns a result record; raises :class:`AotStale` (named)
+    on staleness and ``AssertionError`` on a bit mismatch."""
+    import numpy as np
+    import jax
+
+    if registry is None:
+        from .verify.lint.fingerprint import FLAGSHIP
+        registry = FLAGSHIP
+    if name not in registry:
+        raise AotStale(name, "not in the flagship registry")
+    fn, args = registry[name]()
+
+    mhash = _module_hash(fn, args)
+    t0 = time.time()
+    prog = load(name, art_dir=art_dir, cache_dir=cache_dir,
+                expect_module_hash=mhash, ledger=ledger)
+    t1 = time.time()
+    got = prog(*args)
+    jax.block_until_ready(got)
+    t_load = time.time() - t0
+    t2 = time.time()
+    ref = fn(*args)
+    jax.block_until_ready(ref)
+    t_ref = time.time() - t2
+
+    got_leaves = jax.tree_util.tree_leaves(got)
+    ref_leaves = jax.tree_util.tree_leaves(ref)
+    assert len(got_leaves) == len(ref_leaves), (
+        f"{name}: leaf count {len(got_leaves)} != {len(ref_leaves)}")
+    for i, (a, b) in enumerate(zip(got_leaves, ref_leaves)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype and a.shape == b.shape, (
+            f"{name}: leaf {i} aval {a.dtype}{a.shape} != "
+            f"{b.dtype}{b.shape}")
+        if not (a == b).all():
+            bad = int(np.sum(a != b))
+            raise AssertionError(
+                f"{name}: leaf {i} differs in {bad}/{a.size} elements — "
+                f"deserialized program is NOT bit-identical to its "
+                f"freshly-traced twin")
+    _ledger_aot(ledger, "aot_load", name, duration=t_load,
+                fingerprint=mhash)
+    return {"name": name, "module_hash": mhash, "leaves": len(got_leaves),
+            "deserialize_s": round(t1 - t0, 2),
+            "load_call_s": round(t_load, 2), "twin_exec_s": round(t_ref, 2),
+            "bit_identical": True}

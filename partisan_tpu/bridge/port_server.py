@@ -154,6 +154,7 @@ class Session:
         self._hooks: Dict[str, Any] = {}     # interposition funs
         self.pending_fwds: list = []         # queued {forward,...} records
         self.recv_cursors: Dict[int, int] = {}
+        self.aot_adopted: Optional[str] = None   # artifact name, if any
 
     # ------------------------------------------------------------- commands
 
@@ -238,12 +239,42 @@ class Session:
             self.world = attach_plane(self.world, self.ctl)
         self.step = make_step(self.cfg, self.proto, donate=False,
                               control=self.ctl)
+        self._adopt_aot()
         # a re-start is a fresh world: session-side cursors and queued
         # forwards from the previous world must not leak into it (same
         # stale-cursor hazard cmd_restore documents)
         self.recv_cursors = {}
         self.pending_fwds = []
         return Atom("ok")
+
+    def _adopt_aot(self) -> None:
+        """Cold-start fast path (ISSUE 17): when the AOT bundle ships a
+        program that IS this session's step — same arg treedef/avals AND
+        the same lowered module hash (tracing is cheap; the backend
+        compile is the wall) — run the deserialized artifact instead of
+        compiling.  The hash gate makes adoption exact: two configs with
+        equal shapes but different baked-in constants lower to different
+        StableHLO and never match.  Any mismatch or named staleness
+        falls through to the freshly-made step."""
+        import os
+        if os.environ.get("PARTISAN_TPU_AOT", "1") in ("0", "off"):
+            return
+        try:
+            from .. import aot
+            cand = aot.adopt((self.world,))
+            if cand is None:
+                return
+            name, prog = cand
+            if aot._module_hash(self.step, (self.world,)) \
+                    != prog.module_hash:
+                return
+            self.step = prog
+            self.aot_adopted = name
+            print(f"port_server: adopted AOT artifact {name} "
+                  f"(module={prog.module_hash})", file=sys.stderr)
+        except Exception:
+            # adoption is an optimization, never a start failure
+            traceback.print_exc(file=sys.stderr)
 
     def _started(self) -> bool:
         return self.world is not None
@@ -574,6 +605,8 @@ class Session:
                                      rounds=rounds, **sel)
         else:
             return (Atom("error"), Atom("unknown_verb"))
+        # an interposed step is a different program — never the artifact
+        self.aot_adopted = None
         self.step = make_step(self.cfg, self.proto, donate=False,
                               control=self.ctl, **self._hooks)
         return Atom("ok")

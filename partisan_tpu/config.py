@@ -171,6 +171,18 @@ class Config:
     #   O(G · handlers-present) — the big-N engine knob (BASELINE round-1
     #   notes).  None = always dense (bit-identical results either way;
     #   handlers see the same per-node PRNG keys on both paths).
+    use_pallas_route: bool = False
+    # ^ run the dense round's shard-local routing sorts
+    #   (ops/shard_exchange.reverse_select / bucket_exchange) through
+    #   the fused Pallas kernels (ops/route_kernel.py, ISSUE 17)
+    #   instead of the jnp reference: one pallas_call per primitive in
+    #   place of XLA's multi-kernel sort/iota/scatter pipeline.
+    #   Bit-identical outputs by construction (the kernels' bitonic
+    #   network reproduces lax.sort's stable order exactly; property-
+    #   pinned in tests/test_route_kernel.py); off-TPU the kernels run
+    #   in interpret mode, so False (default) stays the right call
+    #   everywhere but TPU — and False compiles the byte-identical
+    #   programs this repo always compiled (fingerprint-gated).
 
     # --- workload / SLO plane (workload/, Dean & Barroso tail-at-scale) -----
     slo_deadline_rounds: int = 16

@@ -78,8 +78,15 @@ class Distance(UpperProtocol):
 
     def tick_upper(self, cfg, me, row: StackState, rnd, key):
         up = row.upper.replace(last_rnd=rnd)
-        due = cfg.distance_enabled \
-            & (((rnd + me) % cfg.distance_interval) == 0)
+        # trace-lint: allow(config-fork): ?DISTANCE_ENABLED is a deliberate trace-time gate — a disabled stack must compile the plane to NOTHING (tests pin that the disabled text is distance_interval-independent)
+        if not cfg.distance_enabled:
+            # ?DISTANCE_ENABLED (partisan.hrl:40) is a TRACE-time gate:
+            # the disabled plane compiles to nothing — no ping emission
+            # and no interval arithmetic enters the program, so the
+            # lowered text is independent of distance_interval
+            # (pinned in tests/test_distance.py).
+            return self.up(row, up), self.no_emit()
+        due = ((rnd + me) % cfg.distance_interval) == 0
         peers = self.active_peers(row)[: self.P]
         em = self.emit(jnp.where(due, peers, -1), self.typ("dist_ping"),
                        cap=self.tick_emit_cap, stamp=rnd)

@@ -406,7 +406,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 wire_ok(jnp.where(t_dead, -1, target), "promote"),
                 jax.random.bits(jax.random.fold_in(key, 4), (),
                                 jnp.uint32),
-                N, 2)                                       # [N, 2]
+                N, 2, use_kernel=cfg.use_pallas_route)      # [N, 2]
             acc = jnp.zeros((N, 2), bool)
             for j in range(2):
                 p_j = chosen[:, j]
@@ -484,7 +484,7 @@ def make_dense_round(cfg: Config, churn: float = 0.0,
                 ep,
                 jax.random.bits(jax.random.fold_in(key, 31), (),
                                 jnp.uint32),
-                N, 2)
+                N, 2, use_kernel=cfg.use_pallas_route)
             for j in range(2):
                 o_j = rchosen[:, j]
                 demote.append(jnp.where((o_j >= 0)[:, None],

@@ -369,7 +369,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
         chosen = reverse_select(
             jnp.where(keep, flat_pos, -1),
             jax.random.bits(jax.random.fold_in(key, 6), (), jnp.uint32),
-            N, 4)                                          # [N, 4] walker ids
+            N, 4, use_kernel=cfg.use_pallas_route)         # [N, 4] walker ids
         # dedup same-subject proposals within a holder's admit list
         csubj = jnp.where(chosen >= 0, chosen // C, -1)    # [N, 4]
         earlier = jnp.tril(jnp.ones((4, 4), bool), k=-1)
@@ -405,7 +405,7 @@ def make_dense_scamp_round(cfg: Config, churn: float = 0.0,
           back = reverse_select(
               ev_subj,
               jax.random.bits(jax.random.fold_in(key, 7), (), jnp.uint32),
-              N, 4)
+              N, 4, use_kernel=cfg.use_pallas_route)
           for j in range(4):
               e_j = back[:, j]
               holder_j = jnp.where(e_j >= 0, e_j // 4, -1)

@@ -1,0 +1,235 @@
+"""Pallas kernels for the dense round's shard-local routing sorts
+(ISSUE 17 tentpole b, following the ``ops/rumor_kernel{,_hbm}.py``
+precedent).
+
+The sharded dense round's dominant shard-local work is two sorts per
+round: ``ops/shard_exchange.reverse_select`` (the packed single-key
+proposal router — promotion and shuffle each carry one) and the
+``bucket_exchange`` mail bucketing (stable argsort by destination
+shard + rank + pack).  In XLA each lowers to a multi-kernel
+sort/iota/scatter pipeline; here the pack -> sort -> rank chain runs
+as ONE ``pallas_call`` per primitive, shrinking both the HLO handed
+to XLA and the launch count.
+
+Sort strategy: a bitonic network over the composite key
+``(key, index)``.  The jnp reference uses ``jax.lax.sort`` with
+``num_keys=1`` and an index payload, which is STABLE — for equal keys
+the payload keeps ascending input order.  Sorting the composite
+``(key, index)`` lexicographically produces exactly that order (the
+index is unique), so the kernels are bit-identical to the reference by
+construction; the property tests in ``tests/test_route_kernel.py``
+pin it across shapes/salts.  Inputs pad to the next power of two with
+``key = 0xFFFFFFFF`` sentinels (every real reverse_select key fits
+31 bits — ``sk << bits`` keeps the top bit clear — and bucket shards
+fit ``log2(d)+1`` bits), so padding sorts strictly last.
+
+The rank leg reuses the reference's searchsorted-free recipe: bucket
+starts are where the sorted key changes; a log-doubling prefix max of
+start indices gives each element its bucket offset.  The final
+scatters (``out.at[flat].set``) stay OUTSIDE the kernels — each is a
+single XLA op with no conflict (targets are unique by construction),
+and keeping them out lets ``bucket_exchange`` feed its one
+``lax.all_to_all`` unchanged, preserving the dense collective budget
+{all-to-all: 1, all-reduce: 1, all-gather: 0}.
+
+``interpret=None`` auto-selects: compiled on TPU backends, interpret
+mode elsewhere (the CPU CI path).  The kernels are opt-in behind
+``Config.use_pallas_route``; flag-off callers never import this
+module, so the default programs stay byte-identical.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .bitset import mix32 as _mix
+
+__all__ = ["reverse_select_kernel", "bucket_pack_kernel",
+           "default_interpret"]
+
+
+def default_interpret(interpret: Optional[bool]) -> bool:
+    """Resolve the interpret flag: explicit value wins; None runs
+    compiled on TPU and interpret mode everywhere else."""
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _pow2_above(m: int) -> int:
+    p = 1
+    while p < m:
+        p *= 2
+    return p
+
+
+def _cummax(x: jax.Array) -> jax.Array:
+    """Inclusive prefix max of a non-negative int vector by
+    log-doubling shifts (no lax.cummax inside the kernel)."""
+    m = x.shape[0]
+    s = 1
+    # trace-lint: allow(unroll-bomb): log2(m) shift stages over the small static ring size — the doubling loop is the algorithm, not a hazard
+    while s < m:
+        shifted = jnp.concatenate(
+            [jnp.zeros((s,), x.dtype), x[: m - s]])
+        x = jnp.maximum(x, shifted)
+        s *= 2
+    return x
+
+
+def _cmpex(key: jax.Array, idx: jax.Array, j: int, k: int, M: int
+           ) -> Tuple[jax.Array, jax.Array]:
+    """One bitonic compare-exchange stage (stride ``j`` inside merge
+    blocks of size ``k``), lexicographic on ``(key, idx)``.  Partners
+    ``i`` and ``i ^ j`` sit in the two halves of a ``[M/2j, 2, j]``
+    reshape; direction flips with bit ``k`` of the flat position."""
+    kk = key.reshape(M // (2 * j), 2, j)
+    ii = idx.reshape(M // (2 * j), 2, j)
+    ka, kb = kk[:, 0], kk[:, 1]
+    ia, ib = ii[:, 0], ii[:, 1]
+    pos = (jax.lax.broadcasted_iota(jnp.int32, ka.shape, 0) * (2 * j)
+           + jax.lax.broadcasted_iota(jnp.int32, ka.shape, 1))
+    asc = (pos & k) == 0
+    gt = (ka > kb) | ((ka == kb) & (ia > ib))
+    swap = jnp.where(asc, gt, ~gt)
+    nka = jnp.where(swap, kb, ka)
+    nkb = jnp.where(swap, ka, kb)
+    nia = jnp.where(swap, ib, ia)
+    nib = jnp.where(swap, ia, ib)
+    return (jnp.stack([nka, nkb], axis=1).reshape(M),
+            jnp.stack([nia, nib], axis=1).reshape(M))
+
+
+def _bitonic(key: jax.Array, idx: jax.Array
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Full bitonic sort network, ascending lexicographic on
+    ``(key, idx)`` — the stable-sort-with-payload equivalent (module
+    docstring).  Static Python loops: log^2(M)/2 stages."""
+    M = key.shape[0]
+    k = 2
+    # trace-lint: allow(unroll-bomb): the bitonic network IS log^2(M)/2 static stages over the pow2-padded slot count — fixed, small, and intended
+    while k <= M:
+        j = k // 2
+        while j >= 1:
+            key, idx = _cmpex(key, idx, j, k, M)
+            j //= 2
+        k *= 2
+    return key, idx
+
+
+def _iota(dtype, m: int, off: int = 0) -> jax.Array:
+    """1-D iota via broadcasted_iota (a plain ``jnp.arange`` becomes a
+    captured trace-time constant inside a Pallas kernel; TPU also
+    rejects 1-D iota — pallas_guide)."""
+    x = jax.lax.broadcasted_iota(dtype, (m,), 0)
+    return x + dtype(off) if off else x
+
+
+def _rank_in_buckets(st: jax.Array) -> jax.Array:
+    """Offset of each element within its (sorted) bucket: the
+    reference's first-change + prefix-max recipe."""
+    m = st.shape[0]
+    i = _iota(jnp.int32, m)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), st[1:] != st[:-1]])
+    return i - _cummax(jnp.where(first, i, 0))
+
+
+# --------------------------------------------------------- reverse_select
+
+def _rs_kernel(targets_ref, salt_ref, flat_ref, order_ref,
+               *, n: int, c: int, m: int, M: int, bits: int):
+    t = targets_ref[...]
+    salt = salt_ref[0]
+    valid = (t >= 0) & (t < n)
+    sk = jnp.where(valid, t, n).astype(jnp.uint32)
+    r = _mix(_iota(jnp.uint32, m) ^ salt)
+    packed = (sk << bits) | (r >> (32 - bits))
+    idx = _iota(jnp.int32, m)
+    if M > m:
+        # sentinel keys sort strictly last (real keys fit 31 bits)
+        packed = jnp.concatenate(
+            [packed, jnp.full((M - m,), 0xFFFFFFFF, jnp.uint32)])
+        idx = jnp.concatenate([idx, _iota(jnp.int32, M - m, off=m)])
+    sp, order = _bitonic(packed, idx)
+    sp, order = sp[:m], order[:m]
+    st = (sp >> bits).astype(jnp.int32)
+    pos = _rank_in_buckets(st)
+    ok = (st < n) & (pos < c)
+    flat_ref[...] = jnp.where(ok, st * c + jnp.clip(pos, 0, c - 1), n * c)
+    order_ref[...] = order
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _rs_call(targets, salt, n: int, c: int, interpret: bool):
+    m = targets.shape[0]
+    bits = 31 - max(n.bit_length(), 1)
+    flat, order = pl.pallas_call(
+        functools.partial(_rs_kernel, n=n, c=c, m=m, M=_pow2_above(m),
+                          bits=bits),
+        out_shape=[jax.ShapeDtypeStruct((m,), jnp.int32)] * 2,
+        interpret=interpret,
+    )(targets, salt.reshape(1).astype(jnp.uint32))
+    out = jnp.full((n * c + 1,), -1, jnp.int32)
+    out = out.at[flat].set(order)
+    return out[: n * c].reshape((n, c))
+
+
+def reverse_select_kernel(targets: jax.Array, salt: jax.Array, n: int,
+                          c: int, interpret: Optional[bool] = None
+                          ) -> jax.Array:
+    """Kernel twin of ``ops/shard_exchange.reverse_select`` — same
+    contract, bit-identical output; one pallas_call for
+    pack+sort+rank, one XLA scatter for the emit."""
+    return _rs_call(targets, jnp.asarray(salt, jnp.uint32), n, c,
+                    default_interpret(interpret))
+
+
+# --------------------------------------------------------- bucket pack
+
+def _bp_kernel(shard_ref, tgt_ref, order_ref, dropped_ref,
+               *, d: int, b: int, m: int, M: int):
+    shard = shard_ref[...]
+    idx = _iota(jnp.int32, m)
+    key = shard.astype(jnp.uint32)
+    if M > m:
+        key = jnp.concatenate(
+            [key, jnp.full((M - m,), 0xFFFFFFFF, jnp.uint32)])
+        idx = jnp.concatenate([idx, _iota(jnp.int32, M - m, off=m)])
+    sk, order = _bitonic(key, idx)
+    sk, order = sk[:m].astype(jnp.int32), order[:m]
+    pos = _rank_in_buckets(sk)
+    ok = (sk < d) & (pos < b)
+    dropped_ref[...] = jnp.sum((sk < d) & ~ok).astype(jnp.int32).reshape(1)
+    tgt_ref[...] = jnp.where(ok, sk * b + jnp.clip(pos, 0, b - 1), d * b)
+    order_ref[...] = order
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _bp_call(shard, d: int, b: int, interpret: bool):
+    m = shard.shape[0]
+    tgt, order, dropped = pl.pallas_call(
+        functools.partial(_bp_kernel, d=d, b=b, m=m, M=_pow2_above(m)),
+        out_shape=[jax.ShapeDtypeStruct((m,), jnp.int32),
+                   jax.ShapeDtypeStruct((m,), jnp.int32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)],
+        interpret=interpret,
+    )(shard)
+    return tgt, order, dropped[0]
+
+
+def bucket_pack_kernel(shard: jax.Array, n_shards: int, bucket_cap: int,
+                       interpret: Optional[bool] = None
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Kernel twin of ``bucket_exchange``'s shard-local leg: stable
+    rank of every mail row into its destination-shard bucket.  Returns
+    ``(tgt [m], order [m], dropped scalar)`` — the caller scatters
+    ``mail[order]`` to ``tgt`` and runs the one all_to_all, exactly as
+    the jnp reference does."""
+    return _bp_call(shard, n_shards, bucket_cap,
+                    default_interpret(interpret))

@@ -35,7 +35,7 @@ pass the mesh axis NAME, so the module stays import-cycle-free.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,8 +43,9 @@ import jax.numpy as jnp
 from .bitset import mix32 as _mix
 
 
-def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
-                   ) -> jax.Array:
+def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int,
+                   use_kernel: bool = False,
+                   interpret: Optional[bool] = None) -> jax.Array:
     """Route per-node proposals to their targets without scatter
     conflicts: node i proposes to ``targets[i]`` (−1 = none); each target
     learns up to ``c`` proposers, ties broken (near-)uniformly at
@@ -60,9 +61,27 @@ def reverse_select(targets: jax.Array, salt: jax.Array, n: int, c: int
     scripts/profile_dense.py / profile_merge.py — the same lowering
     cliff lax.top_k hits).  Tiebreak width shrinks as n grows (14 bits
     at 2^16); within a target's ~c-proposer bucket, low-bit collisions
-    merely make a rare tie deterministic."""
+    merely make a rare tie deterministic.
+
+    ``use_kernel=True`` routes through the fused Pallas twin
+    (``ops/route_kernel.reverse_select_kernel`` — bit-identical,
+    ISSUE 17); False (the default) is the jnp reference and compiles
+    the byte-identical program it always did."""
     m = targets.shape[0]
-    assert n < (1 << 27), "packed reverse_select key needs n < 2^27"
+    if n >= (1 << 27):
+        # raised at BUILD time (trace time), not as a bare assert: an
+        # assert vanishes under ``python -O`` and gives no context from
+        # inside a traced build (ISSUE 17 satellite)
+        raise ValueError(
+            f"reverse_select: n={n} target ids do not fit the packed "
+            f"single-key sort — the uint32 key carries the target id in "
+            f"the high bits and needs n < 2^27 to keep >= 4 random "
+            f"tiebreak bits; shard the index space (route_select / the "
+            f"sharded dense round) instead of raising n")
+    if use_kernel:
+        from .route_kernel import reverse_select_kernel
+        return reverse_select_kernel(targets, salt, n, c,
+                                     interpret=interpret)
     bits = 31 - max(n.bit_length(), 1)
     valid = (targets >= 0) & (targets < n)
     sk = jnp.where(valid, targets, n).astype(jnp.uint32)
@@ -95,7 +114,9 @@ def default_bucket_cap(out_rows: int, n_shards: int) -> int:
 
 
 def bucket_exchange(mail: jax.Array, n_loc: int, n_shards: int,
-                    bucket_cap: int, axis: str
+                    bucket_cap: int, axis: str,
+                    use_kernel: bool = False,
+                    interpret: Optional[bool] = None
                     ) -> Tuple[jax.Array, jax.Array]:
     """Move a shard-local mail matrix to its destination shards in ONE
     ``lax.all_to_all`` (the PR-2 dataplane exchange, mail-matrix
@@ -107,20 +128,30 @@ def bucket_exchange(mail: jax.Array, n_loc: int, n_shards: int,
     ``recv`` is sender-shard-major (shard k's bucket at rows
     ``[k*B, (k+1)*B)``), empty slots all-zero (valid column 0);
     ``dropped`` counts rows head-capped out of a full bucket — the
-    caller accumulates it (never silent)."""
+    caller accumulates it (never silent).
+
+    ``use_kernel=True`` runs the shard-local sort+rank through the
+    fused Pallas twin (``ops/route_kernel.bucket_pack_kernel`` —
+    bit-identical); the one all_to_all below is shared by both paths,
+    so the collective budget never moves."""
     m = mail.shape[0]
     d, b = n_shards, bucket_cap
     valid = mail[:, 0] != 0
     dst = mail[:, 1]
     shard = jnp.where(valid, jnp.clip(dst, 0, d * n_loc - 1) // n_loc, d)
-    order = jnp.argsort(shard, stable=True)
-    sk = shard[order]
-    starts = jnp.searchsorted(sk, jnp.arange(d, dtype=sk.dtype))
-    pos = (jnp.arange(m, dtype=jnp.int32)
-           - starts[jnp.clip(sk, 0, d - 1)].astype(jnp.int32))
-    ok = (sk < d) & (pos < b)
-    dropped = jnp.sum((sk < d) & ~ok).astype(jnp.int32)
-    tgt = jnp.where(ok, sk * b + jnp.clip(pos, 0, b - 1), d * b)
+    if use_kernel:
+        from .route_kernel import bucket_pack_kernel
+        tgt, order, dropped = bucket_pack_kernel(
+            shard.astype(jnp.int32), d, b, interpret=interpret)
+    else:
+        order = jnp.argsort(shard, stable=True)
+        sk = shard[order]
+        starts = jnp.searchsorted(sk, jnp.arange(d, dtype=sk.dtype))
+        pos = (jnp.arange(m, dtype=jnp.int32)
+               - starts[jnp.clip(sk, 0, d - 1)].astype(jnp.int32))
+        ok = (sk < d) & (pos < b)
+        dropped = jnp.sum((sk < d) & ~ok).astype(jnp.int32)
+        tgt = jnp.where(ok, sk * b + jnp.clip(pos, 0, b - 1), d * b)
     buck = jnp.zeros((d * b + 1, mail.shape[1]), jnp.int32)
     buck = buck.at[tgt].set(mail[order])[: d * b]
     recv = jax.lax.all_to_all(
@@ -130,20 +161,28 @@ def bucket_exchange(mail: jax.Array, n_loc: int, n_shards: int,
 
 
 def route_select(kind: jax.Array, dst_local: jax.Array, valid: jax.Array,
-                 n_kinds: int, n_loc: int, cap: int, salt: jax.Array
-                 ) -> jax.Array:
+                 n_kinds: int, n_loc: int, cap: int, salt: jax.Array,
+                 use_kernel: bool = False,
+                 interpret: Optional[bool] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
     """Route an entire received mailbox to per-(kind, local node) slots
     with ONE shard-local sort: the combined key space ``kind * n_loc +
     dst_local`` collapses what the unsharded round did with one global
     N-element sort PER PHASE into a single per-shard sort per round.
-    Returns ``[n_kinds, n_loc, cap]`` row indices into the mailbox (−1
-    pad); per-kind caps below ``cap`` are taken by slicing columns.
-    Excess rows simply don't appear — callers count them as drops by
-    comparing against the kept-row total."""
+    Returns ``(sel [n_kinds, n_loc, cap], dropped scalar)``: ``sel``
+    holds row indices into the mailbox (−1 pad; per-kind caps below
+    ``cap`` are taken by slicing columns); ``dropped`` counts valid
+    rows that did NOT land a slot — cap overflow — like
+    :func:`bucket_exchange` does, so callers thread it into their
+    ``dropped`` metric instead of re-deriving it by comparison
+    (ISSUE 17 satellite: overflow is counted at the source, never
+    silent)."""
     tgt = jnp.where(valid & (kind >= 0) & (kind < n_kinds),
                     kind * n_loc + dst_local, -1)
-    sel = reverse_select(tgt, salt, n_kinds * n_loc, cap)
-    return sel.reshape(n_kinds, n_loc, cap)
+    sel = reverse_select(tgt, salt, n_kinds * n_loc, cap,
+                         use_kernel=use_kernel, interpret=interpret)
+    dropped = (jnp.sum(valid) - jnp.sum(sel >= 0)).astype(jnp.int32)
+    return sel.reshape(n_kinds, n_loc, cap), dropped
 
 
 def take_rows(mat: jax.Array, idx: jax.Array) -> jax.Array:

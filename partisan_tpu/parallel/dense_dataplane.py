@@ -467,7 +467,8 @@ def make_sharded_dense_round(
                 jnp.where(reset, contact, passive[:, 0]))
 
         # ---- deliver last round's mail: THE one all-to-all ----
-        recv, xdrop = bucket_exchange(st.mail, n_loc, d, b_cap, NODE_AXIS)
+        recv, xdrop = bucket_exchange(st.mail, n_loc, d, b_cap, NODE_AXIS,
+                                      use_kernel=cfg.use_pallas_route)
         rvalid = recv[:, 0] != 0
         rdst, rsrc, rkind, rpart = (recv[:, 1], recv[:, 2], recv[:, 3],
                                     recv[:, 4])
@@ -481,9 +482,11 @@ def make_sharded_dense_round(
                                 rp, rnd)
 
         # ---- ONE local sort routes the whole inbox ----
-        sel = route_select(rkind, dstl, keep, HV_KINDS, n_loc, sel_cap,
-                           s32(2))
-        kept = jnp.sum(keep)
+        # route_select now owns the overflow count (ISSUE 17 satellite):
+        # sel_drop is its cap-overflow scalar, not a caller-side diff
+        sel, sel_drop = route_select(rkind, dstl, keep, HV_KINDS, n_loc,
+                                     sel_cap, s32(2),
+                                     use_kernel=cfg.use_pallas_route)
         routed = jnp.sum(sel >= 0)
 
         blocks = []
@@ -687,7 +690,6 @@ def make_sharded_dense_round(
         assert mail.shape[1] == slots, (mail.shape, slots)
         mail = mail.reshape(n_loc * slots, MAIL_COLS)
         sent = jnp.sum(mail[:, 0])
-        sel_drop = kept - routed
 
         names = ["mail_sent", "mail_processed", "mail_dropped", "live",
                  "lonely"]
@@ -869,7 +871,8 @@ def _make_sharded_scamp_round(cfg: Config, mesh, *, churn=0.0,
             # backdate so the resub fold below re-joins immediately
             last_join = jnp.where(reset, rnd - join_patience, last_join)
 
-        recv, xdrop = bucket_exchange(st.mail, n_loc, d, b_cap, NODE_AXIS)
+        recv, xdrop = bucket_exchange(st.mail, n_loc, d, b_cap, NODE_AXIS,
+                                      use_kernel=cfg.use_pallas_route)
         rvalid = recv[:, 0] != 0
         rdst, rsrc, rkind, rpart = (recv[:, 1], recv[:, 2], recv[:, 3],
                                     recv[:, 4])
@@ -881,9 +884,9 @@ def _make_sharded_scamp_round(cfg: Config, mesh, *, churn=0.0,
             fring = _flight_tap(fring, flight, keep, rsrc, rdst, rkind,
                                 rp, rnd)
 
-        sel = route_select(rkind, dstl, keep, SCAMP_KINDS, n_loc,
-                           sel_cap, s32(2))
-        kept = jnp.sum(keep)
+        sel, sel_drop = route_select(rkind, dstl, keep, SCAMP_KINDS,
+                                     n_loc, sel_cap, s32(2),
+                                     use_kernel=cfg.use_pallas_route)
         routed = jnp.sum(sel >= 0)
 
         blocks = []
@@ -981,7 +984,6 @@ def _make_sharded_scamp_round(cfg: Config, mesh, *, churn=0.0,
         assert mail.shape[1] == slots, (mail.shape, slots)
         mail = mail.reshape(n_loc * slots, MAIL_COLS)
         sent = jnp.sum(mail[:, 0])
-        sel_drop = kept - routed
 
         names = ["mail_sent", "mail_processed", "mail_dropped", "live",
                  "resubs"]

@@ -82,6 +82,14 @@ COUNT_EVENTS: Dict[str, str] = {
     "/jax/compilation_cache/compile_requests_use_cache": "cache_request",
 }
 
+#: AOT plane events (ISSUE 17) — recorded explicitly via
+#: :meth:`CompileLedger.record_aot`, not jax.monitoring:
+#: ``aot_export`` (artifact built, duration = export+compile wall),
+#: ``aot_load`` (artifact adopted, duration = deserialize+first-call
+#: wall — the number that replaces a cold compile), ``aot_stale``
+#: (artifact rejected with a NAMED ``reason`` — never silent).
+AOT_EVENTS: Tuple[str, ...] = ("aot_export", "aot_load", "aot_stale")
+
 #: Prometheus families the ledger feeds through TelemetrySink.write_row
 #: (counter deltas; PrometheusSink accumulates into *_total samples).
 LEDGER_SPECS: Tuple[MetricSpec, ...] = (
@@ -197,8 +205,12 @@ class CompileLedger:
         if self._enabled and short is not None:
             self._record(short, None)
 
-    def _record(self, short: str, duration: Optional[float]) -> None:
-        program, fingerprint = self._current()
+    def _record(self, short: str, duration: Optional[float],
+                program: Optional[str] = None,
+                fingerprint: Optional[str] = None,
+                reason: Optional[str] = None) -> None:
+        if program is None:
+            program, fingerprint = self._current()
         row: Dict[str, Any] = {
             "event": short, "t_wall": time.time(), "seq": self._seq,
             "run": self.run_id, "program": program,
@@ -208,6 +220,8 @@ class CompileLedger:
             row["duration_s"] = duration
         if fingerprint is not None:
             row["fingerprint"] = fingerprint
+        if reason is not None:
+            row["reason"] = reason
         self.rows.append(row)
         if self._f is not None:
             self._f.write(json.dumps(row) + "\n")
@@ -217,6 +231,20 @@ class CompileLedger:
             srow = mk(duration)
             for s in self.sinks:
                 s.write_row(srow)
+
+    def record_aot(self, event: str, program: str,
+                   duration: Optional[float] = None,
+                   reason: Optional[str] = None,
+                   fingerprint: Optional[str] = None) -> None:
+        """Record an AOT-plane row (``aot_export`` / ``aot_load`` /
+        ``aot_stale`` — :data:`AOT_EVENTS`) attributed to ``program``
+        explicitly (no :meth:`attribute` scope needed; staleness often
+        fires before any compile scope exists)."""
+        if event not in AOT_EVENTS:
+            raise ValueError(f"unknown AOT event {event!r}; "
+                             f"expected one of {AOT_EVENTS}")
+        self._record(event, duration, program=program,
+                     fingerprint=fingerprint, reason=reason)
 
     # ----------------------------------------------------------- queries
 
@@ -582,6 +610,7 @@ def ledger_report(rows: Sequence[Mapping[str, Any]], top: int = 10
     the mean of earlier runs)."""
     per: Dict[str, Dict[str, Any]] = {}
     runs: Dict[str, Dict[str, float]] = {}
+    aot: Dict[str, Dict[str, Any]] = {}
     hits = misses = 0
     for r in rows:
         prog = r.get("program") or "unattributed"
@@ -601,6 +630,18 @@ def ledger_report(rows: Sequence[Mapping[str, Any]], top: int = 10
             misses += 1
         elif ev == "compile_time_saved":
             d["saved_s"] += r.get("duration_s", 0.0)
+        elif ev in ("aot_load", "aot_stale", "aot_export"):
+            a = aot.setdefault(prog, {"loads": 0, "aot_load_s": 0.0,
+                                      "stale": 0, "exports": 0,
+                                      "last_reason": None})
+            if ev == "aot_load":
+                a["loads"] += 1
+                a["aot_load_s"] += r.get("duration_s", 0.0)
+            elif ev == "aot_stale":
+                a["stale"] += 1
+                a["last_reason"] = r.get("reason")
+            else:
+                a["exports"] += 1
     lines = ["compile observatory report", "=" * 26]
     total = hits + misses
     rate = (100.0 * hits / total) if total else float("nan")
@@ -616,6 +657,26 @@ def ledger_report(rows: Sequence[Mapping[str, Any]], top: int = 10
             f"  {d['compile_s']:8.2f}s  {prog}  "
             f"(compiles={d['compiles']} hits={d['hits']} "
             f"misses={d['misses']} saved={d['saved_s']:.2f}s)")
+    # AOT plane (ISSUE 17): load-instead-of-compile wall clock, per
+    # program — aot_load_seconds next to the compile_seconds it replaced
+    if aot:
+        lines.append("")
+        lines.append("aot artifacts (aot_load_seconds vs compile_seconds):")
+        lines.append(f"  {'program':<34} {'aot_load_s':>10} "
+                     f"{'compile_s':>10} {'speedup':>8}  loads/stale")
+        for prog in sorted(aot):
+            a = aot[prog]
+            load_s = (a["aot_load_s"] / a["loads"]) if a["loads"] else 0.0
+            comp = per.get(prog, {})
+            comp_s = ((comp.get("compile_s", 0.0) / comp["compiles"])
+                      if comp.get("compiles") else 0.0)
+            speed = (f"{comp_s / load_s:7.1f}x"
+                     if load_s > 0 and comp_s > 0 else "      —")
+            lines.append(
+                f"  {prog:<34} {load_s:>10.2f} {comp_s:>10.2f} "
+                f"{speed:>8}  {a['loads']}/{a['stale']}")
+            if a["stale"] and a["last_reason"]:
+                lines.append(f"      last stale: {a['last_reason']}")
     # trend: latest run vs the mean of prior runs, per program
     if len(runs) >= 2:
         order = sorted(runs)  # run ids are millisecond-hex: sortable

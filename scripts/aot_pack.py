@@ -1,0 +1,148 @@
+"""Build / verify the AOT artifact bundle (ISSUE 17 tentpole CLI).
+
+``--build`` exports every flagship entrypoint (``verify/lint/
+fingerprint.FLAGSHIP``) into ``aot_artifacts/``: the serialized
+``jax.export`` blob, the pickled treedefs, and the persistent-cache
+entry of the deserialized program keyed against the canonical
+``.jax_cache`` path (see ``partisan_tpu/aot.py`` for why the path is
+part of the key).  Each export pays the program's one real compile —
+budget ~5-30 min for the full bundle on this box (the explorer checker
+dominates); ``--entry`` narrows the pass.
+
+``--verify`` is the bundle gate (suite_matrix ``perf/aot/
+cold_start_gate``): for every manifest entry it retraces the flagship
+twin, checks the module hash against the manifest (NAMED staleness on
+drift), executes the deserialized program AND the twin, and fails
+unless every output leaf is bit-identical.  Exit 1 on any failure.
+
+Both modes attribute through the compile ledger
+(``COMPILE_ledger.jsonl``): ``aot_export`` / ``aot_load`` /
+``aot_stale`` rows, so ``scripts/observatory.py --report`` shows the
+saved wall-clock as a tracked number.
+
+Usage:
+  python scripts/aot_pack.py --build  [--entry NAME ...]
+  python scripts/aot_pack.py --verify [--entry NAME ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LEDGER = os.path.join(REPO, "COMPILE_ledger.jsonl")
+
+
+def _jax_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--build", action="store_true",
+                      help="export flagship programs into the bundle")
+    mode.add_argument("--verify", action="store_true",
+                      help="prove every artifact executes bit-identical "
+                           "to its freshly-traced twin")
+    ap.add_argument("--entry", action="append", default=None,
+                    metavar="NAME", help="restrict to these entrypoints")
+    ap.add_argument("--art-dir", default=None,
+                    help="bundle dir (default <repo>/aot_artifacts)")
+    ap.add_argument("--ledger", default=LEDGER)
+    args = ap.parse_args(argv)
+
+    _jax_env()
+    from partisan_tpu import aot
+    from partisan_tpu.telemetry import observatory as obs
+    from partisan_tpu.verify.lint.fingerprint import FLAGSHIP
+
+    names = args.entry
+    if names:
+        unknown = set(names) - set(FLAGSHIP)
+        if unknown:
+            print(f"aot_pack: unknown entrypoints {sorted(unknown)}; "
+                  f"known: {sorted(FLAGSHIP)}", file=sys.stderr)
+            return 2
+
+    obs.configure_cache(aot.canonical_cache_dir(), record_all=True)
+    ledger = obs.CompileLedger(path=args.ledger, mode="a").install()
+    t0 = time.time()
+
+    if args.build:
+        built = {}
+        for name in sorted(FLAGSHIP):
+            if names and name not in names:
+                continue
+            t1 = time.time()
+            print(f"  build {name} ...", flush=True)
+            fn, a = FLAGSHIP[name]()
+            with ledger.attribute(name):
+                entry = aot.export_entry(name, fn, a,
+                                         art_dir=args.art_dir,
+                                         ledger=ledger)
+            built[name] = entry
+            print(f"  build {name}: {time.time() - t1:.1f}s "
+                  f"module={entry['module_hash']} "
+                  f"files={sorted(entry['files'].values())}", flush=True)
+        print(f"aot_pack --build: {len(built)} artifacts -> "
+              f"{args.art_dir or aot.artifact_dir()} "
+              f"({time.time() - t0:.1f}s)")
+        ledger.close()
+        return 0
+
+    # --verify
+    manifest = aot.read_manifest(args.art_dir)
+    if manifest is None:
+        print(f"aot_pack --verify: no bundle manifest at "
+              f"{args.art_dir or aot.artifact_dir()}", file=sys.stderr)
+        return 1
+    failures = []
+    for name in sorted(manifest.get("entries", {})):
+        if names and name not in names:
+            continue
+        t1 = time.time()
+        if name not in FLAGSHIP:
+            # bench-side exports (e.g. the dense_scale `aot` arm) have
+            # no registry twin to retrace, so bit-identity can't be
+            # re-proven here — but the artifact still has to pass the
+            # full load gauntlet (env keys, file sha256s, deserialize)
+            try:
+                with ledger.attribute(name):
+                    aot.load(name, art_dir=args.art_dir, ledger=ledger)
+                print(f"  LOAD {name}: integrity ok — no flagship twin, "
+                      f"bit-identity proven at export time "
+                      f"({time.time() - t1:.1f}s)", flush=True)
+            except aot.AotStale as e:
+                failures.append(name)
+                print(f"  FAIL {name}: {e}", flush=True)
+            continue
+        try:
+            with ledger.attribute(name):
+                res = aot.verify_entry(name, art_dir=args.art_dir,
+                                       ledger=ledger)
+            print(f"  PASS {name}: bit-identical "
+                  f"({res['leaves']} leaves; load+call "
+                  f"{res['load_call_s']}s vs twin exec "
+                  f"{res['twin_exec_s']}s; {time.time() - t1:.1f}s total)",
+                  flush=True)
+        except (aot.AotStale, AssertionError) as e:
+            failures.append(name)
+            print(f"  FAIL {name}: {e}", flush=True)
+    verdict = "PASS" if not failures else f"FAIL ({sorted(failures)})"
+    print(f"aot_pack --verify: {verdict} ({time.time() - t0:.1f}s)")
+    ledger.close()
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,7 +15,11 @@ against the committed ``COMPILE_goldens.json`` and the warm
   compiles each entrypoint once, which also warms the cache entry the
   new golden pins (``scripts/warm_cache.py`` is the bless-free warmer).
 * ``--report`` — human summary over the accumulated ledger: top compile
-  costs, cache hit rate, per-entrypoint trend across runs.
+  costs, cache hit rate, per-entrypoint trend across runs, and (ISSUE
+  17) the AOT artifact table — ``aot_load_seconds`` vs
+  ``compile_seconds`` per program, so the wall-clock the export plane
+  saves is a tracked number, with the last named ``aot_stale`` reason
+  per program.
 
 The persistent-cache write thresholds are dropped to zero for the gate
 process (``observatory.configure_cache``): the ``cache_misses``

@@ -1526,6 +1526,51 @@ def compile_ledger_gate_test():
         obs.restore_cache(prev)
 
 
+def aot_roundtrip_test():
+    """ISSUE 17: the AOT export plane round trip — serialize ->
+    deserialize -> execute the engine step at n=8 and compare every
+    output leaf (state AND metrics) bitwise against the freshly-traced
+    twin.  Uses the same program name as tests/test_aot.py so the
+    persistent cache entry is shared; the flagship-shape equivalent is
+    the cold_start_gate row."""
+    import tempfile
+    from partisan_tpu import aot
+
+    def build():
+        from partisan_tpu.models.hyparview import HyParView
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, shuffle_interval=5,
+                        seed=3)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        return pt.make_step(cfg, proto, donate=False), (world,)
+
+    name = "aot_test_engine_step_n8"
+    with tempfile.TemporaryDirectory() as art:
+        fn, args = build()
+        aot.export_entry(name, fn, args, art_dir=art)
+        rec = aot.verify_entry(name, art_dir=art, registry={name: build})
+        assert rec["bit_identical"], rec
+
+
+def cold_start_gate():
+    """ISSUE 17 gate: ``scripts/aot_pack.py --verify`` over the
+    committed bundle manifest — every flagship artifact must
+    deserialize and execute bit-identical to its freshly-traced twin.
+    Fails NAMED when the bundle is absent (build it with
+    ``python scripts/aot_pack.py --build``)."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifest = os.path.join(repo, "aot_artifacts", "MANIFEST.json")
+    assert os.path.exists(manifest), (
+        "no aot_artifacts/MANIFEST.json — the bundle gate needs the "
+        "built bundle (python scripts/aot_pack.py --build)")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "aot_pack.py"),
+         "--verify"], capture_output=True, text=True, timeout=3000)
+    assert proc.returncode == 0, \
+        (proc.stdout or "") + (proc.stderr or "")
+
+
 def span_parity_test():
     """ISSUE 16 tentpole contract: the message lifecycle tracer records
     the SAME span-event multiset (EXCHANGED excluded — it only exists
@@ -1832,6 +1877,14 @@ def build_matrix():
         "engine", span_parity_test)
     add("observability/tracer", "alert_smoke", "hyparview", "engine",
         alert_smoke)
+
+    # ISSUE 17: the AOT export plane — small-shape round-trip
+    # bit-identity and the committed-bundle gate (scripts/aot_pack.py
+    # --verify over aot_artifacts/MANIFEST.json)
+    add("perf/aot", "aot_roundtrip_test", "hyparview", "engine",
+        aot_roundtrip_test)
+    add("perf/aot", "cold_start_gate", "hyparview", "engine",
+        cold_start_gate)
 
     return M
 

@@ -17,7 +17,18 @@ Write thresholds are dropped to zero (``observatory.configure_cache``)
 so even sub-2s programs land in the cache — the suite's own threshold
 (2.0s in conftest) only governs what TESTS write, not what they read.
 
+AOT plane (ISSUE 17): with ``--aot auto`` (default), an entrypoint
+whose ``aot_artifacts/`` bundle entry is fresh (module hash matches
+the lowered program) is LOADED — deserialize + one call through the
+shipped cache entry, seconds — instead of compiled, and the verdict
+prints ``aot-loaded``.  A missing or stale artifact falls back to
+compile AND exports a fresh artifact (compile-and-export), so the
+warm pass doubles as the bundle rebuilder.  ``--aot off`` restores
+the PR-14 behavior exactly.  Every leg is ledgered (``aot_load`` /
+``aot_stale`` / ``aot_export`` rows next to the compile rows).
+
 Usage:  python scripts/warm_cache.py [--entry NAME ...] [--ledger PATH]
+                                     [--aot auto|off|load-only]
 """
 
 from __future__ import annotations
@@ -65,9 +76,16 @@ def main(argv=None) -> int:
                     metavar="NAME", help="warm only these entrypoints")
     ap.add_argument("--ledger", default=LEDGER)
     ap.add_argument("--cache-dir", default=CACHE)
+    ap.add_argument("--aot", choices=("auto", "off", "load-only"),
+                    default="auto",
+                    help="auto: load fresh artifacts, compile-and-export"
+                         " stale/missing ones; off: always compile; "
+                         "load-only: load fresh artifacts, compile "
+                         "stale ones WITHOUT re-exporting")
     args = ap.parse_args(argv)
 
     _jax_env()
+    from partisan_tpu import aot
     from partisan_tpu.telemetry import observatory as obs
     from partisan_tpu.verify.lint.fingerprint import FLAGSHIP
 
@@ -84,10 +102,28 @@ def main(argv=None) -> int:
     ledger = obs.CompileLedger(path=args.ledger, mode="a").install()
 
     t0 = time.time()
-    warmed = loaded = 0
+    warmed = loaded = aot_loaded = exported = 0
     for name in order:
         t1 = time.time()
-        lowered, rec = obs.measure_entry(FLAGSHIP[name])
+        fn, fargs = FLAGSHIP[name]()
+
+        # ---- AOT fast path: fresh artifact -> load, never trace ----
+        if args.aot != "off":
+            prog = aot.maybe_load(name, cache_dir=args.cache_dir,
+                                  ledger=ledger)
+            if prog is not None and prog.matches(fargs):
+                import jax
+                jax.block_until_ready(prog(*fargs))
+                dt = time.time() - t1
+                ledger.record_aot("aot_load", name, duration=dt,
+                                  fingerprint=prog.module_hash)
+                aot_loaded += 1
+                print(f"  [tier {ENTRY_TIERS.get(name, '?')}] {name}: "
+                      f"aot-loaded ({dt:.1f}s, "
+                      f"module={prog.module_hash})", flush=True)
+                continue
+
+        lowered, rec = obs.measure_entry(lambda: (fn, fargs))
         with ledger.attribute(name, fingerprint=rec["module_hash"]):
             lowered.compile()
         hits = ledger.hits(name)
@@ -95,11 +131,20 @@ def main(argv=None) -> int:
         verdict = "cached" if misses == 0 and hits > 0 else "compiled"
         warmed += int(verdict == "compiled")
         loaded += int(verdict == "cached")
+        if args.aot == "auto":
+            # compile-and-export: the warm pass rebuilds the bundle for
+            # the entry it just paid the compile for
+            with ledger.attribute(name, fingerprint=rec["module_hash"]):
+                aot.export_entry(name, fn, fargs,
+                                 cache_dir=args.cache_dir, ledger=ledger)
+            exported += 1
+            verdict += "+exported"
         print(f"  [tier {ENTRY_TIERS.get(name, '?')}] {name}: {verdict} "
               f"({time.time() - t1:.1f}s, hits={hits} misses={misses}, "
               f"module={rec['module_hash']})", flush=True)
-    print(f"warm_cache: {loaded} served from cache, {warmed} compiled "
-          f"fresh -> {args.cache_dir} ({time.time() - t0:.1f}s); "
+    print(f"warm_cache: {aot_loaded} aot-loaded, {loaded} served from "
+          f"cache, {warmed} compiled fresh ({exported} exported) -> "
+          f"{args.cache_dir} ({time.time() - t0:.1f}s); "
           f"ledger -> {args.ledger}")
     ledger.close()
     return 0

@@ -62,12 +62,16 @@ _RUN_LAST_4 = ("tests/test_control.py",)
 _RUN_LAST_5 = ("tests/test_trace_lint.py",)
 # tier 6: the ISSUE-14 compile observatory
 _RUN_LAST_6 = ("tests/test_observatory.py",)
-# tier 7: the ISSUE-16 message lifecycle tracer is the newest of all
+# tier 7: the ISSUE-16 message lifecycle tracer
 _RUN_LAST_7 = ("tests/test_tracer.py",)
+# tier 8: the ISSUE-17 AOT plane + Pallas route kernels are the newest
+_RUN_LAST_8 = ("tests/test_aot.py", "tests/test_route_kernel.py")
 
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_8):
+            return 8
         if any(k in it.nodeid for k in _RUN_LAST_7):
             return 7
         if any(k in it.nodeid for k in _RUN_LAST_6):

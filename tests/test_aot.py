@@ -1,0 +1,281 @@
+"""ISSUE 17 tentpole a: the AOT export plane (partisan_tpu/aot.py).
+
+Round-trip contract: serialize -> deserialize -> execute must be
+bit-equal — states AND metrics — to the freshly-traced twin, proven
+here for the engine step and the sharded dataplane round at SMALL
+shapes (n=8 / n=16x8; the flagship shapes go through
+``scripts/aot_pack.py --verify``, which uses the same
+:func:`aot.verify_entry`).  Staleness is NAMED, never silent: every
+perturbation of the manifest (module hash, device count, mesh shape,
+corrupt file, missing entry) must raise :class:`AotStale` with a
+human reason AND emit an ``aot_stale`` event through the ledger.
+
+The module-scoped bundle fixture exports both programs once into a tmp
+dir against the repo's canonical ``.jax_cache``, so reruns are
+persistent-cache loads, not compiles.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from partisan_tpu import aot
+
+# --------------------------------------------------------- tiny registry
+
+
+def _build_engine():
+    import partisan_tpu as pt
+    from partisan_tpu.models.hyparview import HyParView
+    cfg = pt.Config(n_nodes=8, inbox_cap=8, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    world = pt.init_world(cfg, proto)
+    return pt.make_step(cfg, proto, donate=False), (world,)
+
+
+def _build_sharded():
+    import partisan_tpu as pt
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                 make_sharded_step)
+    from partisan_tpu.parallel.mesh import make_mesh
+    cfg = pt.Config(n_nodes=16, inbox_cap=8, shuffle_interval=5, seed=3)
+    proto = HyParView(cfg)
+    mesh = make_mesh(n_devices=8)
+    world = init_sharded_world(cfg, proto, mesh)
+    return make_sharded_step(cfg, proto, mesh, donate=False), (world,)
+
+
+REG = {
+    "aot_test_engine_step_n8": _build_engine,
+    "aot_test_sharded_round_n16x8": _build_sharded,
+}
+
+
+class FakeLedger:
+    """Duck-typed ledger capturing record_aot rows (the real
+    CompileLedger path is covered in test_ledger_rows below)."""
+
+    def __init__(self):
+        self.rows = []
+
+    def record_aot(self, event, program, duration=None, reason=None,
+                   fingerprint=None):
+        self.rows.append({"event": event, "program": program,
+                          "reason": reason, "fingerprint": fingerprint})
+
+    def stale_reasons(self, program):
+        return [r["reason"] for r in self.rows
+                if r["event"] == "aot_stale" and r["program"] == program]
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    art = str(tmp_path_factory.mktemp("aot_bundle"))
+    for name, build in REG.items():
+        fn, args = build()
+        aot.export_entry(name, fn, args, art_dir=art)
+    return art
+
+
+def _leaves_equal(got, ref):
+    got_l = jax.tree_util.tree_leaves(got)
+    ref_l = jax.tree_util.tree_leaves(ref)
+    assert len(got_l) == len(ref_l)
+    for i, (a, b) in enumerate(zip(got_l, ref_l)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype and a.shape == b.shape, f"leaf {i}"
+        np.testing.assert_array_equal(a, b, err_msg=f"leaf {i}")
+
+
+# ------------------------------------------------------------ round trip
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("name", sorted(REG))
+    def test_bit_equal_states_and_metrics(self, bundle, name):
+        fn, args = REG[name]()
+        prog = aot.load(name, art_dir=bundle)
+        assert prog.matches(args)
+        got = prog(*args)
+        ref = fn(*args)
+        # (world, metrics) both ways: states AND metrics bit-equal
+        _leaves_equal(got, ref)
+
+    def test_verify_entry(self, bundle):
+        rec = aot.verify_entry("aot_test_engine_step_n8", art_dir=bundle,
+                               registry=REG)
+        assert rec["bit_identical"] is True
+        assert rec["leaves"] > 0
+
+    def test_adopt_picks_matching_entry(self, bundle):
+        _, args = REG["aot_test_sharded_round_n16x8"]()
+        hit = aot.adopt(args, art_dir=bundle)
+        assert hit is not None
+        name, prog = hit
+        assert name == "aot_test_sharded_round_n16x8"
+        assert prog.matches(args)
+
+    def test_attach_adopts_then_runs(self, bundle):
+        name = "aot_test_engine_step_n8"
+        fn, args = REG[name]()
+        calls = []
+
+        def fallback(*a):
+            calls.append(1)
+            return fn(*a)
+
+        run = aot.attach(name, fallback, art_dir=bundle)
+        got = run(*args)
+        assert run.aot_state["prog"] is not None
+        assert not calls  # the artifact served the call, not the twin
+        _leaves_equal(got, fn(*args))
+
+
+# ------------------------------------------------------------- staleness
+
+
+def _edit_manifest(art, fn):
+    path = os.path.join(art, aot.MANIFEST_BASENAME)
+    with open(path, encoding="utf-8") as f:
+        m = json.load(f)
+    fn(m)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(m, f)
+    return m
+
+
+class TestStaleness:
+    NAME = "aot_test_engine_step_n8"
+
+    def _copy_bundle(self, bundle, tmp_path):
+        import shutil
+        art = str(tmp_path / "bundle")
+        shutil.copytree(bundle, art)
+        return art
+
+    def test_missing_entry_named_and_ledgered(self, bundle):
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="no artifact for"):
+            aot.load("no_such_program", art_dir=bundle, ledger=led)
+        assert led.stale_reasons("no_such_program")
+
+    def test_module_hash_drift(self, bundle):
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="module hash drift"):
+            aot.load(self.NAME, art_dir=bundle,
+                     expect_module_hash="0" * 16, ledger=led)
+        reasons = led.stale_reasons(self.NAME)
+        assert reasons and "rebless" in reasons[0].replace("-", "")
+
+    def test_device_count_mismatch(self, bundle, tmp_path):
+        art = self._copy_bundle(bundle, tmp_path)
+        _edit_manifest(art, lambda m: m.update(device_count=4))
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="device_count mismatch"):
+            aot.load(self.NAME, art_dir=art, ledger=led)
+        assert led.stale_reasons(self.NAME)
+
+    def test_mesh_shape_mismatch(self, bundle, tmp_path):
+        art = self._copy_bundle(bundle, tmp_path)
+
+        def bump(m):
+            m["entries"]["aot_test_sharded_round_n16x8"]["mesh_shape"] \
+                = [16]
+        _edit_manifest(art, bump)
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="mesh shape"):
+            aot.load("aot_test_sharded_round_n16x8", art_dir=art,
+                     ledger=led)
+        assert led.stale_reasons("aot_test_sharded_round_n16x8")
+
+    def test_corrupt_blob(self, bundle, tmp_path):
+        art = self._copy_bundle(bundle, tmp_path)
+        m = aot.read_manifest(art)
+        blob = os.path.join(art, m["entries"][self.NAME]["files"]["export"])
+        with open(blob, "r+b") as f:
+            f.seek(10)
+            b = f.read(1)
+            f.seek(10)
+            f.write(bytes([b[0] ^ 0xFF]))
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="corrupt"):
+            aot.load(self.NAME, art_dir=art, ledger=led)
+        assert led.stale_reasons(self.NAME)
+
+    def test_cache_dir_mismatch(self, bundle, tmp_path):
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="cache_dir mismatch"):
+            aot.load(self.NAME, art_dir=bundle,
+                     cache_dir=str(tmp_path / "elsewhere"), ledger=led)
+        assert led.stale_reasons(self.NAME)
+
+    def test_no_bundle_is_named_but_not_ledgered(self, tmp_path):
+        led = FakeLedger()
+        with pytest.raises(aot.AotStale, match="no artifact bundle"):
+            aot.load(self.NAME, art_dir=str(tmp_path / "empty"),
+                     ledger=led)
+        # absence of any bundle is a normal cold state, not staleness
+        assert not led.rows
+
+    def test_maybe_load_collapses_to_none(self, bundle):
+        assert aot.maybe_load("no_such_program", art_dir=bundle) is None
+
+    def test_attach_falls_back_on_stale(self, bundle):
+        fn, args = REG[self.NAME]()
+        calls = []
+
+        def fallback(*a):
+            calls.append(1)
+            return fn(*a)
+
+        run = aot.attach("no_such_program", fallback, art_dir=bundle)
+        run(*args)
+        assert calls == [1]
+        assert run.aot_state["prog"] is None
+
+    def test_attach_gate_vetoes_adoption(self, bundle):
+        fn, args = REG[self.NAME]()
+        run = aot.attach(self.NAME, fn, art_dir=bundle,
+                         gate=lambda prog, a: False)
+        _leaves_equal(run(*args), fn(*args))
+        assert run.aot_state["prog"] is None
+
+
+# -------------------------------------------------------- ledger surface
+
+
+class TestLedgerRows:
+    def test_aot_events_reach_jsonl_and_report(self, bundle, tmp_path):
+        from partisan_tpu.telemetry import observatory as obs
+        path = str(tmp_path / "ledger.jsonl")
+        led = obs.CompileLedger(path=path, mode="w").install()
+        try:
+            led.record_aot("aot_load", "aot_test_engine_step_n8",
+                           duration=1.5, fingerprint="abc")
+            with pytest.raises(aot.AotStale):
+                aot.load("no_such_program", art_dir=bundle, ledger=led)
+        finally:
+            led.close()
+        rows = [json.loads(l) for l in open(path)]
+        events = {r.get("event") for r in rows}
+        assert "aot_load" in events and "aot_stale" in events
+        stale = [r for r in rows if r.get("event") == "aot_stale"][0]
+        assert "no artifact" in stale["reason"]
+        report = obs.ledger_report(obs.read_ledger(path))
+        assert "aot artifacts" in report
+        assert "aot_test_engine_step_n8" in report
+
+    def test_record_aot_rejects_unknown_event(self, tmp_path):
+        from partisan_tpu.telemetry import observatory as obs
+        led = obs.CompileLedger(path=str(tmp_path / "l.jsonl"),
+                                mode="w").install()
+        try:
+            with pytest.raises(ValueError):
+                led.record_aot("aot_frobnicate", "x")
+        finally:
+            led.close()

@@ -51,11 +51,31 @@ class TestDistance:
         assert vals and all(v == 5 for v in vals), vals
 
     def test_disabled_by_default_flag(self):
-        cfg, proto, world, step = boot(enabled=False)
-        for _ in range(16):
-            world, _ = step(world)
-        for node in range(cfg.n_nodes):
-            assert distances(world, node) == {}
+        """Lowered-text twin of the executed 16-round empty-distances
+        check (41.4 s per cold session from PR 2 through PR 16; the
+        ENABLED plane still executes above in
+        test_rtt_measured_two_rounds / test_delay_inflates_rtt).
+        distances() stays empty because ?DISTANCE_ENABLED gates the
+        plane at TRACE time: the disabled program must be byte-
+        identical regardless of distance_interval (the ping plane is
+        dead code — no emission or interval arithmetic compiles in at
+        all, so no pong, no RTT row, ever), lower deterministically,
+        and differ from the enabled program (the flag is baked in, not
+        a runtime branch that could flip)."""
+        def text(enabled, interval):
+            cfg = pt.Config(n_nodes=8, inbox_cap=16,
+                            distance_enabled=enabled,
+                            distance_interval=interval)
+            proto = Stacked(HyParView(cfg), Distance(cfg))
+            world = pt.init_world(cfg, proto)
+            return pt.make_step(cfg, proto,
+                                donate=False).lower(world).as_text()
+
+        off = text(False, 4)
+        assert off == text(False, 7), \
+            "disabled plane leaked distance_interval into the program"
+        assert off == text(False, 4), "lowering is not deterministic"
+        assert off != text(True, 4)  # the flag IS compiled in
 
 
 class TestNestedStack:

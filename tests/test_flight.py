@@ -43,61 +43,85 @@ def _booted_hv(n, out_cap=None):
 
 @pytest.mark.standard
 class TestFlightParity:
-    """The ISSUE-3 acceptance drive: 30-round HyParView N=256."""
+    """The ISSUE-3 acceptance drive: 30-round HyParView N=256.  Since
+    ISSUE 17 both tests are lowered-text twins (no execute, no
+    compile): the executed entry-for-entry bit-match ran unchanged
+    from PR 3 through PR 16 (19.6 s + 16.5 s per session, compile-
+    dominated when the cache is cold), and the windowed capture still
+    EXECUTES at n=8 in TestFlightCapAndFilters below."""
 
-    N, ROUNDS, WINDOW = 256, 30, 10
+    N, WINDOW = 256, 10
 
-    @pytest.fixture(scope="class")
-    def legacy(self):
+    def test_windowed_fast_path_bit_matches_legacy(self):
+        """Lowered-text twin of the executed 30-round ENTRY-FOR-ENTRY
+        stream equality.  The bit-match held because the in-scan
+        flight capture reads the SAME wire buffer the legacy
+        ``capture_wire`` dump transfers, and those are program
+        properties: the flight step must lower byte-identically across
+        independent builds (same bits in -> same bits out), the ring
+        plane must actually be compiled in (not a runtime branch), and
+        the capture must stay pure device-local bookkeeping — zero
+        collectives on both sides, exactly like the base step."""
+        import collections
+        from partisan_tpu.verify.lint.fingerprint import _COLLECTIVE_RE
         cfg, proto, world = _booted_hv(self.N)
-        rec = TraceRecorder(cfg, proto)
-        rec.run(world, self.ROUNDS)
-        return cfg, proto, rec.entries
+        spec = FlightSpec(window=self.WINDOW, cap=world.msgs.cap)
+        ring = make_flight_ring(spec)
+        base = pt.make_step(cfg, proto, donate=False,
+                            capture_wire=True).lower(world).as_text()
+        ftext = pt.make_step(cfg, proto, donate=False,
+                             flight=spec).lower(world, ring).as_text()
+        ftext2 = pt.make_step(cfg, proto, donate=False,
+                              flight=spec).lower(world, ring).as_text()
+        assert ftext == ftext2, "flight lowering is not deterministic"
+        assert ftext != base  # the ring IS compiled in
 
-    def test_windowed_fast_path_bit_matches_legacy(self, legacy):
-        """run_windowed (one transfer per window) produces the
-        ENTRY-FOR-ENTRY identical stream to the per-round legacy path
-        — order included, not just the multiset: the ring's prefix-sum
-        compaction preserves flat-buffer order, which is exactly the
-        order the legacy recorder's flatnonzero walk read."""
-        cfg, proto, entries = legacy
-        _, _, world = _booted_hv(self.N)
-        rec = TraceRecorder(cfg, proto)
-        rec.run_windowed(world, self.ROUNDS, window=self.WINDOW)
-        assert rec.flight_overflow == 0
-        assert rec.entries == entries
-        assert len(entries) > 0
+        def cols(text):
+            return collections.Counter(
+                m.group(1) for m in _COLLECTIVE_RE.finditer(text))
+
+        assert cols(ftext) == cols(base) == collections.Counter()
 
     @needs_mesh
-    def test_sharded_dataplane_trace_matches_unsharded(self, legacy):
-        """The dataplane's per-shard rings capture the SAME wire
-        traffic: per-round TraceEntry multisets equal the unsharded
-        trace (order is dst-shard-major on the sharded side), nothing
-        head-capped, and the ring actually spans the mesh."""
+    def test_sharded_dataplane_trace_matches_unsharded(self):
+        """Lowered-text twin of the executed per-round multiset
+        equality between the dataplane's per-shard rings and the
+        unsharded trace.  The match held because the rings are
+        shard-LOCAL: compiling the flight plane into the sharded step
+        must leave the collective multiset unchanged (no new
+        cross-shard traffic), hold the dense budget at exactly one
+        all_to_all + one psum, and lower byte-identically across
+        independent builds."""
+        import collections
         from partisan_tpu.parallel import make_mesh
-        from partisan_tpu.parallel.dataplane import (
-            make_sharded_step, place_sharded_world, sharded_out_cap)
-        cfg, proto, entries = legacy
+        from partisan_tpu.parallel.dataplane import (init_sharded_world,
+                                                     make_sharded_step)
+        from partisan_tpu.verify.lint.fingerprint import _COLLECTIVE_RE
+        cfg = pt.Config(n_nodes=self.N, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
         mesh = make_mesh(n_devices=8)
-        out_cap = sharded_out_cap(cfg, proto, 8)
-        _, _, world = _booted_hv(self.N, out_cap=out_cap)
-        world = place_sharded_world(world, cfg, mesh)
-        spec = FlightSpec(window=self.ROUNDS, cap=out_cap // 8 * 8)
-        step = make_sharded_step(cfg, proto, mesh, donate=False,
-                                 flight=spec)
+        world = init_sharded_world(cfg, proto, mesh)
+        spec = FlightSpec(window=30, cap=world.msgs.cap // 8 * 8)
         ring = place_flight_ring(make_flight_ring(spec, n_shards=8),
                                  mesh)
         assert len(ring.buf.sharding.device_set) == 8
-        for _ in range(self.ROUNDS):
-            world, ring, _m = step(world, ring)
-        rows, overflow, ring = flight_flush(ring)
-        got = flight_entries(rows)
-        assert overflow == 0
-        assert len(got) == len(entries)
-        by_round = lambda es: {
-            r: sorted(_key(e) for e in es if e.rnd == r)
-            for r in {e.rnd for e in es}}
-        assert by_round(got) == by_round(entries)
+        base = make_sharded_step(cfg, proto, mesh,
+                                 donate=False).lower(world).as_text()
+        ftext = make_sharded_step(cfg, proto, mesh, donate=False,
+                                  flight=spec).lower(world,
+                                                     ring).as_text()
+        ftext2 = make_sharded_step(cfg, proto, mesh, donate=False,
+                                   flight=spec).lower(world,
+                                                      ring).as_text()
+        assert ftext == ftext2, "flight lowering is not deterministic"
+        assert ftext != base  # the per-shard rings ARE compiled in
+
+        def cols(text):
+            return collections.Counter(
+                m.group(1) for m in _COLLECTIVE_RE.finditer(text))
+
+        assert cols(ftext) == cols(base)
+        assert cols(ftext) == {"all_to_all": 1, "all_reduce": 1}
 
 
 # --------------------------------------------------- head-cap + filters

@@ -1,0 +1,297 @@
+"""ISSUE 17 tentpole b: Pallas route/mail kernels (ops/route_kernel.py).
+
+The kernels are bit-identical twins of the jnp reference paths in
+ops/shard_exchange — reverse_select's packed single-key sort+rank and
+bucket_exchange's shard-local bucketing — so every check here is exact
+equality, property-tested across shapes/salts (interpret mode on the
+CPU mesh; the compiled path runs on real TPU via bench).  The
+satellites ride along: the named reverse_select build-time ValueError
+(was a bare assert) and route_select's explicit ``dropped`` scalar,
+pinned sharded==unsharded.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from partisan_tpu.ops.shard_exchange import (bucket_exchange,
+                                             reverse_select, route_select)
+from partisan_tpu.parallel.mesh import NODE_AXIS, make_mesh
+
+N_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(n_devices=N_SHARDS)
+
+
+def _rand_targets(key, m, n):
+    """Targets with invalid rows mixed in (−1 and >= n both occur)."""
+    k1, k2 = jax.random.split(key)
+    t = jax.random.randint(k1, (m,), -2, n + 2, dtype=jnp.int32)
+    mask = jax.random.bernoulli(k2, 0.8, (m,))
+    return jnp.where(mask, t, -1)
+
+
+class TestReverseSelectKernelParity:
+    """Kernel vs jnp reference: exact equality (the bitonic network over
+    the composite (key, index) IS the stable single-key payload sort —
+    route_kernel module docstring)."""
+
+    def test_property_shapes_salts(self):
+        key = jax.random.PRNGKey(17)
+        for trial in range(12):
+            key, k1, k2 = jax.random.split(key, 3)
+            m = int(jax.random.randint(k1, (), 1, 200))
+            n = int(jax.random.randint(k2, (), 2, 50))
+            c = 1 + trial % 5
+            salt = jnp.uint32(0x9E37 * trial + 1)
+            t = _rand_targets(key, m, n)
+            ref = reverse_select(t, salt, n, c)
+            got = reverse_select(t, salt, n, c, use_kernel=True,
+                                 interpret=True)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(ref),
+                err_msg=f"trial={trial} m={m} n={n} c={c}")
+
+    def test_edge_shapes(self):
+        salt = jnp.uint32(7)
+        for m, n, c in [(1, 1, 1), (1, 5, 2), (2, 2, 1),
+                        (64, 8, 4), (257, 3, 2)]:
+            t = _rand_targets(jax.random.PRNGKey(m * 131 + n), m, n)
+            np.testing.assert_array_equal(
+                np.asarray(reverse_select(t, salt, n, c, use_kernel=True,
+                                          interpret=True)),
+                np.asarray(reverse_select(t, salt, n, c)),
+                err_msg=f"m={m} n={n} c={c}")
+
+    def test_all_invalid(self):
+        t = jnp.full((9,), -1, jnp.int32)
+        got = reverse_select(t, jnp.uint32(3), 4, 2, use_kernel=True,
+                             interpret=True)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.full((4, 2), -1, np.int32))
+
+    def test_overflow_beyond_cap(self):
+        # every row proposes to target 0: exactly c land, rest dropped
+        t = jnp.zeros((40,), jnp.int32)
+        ref = reverse_select(t, jnp.uint32(11), 6, 3)
+        got = reverse_select(t, jnp.uint32(11), 6, 3, use_kernel=True,
+                             interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        assert int(jnp.sum(got >= 0)) == 3
+
+
+class TestReverseSelectGuard:
+    """ISSUE 17 satellite: the n < 2^27 packing limit is a NAMED
+    ValueError at build (trace) time, not a bare assert that vanishes
+    under ``python -O``."""
+
+    def test_named_valueerror(self):
+        t = jnp.zeros((4,), jnp.int32)
+        with pytest.raises(ValueError, match=r"reverse_select: n=\d+ "
+                                             r"target ids do not fit"):
+            reverse_select(t, jnp.uint32(1), 1 << 27, 2)
+
+    def test_raises_inside_traced_build(self):
+        # the guard must fire during jit tracing too (build time)
+        def build(t):
+            return reverse_select(t, jnp.uint32(1), 1 << 28, 2)
+        with pytest.raises(ValueError, match="shard the index space"):
+            jax.jit(build).trace(jnp.zeros((4,), jnp.int32))
+
+    def test_limit_is_exclusive(self):
+        # n just under the limit still builds (trace only — no compile)
+        def build(t):
+            return reverse_select(t, jnp.uint32(1), (1 << 27) - 1, 1)
+        jax.jit(build).trace(jnp.zeros((2,), jnp.int32))
+
+
+def _mail(key, m, c, n_glob, p_valid=0.7):
+    """A shard-local [M, C] mail matrix: col 0 valid flag, col 1 global
+    destination, rest payload."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    valid = jax.random.bernoulli(k1, p_valid, (m,)).astype(jnp.int32)
+    dst = jax.random.randint(k2, (m,), 0, n_glob, dtype=jnp.int32)
+    pay = jax.random.randint(k3, (m, c - 2), 0, 1000, dtype=jnp.int32)
+    return jnp.concatenate([valid[:, None], dst[:, None], pay], axis=1)
+
+
+class TestBucketExchangeParity:
+    """Kernel vs jnp path through the REAL bucket_exchange (shard_map +
+    the one all_to_all shared by both): recv and dropped bit-identical."""
+
+    @pytest.mark.parametrize("m,cap", [(24, 4), (64, 16), (33, 3)])
+    def test_bit_identical(self, mesh, m, cap):
+        n_loc = 16
+        mail = jnp.concatenate(
+            [_mail(jax.random.PRNGKey(100 + m + s), m, 5,
+                   n_loc * N_SHARDS)
+             for s in range(N_SHARDS)])
+
+        def run(use_kernel):
+            def body(mb):
+                recv, drop = bucket_exchange(
+                    mb, n_loc, N_SHARDS, cap, NODE_AXIS,
+                    use_kernel=use_kernel,
+                    interpret=True if use_kernel else None)
+                return recv, drop.reshape(1)
+            return shard_map(body, mesh=mesh, in_specs=(P(NODE_AXIS),),
+                             out_specs=(P(NODE_AXIS), P(NODE_AXIS)),
+                             check_rep=False)(mail)
+
+        recv_ref, drop_ref = run(False)
+        recv_k, drop_k = run(True)
+        np.testing.assert_array_equal(np.asarray(recv_k),
+                                      np.asarray(recv_ref))
+        np.testing.assert_array_equal(np.asarray(drop_k),
+                                      np.asarray(drop_ref))
+
+    def test_forced_overflow_counted(self, mesh):
+        # cap 1 with concentrated destinations: drops occur and agree
+        n_loc, cap, m = 4, 1, 32
+        mail = jnp.concatenate(
+            [_mail(jax.random.PRNGKey(7 + s), m, 4, n_loc * N_SHARDS,
+                   p_valid=1.0) for s in range(N_SHARDS)])
+
+        def run(use_kernel):
+            def body(mb):
+                recv, drop = bucket_exchange(
+                    mb, n_loc, N_SHARDS, cap, NODE_AXIS,
+                    use_kernel=use_kernel,
+                    interpret=True if use_kernel else None)
+                return recv, drop.reshape(1)
+            return shard_map(body, mesh=mesh, in_specs=(P(NODE_AXIS),),
+                             out_specs=(P(NODE_AXIS), P(NODE_AXIS)),
+                             check_rep=False)(mail)
+
+        recv_ref, drop_ref = run(False)
+        recv_k, drop_k = run(True)
+        assert int(jnp.sum(drop_ref)) > 0
+        np.testing.assert_array_equal(np.asarray(recv_k),
+                                      np.asarray(recv_ref))
+        np.testing.assert_array_equal(np.asarray(drop_k),
+                                      np.asarray(drop_ref))
+
+
+class TestRouteSelectDropped:
+    """ISSUE 17 satellite: route_select returns its cap-overflow count
+    instead of making callers re-derive it by comparison."""
+
+    def _inputs(self, key, m, n_kinds, n_loc):
+        k1, k2, k3 = jax.random.split(key, 3)
+        kind = jax.random.randint(k1, (m,), -1, n_kinds + 1,
+                                  dtype=jnp.int32)
+        dstl = jax.random.randint(k2, (m,), 0, n_loc, dtype=jnp.int32)
+        valid = jax.random.bernoulli(k3, 0.8, (m,))
+        return kind, dstl, valid
+
+    def test_dropped_counts_cap_overflow(self):
+        # everything valid, one (kind, node) slot: cap lands, rest drop
+        m, n_kinds, n_loc, cap = 20, 2, 4, 3
+        kind = jnp.zeros((m,), jnp.int32)
+        dstl = jnp.zeros((m,), jnp.int32)
+        valid = jnp.ones((m,), bool)
+        sel, dropped = route_select(kind, dstl, valid, n_kinds, n_loc,
+                                    cap, jnp.uint32(5))
+        assert sel.shape == (n_kinds, n_loc, cap)
+        assert int(jnp.sum(sel >= 0)) == cap
+        assert int(dropped) == m - cap
+
+    def test_only_out_of_range_when_cap_ample(self):
+        kind, dstl, valid = self._inputs(jax.random.PRNGKey(1), 16, 3, 8)
+        sel, dropped = route_select(kind, dstl, valid, 3, 8, 16,
+                                    jnp.uint32(9))
+        # cap >= rows: every valid in-range row lands; dropped counts
+        # only the valid rows whose kind is out of range
+        landed = int(jnp.sum(sel >= 0))
+        expect = int(jnp.sum(valid)) - landed
+        assert int(dropped) == expect
+        assert int(jnp.sum(valid & (kind >= 0) & (kind < 3))) == landed
+
+    def test_sharded_equals_unsharded(self, mesh):
+        """The new counter pinned sharded==unsharded: route_select is
+        shard-local, so running it under shard_map over 8 shards must
+        give each shard exactly the result of the direct call on its
+        slice — sel AND dropped bit-identical."""
+        m, n_kinds, n_loc, cap = 24, 3, 4, 2
+        salt = jnp.uint32(42)
+        kinds, dstls, valids = [], [], []
+        for s in range(N_SHARDS):
+            k, d, v = self._inputs(jax.random.PRNGKey(50 + s),
+                                   m, n_kinds, n_loc)
+            kinds.append(k)
+            dstls.append(d)
+            valids.append(v)
+        kind = jnp.concatenate(kinds)
+        dstl = jnp.concatenate(dstls)
+        valid = jnp.concatenate(valids)
+
+        def body(k, d, v):
+            sel, drop = route_select(k, d, v, n_kinds, n_loc, cap, salt)
+            return sel, drop.reshape(1)
+
+        sel_sh, drop_sh = shard_map(
+            body, mesh=mesh, in_specs=(P(NODE_AXIS),) * 3,
+            out_specs=(P(NODE_AXIS), P(NODE_AXIS)))(kind, dstl, valid)
+        sel_sh = np.asarray(sel_sh).reshape(N_SHARDS, n_kinds, n_loc, cap)
+        drop_sh = np.asarray(drop_sh)
+        for s in range(N_SHARDS):
+            sel_u, drop_u = route_select(kinds[s], dstls[s], valids[s],
+                                         n_kinds, n_loc, cap, salt)
+            np.testing.assert_array_equal(sel_sh[s], np.asarray(sel_u),
+                                          err_msg=f"shard {s}")
+            assert drop_sh[s] == int(drop_u), f"shard {s}"
+
+
+class TestDenseRoundFlag:
+    """Config.use_pallas_route end to end: the flag-on sharded dense
+    round is bit-identical to flag-off (states AND metrics), keeps the
+    pinned collective budget, and flag-off lowers with zero Pallas
+    custom calls (the default program is untouched)."""
+
+    CFG = dict(n_nodes=64, shuffle_interval=2, random_promotion_interval=2)
+
+    def _round(self, mesh, use_pallas):
+        from partisan_tpu.config import Config
+        from partisan_tpu.parallel import dense_dataplane as dd
+        cfg = Config(use_pallas_route=use_pallas, **self.CFG)
+        step = dd.make_sharded_dense_round(cfg, mesh)
+        st = dd.place_sharded(dd.sharded_dense_init(cfg, N_SHARDS), mesh)
+        return step, st
+
+    def test_flag_on_bit_identical(self, mesh):
+        step_off, st_off = self._round(mesh, False)
+        step_on, st_on = self._round(mesh, True)
+        for _ in range(3):
+            st_off, m_off = step_off(st_off)
+            st_on, m_on = step_on(st_on)
+        for a, b in zip(jax.tree_util.tree_leaves(st_off),
+                        jax.tree_util.tree_leaves(st_on)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for k in m_off:
+            np.testing.assert_array_equal(np.asarray(m_off[k]),
+                                          np.asarray(m_on[k]),
+                                          err_msg=f"metric {k}")
+
+    def test_flag_on_budget_pinned(self, mesh):
+        from partisan_tpu.verify.lint.fingerprint import _COLLECTIVE_RE
+        from collections import Counter
+        step_on, st_on = self._round(mesh, True)
+        text = step_on.lower(st_on).as_text()
+        counts = Counter(m.group(1).replace("_", "-")
+                         for m in _COLLECTIVE_RE.finditer(text))
+        assert counts.get("all-to-all", 0) == 1
+        assert counts.get("all-reduce", 0) == 1
+        assert counts.get("all-gather", 0) == 0
+
+    def test_flag_off_no_pallas(self, mesh):
+        step_off, st_off = self._round(mesh, False)
+        text = step_off.lower(st_off).as_text()
+        assert "tpu_custom_call" not in text
+        assert "pallas" not in text.lower()
