@@ -115,6 +115,24 @@ def main() -> None:
         trial_sink.write_row(row)
     trial_sink.close()
 
+    # unified bench ledger (ISSUE 18): the same trials as canonical
+    # BenchRows — calibration-normalized, with the compile wall the
+    # CompileLedger attributed to this variant.  BENCH_trials.jsonl and
+    # the stdout contract above stay byte-identical.
+    from partisan_tpu.telemetry import benchplane
+    compile_s = ledger.summary().get(
+        f"bench_rumor_{variant}_n2e20", {}).get("compile_s")
+    calib = benchplane.calibrate()
+    benchplane.append_rows_nonfatal([benchplane.make_row(
+        "bench_rumor", variant,
+        config={"churn": churn, "fanout": fanout},
+        n_nodes=n, rounds=rounds,
+        rounds_per_sec=row["rounds_per_sec"], wall_s=row["seconds"],
+        compile_s=(compile_s if row["trial"] == 0 else None),
+        calibration=calib,
+        metrics={"trial": row["trial"], "infected": row["infected"]})
+        for row in trial_rows])
+
     rps = statistics.median(rates)
     result = {
         "metric": f"rumor_mongering rounds/sec @ N=2^20, churn={churn}",

@@ -53,6 +53,15 @@ from .timeline import RoundTimeline, profile_trace
 from .perfetto import chrome_trace, write_chrome_trace
 from .observatory import (CompileLedger, LEDGER_SPECS, StreamSpec,
                           bless_goldens, check_goldens, ledger_report)
+# benchplane's short names (SCHEMA/make_row/validate/...) would clobber
+# the package namespace, so the generic ones are re-exported aliased
+from .benchplane import (PERF_SUBSET, calibrate, config_fingerprint,
+                         read_bench_ledger)
+from .benchplane import SCHEMA as BENCH_SCHEMA
+from .benchplane import append_rows as append_bench_rows
+from .benchplane import make_row as bench_row
+from .benchplane import trend_report as bench_trend_report
+from .benchplane import validate as validate_bench_row
 
 __all__ = [
     "COUNTER", "GAUGE", "DEFAULT_SPECS", "HOST_SPECS",
@@ -75,6 +84,9 @@ __all__ = [
     "chrome_trace", "write_chrome_trace",
     "CompileLedger", "LEDGER_SPECS", "StreamSpec",
     "bless_goldens", "check_goldens", "ledger_report",
+    "BENCH_SCHEMA", "PERF_SUBSET", "append_bench_rows", "bench_row",
+    "bench_trend_report", "calibrate", "config_fingerprint",
+    "read_bench_ledger", "validate_bench_row",
     "add_global_sink", "remove_global_sink", "global_sinks", "emit_event",
     "note_round", "current_round",
 ]

@@ -1,0 +1,707 @@
+"""Benchplane (ISSUE 18): the unified performance ledger + perf gates.
+
+The repo's perf record was nine incompatible ``BENCH_*`` schemas with
+zero gating — no tool could read the numbers across PRs, so the bench
+trajectory was unqueryable and suite-runtime regressions surfaced three
+PRs late.  This module is the missing observability plane for *runtime
+performance itself*, mirroring how ``observatory.py`` gates compiles:
+
+* :data:`SCHEMA` / :func:`make_row` / :func:`validate` — the canonical
+  ``BenchRow``: suite, arm, config fingerprint, N/rounds/devices,
+  rounds_per_sec + derived metrics, wall/compile split (compile seconds
+  come from the existing :class:`~.observatory.CompileLedger`
+  attribution), jax/platform/device fields, and the **machine
+  calibration fingerprint** — a ~2 s fixed pure-numpy microbenchmark
+  (:func:`calibrate`) whose score normalizes cross-box numbers (CHANGES
+  records this box itself drifting 1.7x between PRs; raw rounds/sec is
+  not comparable across runs, ``norm_rounds_per_sec`` is, to first
+  order).  Every bench entrypoint appends rows to
+  ``BENCH_ledger.jsonl`` (:func:`append_rows`); legacy artifacts and
+  stdout contracts are untouched.
+
+* :func:`bless_perf` / :func:`check_perf` — the run-over-run regression
+  gate over a CHEAP pinned subset (:data:`PERF_SUBSET`: flagship
+  micro-rounds at tier-1 shapes, AOT-loaded by ``scripts/perf_gate.py``
+  so there is no compile wall).  ``check`` compares
+  calibration-normalized rounds/sec against ``PERF_goldens.json`` with
+  explicit noise bands: fail NAMED above the fail band, warn-only in
+  the band below it.  Throughput is estimated as the MAX over repeats
+  (the least-noise estimator on a contended 1-vCPU box).
+
+* :func:`bless_budget` / :func:`check_budget` — the tier-1 runtime
+  budget over ``BENCH_suite_durations.jsonl`` (written per-test by
+  ``tests/conftest.py``): fail NAMED when a test exceeds its committed
+  per-test budget or the projected tier-1 total exceeds the 870 s
+  ceiling.  Budgets are calibration-normalized too, so a slower box
+  does not read as a regression.
+
+* :func:`trend_report` — the cross-PR trend table, rendered from the
+  ledger alone (no jax import on this path — readable anywhere).
+
+``scripts/perf_gate.py`` is the CLI (``--bless/--check/--report``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import (Any, Callable, Dict, List, Mapping, Optional,
+                    Sequence, Tuple)
+
+__all__ = [
+    "SCHEMA", "LEDGER_BASENAME", "PERF_GOLDEN_BASENAME",
+    "DURATIONS_BASENAME", "PERF_SUBSET", "TIER1_CEILING_S",
+    "calibrate", "config_fingerprint", "make_row", "validate",
+    "append_row", "append_rows", "append_rows_nonfatal",
+    "read_bench_ledger", "default_ledger_path",
+    "convert_trials", "measure_rps", "bless_perf", "check_perf",
+    "bless_budget", "check_budget", "trend_report",
+]
+
+SCHEMA = "benchrow/v1"
+GOLDEN_SCHEMA = "perf_goldens/v1"
+LEDGER_BASENAME = "BENCH_ledger.jsonl"
+PERF_GOLDEN_BASENAME = "PERF_goldens.json"
+DURATIONS_BASENAME = "BENCH_suite_durations.jsonl"
+
+#: the tier-1 verify wall from ROADMAP.md — the budget gate's ceiling.
+TIER1_CEILING_S = 870.0
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# The pinned cheap subset for perf_gate --check: flagship entrypoints
+# (verify/lint/fingerprint.py names) that advance a single state arg,
+# micro-round host loops at tier-1 canonical shapes.  iters are sized
+# so the warm gate stays well under 120 s on a 1-vCPU box.
+PERF_SUBSET: Dict[str, Dict[str, int]] = {
+    "engine_step_hyparview_n64":    {"iters": 48, "warm": 4, "repeats": 3},
+    "sharded_dataplane_round_n64x8": {"iters": 12, "warm": 2, "repeats": 3},
+    "dense_hyparview_n256x8":       {"iters": 12, "warm": 2, "repeats": 3},
+    "dense_scamp_n256x8":           {"iters": 12, "warm": 2, "repeats": 3},
+    "dense_plumtree_n256x8":        {"iters": 12, "warm": 2, "repeats": 3},
+}
+
+
+def default_ledger_path() -> str:
+    """``$PARTISAN_BENCH_LEDGER`` or ``<repo>/BENCH_ledger.jsonl`` —
+    resolved from this module's location, NOT the cwd, so a bench run
+    from a scratch directory still lands in the repo ledger."""
+    return os.environ.get("PARTISAN_BENCH_LEDGER",
+                          os.path.join(_REPO, LEDGER_BASENAME))
+
+
+# --------------------------------------------------------- calibration
+
+_CALIB: Optional[Dict[str, float]] = None
+
+
+def _calib_block(a, b):
+    """One fixed unit of work: 8 chained 128x128 f32 matmuls with a
+    rescale (keeps values finite without changing the op count)."""
+    for _ in range(8):
+        a = a @ b
+        a *= 1.0 / (abs(a).max() + 1.0)
+    return a
+
+
+def calibrate(target_s: Optional[float] = None, *, force: bool = False
+              ) -> Dict[str, float]:
+    """The machine calibration fingerprint: run a fixed pure-numpy
+    workload for ~``target_s`` wall seconds and return
+    ``{"score": work_units_per_sec, "wall_s": ..., "blocks": ...}``.
+
+    The score divides raw rounds/sec (``norm_rounds_per_sec``) and
+    multiplies raw durations (``norm_s``), so numbers from boxes of
+    different speed land on a shared scale.  Cached per process (one
+    ~2 s payment covers every row); ``$PARTISAN_CALIB_SECS`` shortens
+    it for tests.  The workload is deterministic — variance across
+    calls on one box is scheduler noise, pinned by the determinism-band
+    test.
+    """
+    global _CALIB
+    if _CALIB is not None and not force and target_s is None:
+        return _CALIB
+    import numpy as np
+    if target_s is None:
+        target_s = float(os.environ.get("PARTISAN_CALIB_SECS", "2.0"))
+    rng = np.random.RandomState(0)
+    a = rng.rand(128, 128).astype(np.float32)
+    b = rng.rand(128, 128).astype(np.float32)
+    _calib_block(a, b)                       # untimed spin-up
+    blocks = 0
+    t0 = time.perf_counter()
+    while True:
+        a = _calib_block(a, b)
+        blocks += 1
+        dt = time.perf_counter() - t0
+        if dt >= target_s:
+            break
+    out = {"score": round(blocks / dt, 3), "wall_s": round(dt, 3),
+           "blocks": blocks}
+    if target_s >= 1.0:                      # only cache full-length runs
+        _CALIB = out
+    return out
+
+
+# ----------------------------------------------------------- BenchRow
+
+def config_fingerprint(config: Any) -> Optional[str]:
+    """Stable 16-hex fingerprint of an arbitrary config mapping (or any
+    JSON-serializable-with-default=str value)."""
+    if config is None:
+        return None
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+_RUN_ID: Optional[str] = None
+
+
+def _run_id() -> str:
+    global _RUN_ID
+    if _RUN_ID is None:
+        _RUN_ID = time.strftime("%Y%m%d_%H%M%S") + f"_{os.getpid()}"
+    return _RUN_ID
+
+
+def _device_fields() -> Dict[str, Any]:
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return {"jax": jax.__version__, "platform": dev.platform,
+                "device_kind": getattr(dev, "device_kind", dev.platform),
+                "n_devices": len(jax.devices()),
+                "cpu_fallback": dev.platform != "tpu"}
+    except Exception:  # noqa: BLE001 — report path has no jax
+        return {"jax": None, "platform": None, "device_kind": None,
+                "n_devices": None, "cpu_fallback": None}
+
+
+def make_row(suite: str, arm: str, *,
+             config: Any = None,
+             n_nodes: Optional[int] = None,
+             rounds: Optional[int] = None,
+             rounds_per_sec: Optional[float] = None,
+             wall_s: Optional[float] = None,
+             compile_s: Optional[float] = None,
+             metrics: Optional[Mapping[str, Any]] = None,
+             calibration: Any = True,
+             legacy: bool = False,
+             **extra: Any) -> Dict[str, Any]:
+    """Build a canonical BenchRow.  ``calibration=True`` runs (or
+    reuses) the per-process :func:`calibrate`; pass a calibrate() dict
+    to share one, or ``None`` for legacy/backfill rows that predate the
+    fingerprint.  ``compile_s`` is the CompileLedger-attributed compile
+    wall for this arm (None when unattributed)."""
+    if calibration is True:
+        calibration = calibrate()
+    score = calibration["score"] if isinstance(calibration, Mapping) \
+        else calibration
+    row: Dict[str, Any] = {
+        "schema": SCHEMA, "suite": suite, "arm": arm,
+        "config_fp": config_fingerprint(config),
+        "n_nodes": n_nodes, "rounds": rounds,
+        "rounds_per_sec": None if rounds_per_sec is None
+        else round(float(rounds_per_sec), 4),
+        "wall_s": None if wall_s is None else round(float(wall_s), 4),
+        "compile_s": None if compile_s is None
+        else round(float(compile_s), 4),
+        "calib_score": None if score is None else round(float(score), 3),
+        "norm_rounds_per_sec": None,
+        "t_wall": time.time(), "run": _run_id(),
+    }
+    row.update(_device_fields())
+    if "n_devices" in extra:               # caller knows better than jax
+        row["n_devices"] = extra.pop("n_devices")
+    if rounds_per_sec is not None and score:
+        row["norm_rounds_per_sec"] = round(float(rounds_per_sec) / score, 5)
+    if metrics:
+        row["metrics"] = dict(metrics)
+    if legacy:
+        row["legacy"] = True
+    row.update(extra)
+    return row
+
+
+def validate(row: Any) -> List[str]:
+    """-> list of NAMED schema violations (empty = valid BenchRow)."""
+    if not isinstance(row, Mapping):
+        return [f"BENCHROW INVALID — row is not a mapping: {type(row).__name__}"]
+    errs: List[str] = []
+    if row.get("schema") != SCHEMA:
+        errs.append(f"BENCHROW SCHEMA — expected {SCHEMA!r}, got "
+                    f"{row.get('schema')!r}")
+    for k in ("suite", "arm", "run"):
+        v = row.get(k)
+        if not isinstance(v, str) or not v:
+            errs.append(f"BENCHROW FIELD {k} — missing or not a "
+                        f"non-empty string: {v!r}")
+    for k in ("rounds_per_sec", "wall_s", "compile_s", "calib_score",
+              "norm_rounds_per_sec", "t_wall"):
+        v = row.get(k)
+        if v is not None and not isinstance(v, (int, float)):
+            errs.append(f"BENCHROW FIELD {k} — not numeric: {v!r}")
+        elif isinstance(v, (int, float)) and v < 0:
+            errs.append(f"BENCHROW FIELD {k} — negative: {v!r}")
+    if not isinstance(row.get("t_wall"), (int, float)):
+        errs.append("BENCHROW FIELD t_wall — missing timestamp")
+    rps, score = row.get("rounds_per_sec"), row.get("calib_score")
+    if isinstance(rps, (int, float)) and isinstance(score, (int, float)) \
+            and score > 0 and row.get("norm_rounds_per_sec") is None:
+        errs.append("BENCHROW FIELD norm_rounds_per_sec — missing while "
+                    "rounds_per_sec and calib_score are both present")
+    return errs
+
+
+def append_rows(rows: Sequence[Mapping[str, Any]],
+                path: Optional[str] = None) -> str:
+    """Append validated BenchRows to the unified ledger (one JSON line
+    each).  Raises ValueError with the NAMED violations on an invalid
+    row — a bench must not silently pollute the trajectory."""
+    path = path or default_ledger_path()
+    for row in rows:
+        errs = validate(row)
+        if errs:
+            raise ValueError("refusing to append invalid BenchRow: "
+                             + "; ".join(errs))
+    with open(path, "a", encoding="utf-8") as f:
+        for row in rows:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+    return path
+
+
+def append_row(row: Mapping[str, Any], path: Optional[str] = None) -> str:
+    return append_rows([row], path)
+
+
+def append_rows_nonfatal(rows: Sequence[Mapping[str, Any]],
+                         path: Optional[str] = None) -> Optional[str]:
+    """:func:`append_rows` for bench CLIs: a ledger failure must not
+    tank a long soak run whose legacy artifacts already landed — it is
+    reported LOUDLY on stderr, never silently swallowed."""
+    import sys
+    try:
+        return append_rows(rows, path)
+    except Exception as e:  # noqa: BLE001 — warn-and-continue by design
+        print(f"benchplane: BENCH_ledger append FAILED "
+              f"({type(e).__name__}: {e}) — legacy artifacts are "
+              f"unaffected, but this run is missing from the unified "
+              f"trajectory", file=sys.stderr)
+        return None
+
+
+def read_bench_ledger(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Read the unified ledger; silently skips blank lines, raises on
+    unparseable ones (a corrupt ledger should be loud)."""
+    path = path or default_ledger_path()
+    rows: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{i + 1}: unparseable ledger line ({e})")
+    return rows
+
+
+def convert_trials(trials_path: str) -> List[Dict[str, Any]]:
+    """Back-convert legacy ``BENCH_trials.jsonl`` rows (bench.py's
+    per-trial artifact) into BenchRows — the historical seed for the
+    unified ledger.  Legacy rows predate calibration, so they carry
+    ``calib_score: null`` and ``legacy: true``; their original wall
+    timestamps are preserved so the trend report orders them first."""
+    out: List[Dict[str, Any]] = []
+    with open(trials_path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            t = json.loads(line)
+            row = {
+                "schema": SCHEMA, "suite": "bench_rumor",
+                "arm": t.get("variant", "unknown"),
+                "config_fp": config_fingerprint(
+                    {"churn": t.get("churn"), "fanout": t.get("fanout")}),
+                "n_nodes": t.get("n"), "rounds": t.get("rounds"),
+                "rounds_per_sec": t.get("rounds_per_sec"),
+                "wall_s": t.get("seconds"), "compile_s": None,
+                "calib_score": None, "norm_rounds_per_sec": None,
+                "jax": None, "platform": t.get("device"),
+                "device_kind": t.get("device"), "n_devices": None,
+                "cpu_fallback": (None if t.get("device") is None
+                                 else t.get("device") != "tpu"),
+                "t_wall": t.get("t_wall", 0.0),
+                "run": "legacy_backfill", "legacy": True,
+                "metrics": {"trial": t.get("trial"),
+                            "infected": t.get("infected")},
+            }
+            out.append(row)
+    return out
+
+
+# ------------------------------------------------- throughput measure
+
+def measure_rps(fn: Callable, args: tuple, *, iters: int = 16,
+                warm: int = 2, repeats: int = 3) -> Dict[str, Any]:
+    """Micro-round throughput of a compiled/AOT program: host loop of
+    ``iters`` calls, the first output re-fed as the first argument
+    (every flagship round is ``state -> (state, metrics)``), synced
+    once per repeat.  Returns max-over-repeats rounds/sec — on a noisy
+    shared box the max is the least-biased throughput estimate; the
+    spread across repeats is reported so the gate can widen its band.
+    """
+    import jax
+    state, rest = args[0], tuple(args[1:])
+
+    def _step(s):
+        out = fn(s, *rest)
+        return out[0] if isinstance(out, tuple) else out
+
+    for _ in range(warm):
+        state = _step(state)
+    state = jax.block_until_ready(state)
+    samples: List[float] = []
+    t_all = time.perf_counter()
+    for _ in range(repeats):
+        s = state
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s = _step(s)
+        jax.block_until_ready(s)
+        samples.append(iters / (time.perf_counter() - t0))
+    best = max(samples)
+    spread_pct = 100.0 * (best - min(samples)) / best if best else 0.0
+    return {"rounds_per_sec": round(best, 4),
+            "samples": [round(x, 4) for x in samples],
+            "spread_pct": round(spread_pct, 1),
+            "wall_s": round(time.perf_counter() - t_all, 3)}
+
+
+def _default_loader(name: str, build: Callable) -> Tuple[Callable, tuple, str]:
+    """(fn, args, how) from a flagship-style builder; perf_gate swaps in
+    an AOT-aware loader so --check never compiles."""
+    fn, args = build()
+    return fn, args, "jit"
+
+
+# ----------------------------------------------- perf regression gate
+
+def bless_perf(path: str, registry: Mapping[str, Callable],
+               subset: Optional[Mapping[str, Mapping[str, int]]] = None,
+               *, loader: Callable = _default_loader,
+               calibration: Any = True,
+               progress: Optional[Callable[[str], None]] = None
+               ) -> Dict[str, Any]:
+    """Measure the pinned subset and write ``PERF_goldens.json``.  An
+    existing file's ``suite_budget`` section is PRESERVED (the two
+    blesses are independent: perf rows re-bless after an intended perf
+    change, budgets re-bless after a clean tier-1 run)."""
+    if calibration is True:
+        calibration = calibrate()
+    subset = _resolve_subset(registry, subset)
+    golden: Dict[str, Any] = {"schema": GOLDEN_SCHEMA,
+                              "calibration": calibration, "rows": {}}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            old = json.load(f)
+        if "suite_budget" in old:
+            golden["suite_budget"] = old["suite_budget"]
+    for name, knobs in subset.items():
+        if progress:
+            progress(name)
+        fn, args, how = loader(name, registry[name])
+        m = measure_rps(fn, args, **knobs)
+        golden["rows"][name] = {
+            "norm_rps": round(m["rounds_per_sec"] / calibration["score"], 5),
+            "rounds_per_sec": m["rounds_per_sec"],
+            "spread_pct": m["spread_pct"], "iters": knobs.get("iters"),
+            "how": how,
+        }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(golden, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return golden
+
+
+def _resolve_subset(registry, subset):
+    if subset is None:
+        subset = {k: v for k, v in PERF_SUBSET.items() if k in registry}
+        if not subset:     # toy registries: measure everything, default knobs
+            subset = {k: {"iters": 16, "warm": 2, "repeats": 3}
+                      for k in registry}
+    missing = set(subset) - set(registry)
+    if missing:
+        raise KeyError(f"perf subset names not in registry: "
+                       f"{sorted(missing)}")
+    return subset
+
+
+def check_perf(path: str, registry: Mapping[str, Callable],
+               subset: Optional[Mapping[str, Mapping[str, int]]] = None,
+               *, loader: Callable = _default_loader,
+               fail_pct: float = 45.0, warn_pct: float = 18.0,
+               calibration: Any = True,
+               progress: Optional[Callable[[str], None]] = None
+               ) -> Tuple[List[str], List[str], List[Dict[str, Any]]]:
+    """The regression gate: -> (errors, warnings, bench_rows).
+
+    Per pinned row, the calibration-normalized rounds/sec is compared
+    against the golden.  A drop beyond ``max(fail_pct, 2x the blessed
+    repeat spread)`` fails NAMED; a drop beyond ``warn_pct`` but inside
+    the fail band is warn-only (explicit noise band — a contended box
+    should nag, not block).  ``bench_rows`` are canonical BenchRows
+    (suite ``perf_gate``) for the unified ledger, one per measured
+    entry, whatever the verdict — the gate's own runs ARE trajectory.
+    """
+    with open(path, encoding="utf-8") as f:
+        golden = json.load(f)
+    if calibration is True:
+        calibration = calibrate()
+    subset = _resolve_subset(registry, subset)
+    errors: List[str] = []
+    warnings: List[str] = []
+    rows: List[Dict[str, Any]] = []
+    for name, knobs in subset.items():
+        ref = golden.get("rows", {}).get(name)
+        if ref is None:
+            errors.append(
+                f"{name}: PERF GOLDEN MISSING — pinned subset entry has "
+                f"no row in {os.path.basename(path)}; run "
+                f"scripts/perf_gate.py --bless")
+            continue
+        if progress:
+            progress(name)
+        fn, args, how = loader(name, registry[name])
+        m = measure_rps(fn, args, **knobs)
+        cur_norm = m["rounds_per_sec"] / calibration["score"]
+        gold_norm = ref["norm_rps"]
+        drop_pct = 100.0 * (gold_norm - cur_norm) / gold_norm \
+            if gold_norm else 0.0
+        band = max(fail_pct, 2.0 * ref.get("spread_pct", 0.0))
+        rows.append(make_row(
+            "perf_gate", name, rounds=knobs.get("iters"),
+            rounds_per_sec=m["rounds_per_sec"],
+            wall_s=m["wall_s"], calibration=calibration,
+            metrics={"how": how, "spread_pct": m["spread_pct"],
+                     "drop_pct": round(drop_pct, 1),
+                     "golden_norm_rps": gold_norm}))
+        if drop_pct > band:
+            errors.append(
+                f"{name}: PERF REGRESSION — normalized rounds/sec "
+                f"{cur_norm:.2f} is {drop_pct:.0f}% below the golden "
+                f"{gold_norm:.2f} (fail band {band:.0f}%; raw "
+                f"{m['rounds_per_sec']:.1f} r/s via {how}, calib score "
+                f"{calibration['score']:.0f}) — find the regressing "
+                f"change, or re-bless if intended "
+                f"(scripts/perf_gate.py --bless)")
+        elif drop_pct > warn_pct:
+            warnings.append(
+                f"{name}: perf warn — normalized rounds/sec "
+                f"{cur_norm:.2f} is {drop_pct:.0f}% below golden "
+                f"{gold_norm:.2f} (inside the {band:.0f}% fail band; "
+                f"watch the trend: scripts/perf_gate.py --report)")
+    return errors, warnings, rows
+
+
+# ------------------------------------------------ tier-1 runtime budget
+
+def read_durations(path: str) -> List[Dict[str, Any]]:
+    """Per-test duration rows (``{"bench": "suite_durations", "test":
+    nodeid, "duration_s": ...}``) from conftest's artifact."""
+    rows = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if r.get("bench") == "suite_durations" and "test" in r:
+                rows.append(r)
+    return rows
+
+
+def bless_budget(durations_path: str, *,
+                 ceiling_s: float = TIER1_CEILING_S,
+                 slack_pct: float = 75.0, floor_s: float = 3.0,
+                 ceiling_slack_pct: float = 15.0,
+                 calibration: Any = True) -> Dict[str, Any]:
+    """Regenerate the per-test budget section from a CLEAN tier-1 run's
+    durations artifact.  Tests under ``floor_s`` are pooled into
+    ``small_total_s`` (per-test noise there exceeds signal); tests at
+    or over it get individual calibration-normalized budgets."""
+    if calibration is True:
+        calibration = calibrate()
+    score = calibration["score"]
+    rows = read_durations(durations_path)
+    if not rows:
+        raise ValueError(f"no suite_durations rows in {durations_path} — "
+                         f"run tier-1 first (tests/conftest.py writes it)")
+    per: Dict[str, float] = {}
+    for r in rows:
+        per[r["test"]] = per.get(r["test"], 0.0) + float(r["duration_s"])
+    big = {t: d for t, d in per.items() if d >= floor_s}
+    small_total = sum(d for d in per.values()) - sum(big.values())
+    return {
+        "ceiling_s": ceiling_s, "slack_pct": slack_pct,
+        "floor_s": floor_s, "calib_score": score,
+        "ceiling_slack_pct": ceiling_slack_pct,
+        "n_tests": len(per), "small_total_s": round(small_total, 1),
+        "total_s": round(sum(per.values()), 1),
+        "tests": {t: {"budget_s": round(d, 2),
+                      "norm_s": round(d * score, 1)}
+                  for t, d in sorted(big.items())},
+    }
+
+
+def check_budget(budget: Mapping[str, Any], durations_path: str, *,
+                 calibration: Any = True
+                 ) -> Tuple[List[str], List[str], Dict[str, Any]]:
+    """The tier-1 runtime-budget gate: -> (errors, warnings, info).
+
+    NAMED failures: a per-test duration whose calibration-normalized
+    value exceeds its committed budget + slack, or a projected suite
+    total beyond the ceiling's own fail band.  The projection charges
+    every budgeted test its CURRENT duration when observed this run and
+    its BLESSED budget when not (a partial run still projects the full
+    suite), plus the pooled small-test total — so truncation cannot
+    hide an overrun.
+
+    The per-test legs are calibration-normalized (cross-box
+    comparability); the ceiling leg is RAW same-box seconds — the
+    ceiling is a wall-clock CI property of the box running the suite,
+    and the ~2 s calibration snapshot's scheduler noise (up to ~2x on
+    a contended 1-vCPU box) must not modulate a wall-clock verdict.
+    Like the perf leg's fail/warn bands, the ceiling has an explicit
+    noise band: projected > ceiling warns, projected >
+    ceiling * (1 + ceiling_slack_pct/100) fails NAMED — a
+    timeout-truncated artifact totals ≈ the wall by construction, so a
+    margin-free ceiling would be a coin flip.
+    """
+    if calibration is True:
+        calibration = calibrate()
+    score = calibration["score"]
+    slack = 1.0 + budget.get("slack_pct", 75.0) / 100.0
+    floor = budget.get("floor_s", 3.0)
+    rows = read_durations(durations_path)
+    per: Dict[str, float] = {}
+    for r in rows:
+        per[r["test"]] = per.get(r["test"], 0.0) + float(r["duration_s"])
+    errors: List[str] = []
+    warnings: List[str] = []
+    budgets = budget.get("tests", {})
+    for test, d in sorted(per.items(), key=lambda kv: -kv[1]):
+        cur_norm = d * score
+        ref = budgets.get(test)
+        if ref is None:
+            if d >= floor:
+                warnings.append(
+                    f"{test}: unbudgeted test took {d:.1f}s (>= the "
+                    f"{floor:.0f}s floor) — re-bless budgets after a "
+                    f"clean run (scripts/perf_gate.py --bless) or "
+                    f"re-tier it")
+            continue
+        if cur_norm > ref["norm_s"] * slack and d >= floor:
+            errors.append(
+                f"{test}: DURATION BUDGET OVERRUN — {d:.1f}s this run "
+                f"(normalized {cur_norm:.0f}) vs committed budget "
+                f"{ref['budget_s']:.1f}s (+{budget.get('slack_pct', 75):.0f}% "
+                f"slack, normalized cap {ref['norm_s'] * slack:.0f}) — "
+                f"re-tier the test (slow marker / lowered-text twin) or "
+                f"re-bless after an intended change")
+    # projected full-suite total in RAW same-box seconds (see docstring)
+    projected_s = 0.0
+    for test, ref in budgets.items():
+        projected_s += per[test] if test in per else ref["budget_s"]
+    small = budget.get("small_total_s", 0.0)
+    observed_small = sum(d for t, d in per.items() if t not in budgets)
+    projected_s += max(small, observed_small)
+    ceiling = budget.get("ceiling_s", TIER1_CEILING_S)
+    c_slack_pct = budget.get("ceiling_slack_pct", 15.0)
+    fail_s = ceiling * (1.0 + c_slack_pct / 100.0)
+    info = {"projected_s": round(projected_s, 1), "ceiling_s": ceiling,
+            "ceiling_fail_s": round(fail_s, 1),
+            "observed_tests": len(per), "budgeted_tests": len(budgets)}
+    if projected_s > ceiling:
+        top = sorted(budgets.items(),
+                     key=lambda kv: -per.get(kv[0], kv[1]["budget_s"]))[:5]
+        tops = ", ".join(f"{t}={per.get(t, ref['budget_s']):.0f}s"
+                         for t, ref in top)
+        msg = (f"TIER-1 RUNTIME BUDGET — projected suite total "
+               f"{projected_s:.0f}s exceeds the {ceiling:.0f}s ceiling "
+               f"(fail band {fail_s:.0f}s; top contributors: {tops}) — "
+               f"re-tier the heaviest tests (ROADMAP tier-1 velocity "
+               f"item) before they truncate CI")
+        if projected_s > fail_s:
+            errors.append(msg)
+        else:
+            warnings.append(msg.replace(
+                "TIER-1 RUNTIME BUDGET —",
+                "tier-1 runtime budget warn —", 1))
+    return errors, warnings, info
+
+
+# ------------------------------------------------------- trend report
+
+def trend_report(rows: Sequence[Mapping[str, Any]], top: int = 20) -> str:
+    """The cross-PR trend table, from ledger rows alone (no jax).  One
+    line per (suite, arm): run count, first/latest normalized
+    rounds/sec (falls back to raw for legacy rows, marked ``raw``),
+    and the latest-vs-prior-mean delta."""
+    groups: Dict[Tuple[str, str], List[Mapping[str, Any]]] = {}
+    for r in rows:
+        if r.get("schema") != SCHEMA:
+            continue
+        groups.setdefault((str(r.get("suite")), str(r.get("arm"))),
+                          []).append(r)
+    suites = {k[0] for k in groups}
+    lines = [f"benchplane trend — {len(rows)} rows, {len(suites)} suites, "
+             f"{len(groups)} (suite, arm) series",
+             f"{'suite':<16} {'arm':<26} {'runs':>4} {'first':>10} "
+             f"{'latest':>10} {'delta':>7}  unit"]
+
+    def _val(r):
+        v = r.get("norm_rounds_per_sec")
+        if v is not None:
+            return v, "norm r/s"
+        if r.get("rounds_per_sec") is not None:
+            return r["rounds_per_sec"], "raw r/s"
+        if r.get("wall_s") is not None:
+            return r["wall_s"], "raw s"
+        return None, ""
+
+    scored = []
+    for (suite, arm), rs in groups.items():
+        rs = sorted(rs, key=lambda r: (r.get("t_wall") or 0.0))
+        vals = [(v, u) for v, u in (_val(r) for r in rs) if v is not None]
+        if not vals:
+            continue
+        unit = vals[-1][1]
+        series = [v for v, u in vals if u == unit]
+        first, latest = series[0], series[-1]
+        if len(series) > 1:
+            prior = sum(series[:-1]) / (len(series) - 1)
+            delta = 100.0 * (latest - prior) / prior if prior else 0.0
+            dtxt = f"{delta:+.0f}%"
+        else:
+            dtxt = "-"
+        scored.append((suite, arm, len(rs), first, latest, dtxt, unit))
+    for suite, arm, n, first, latest, dtxt, unit in sorted(scored)[:top]:
+        lines.append(f"{suite:<16} {arm:<26} {n:>4} {first:>10.2f} "
+                     f"{latest:>10.2f} {dtxt:>7}  {unit}")
+    if len(scored) > top:
+        lines.append(f"... {len(scored) - top} more series (--top)")
+    calibs = [r["calib_score"] for r in rows
+              if isinstance(r.get("calib_score"), (int, float))]
+    if calibs:
+        lines.append(f"calibration score range: {min(calibs):.0f} .. "
+                     f"{max(calibs):.0f} (box drift "
+                     f"{max(calibs) / min(calibs):.2f}x — normalized "
+                     f"columns absorb it)")
+    return "\n".join(lines)

@@ -41,6 +41,7 @@ Run:  JAX_PLATFORMS=cpu python scripts/bench_telemetry.py [--n 4096]
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -255,6 +256,29 @@ def main() -> None:
         "device": jax.devices()[0].platform,
     }
     print(json.dumps(summary))
+
+    # unified bench ledger (ISSUE 18): one BenchRow per telemetry arm,
+    # so the overhead trend is queryable next to every other suite; the
+    # stdout summary and BENCH_telemetry.* artifacts stay unchanged
+    from partisan_tpu.telemetry import benchplane
+    calib = benchplane.calibrate()
+    rounds = window * args.windows
+    benchplane.append_rows_nonfatal(
+        [benchplane.make_row(
+            "bench_telemetry", arm,
+            config={"window": window, "windows": args.windows,
+                    "flight_cap": args.flight_cap,
+                    "trace_cap": args.trace_cap},
+            n_nodes=n, rounds=rounds, rounds_per_sec=rps,
+            wall_s=round(rounds / rps, 4) if rps else None,
+            calibration=calib, metrics={"overhead_pct": ovh})
+         for arm, rps, ovh in [
+             ("plain", plain_rps, None),
+             ("telemetry", telem_rps, summary["overhead_pct"]),
+             ("flight", flight_rps, summary["flight_overhead_pct"]),
+             ("stream", stream_rps, summary["stream_overhead_pct"]),
+             ("tracer", tracer_rps, summary["tracer_overhead_pct"])]],
+        os.environ.get("PARTISAN_BENCH_LEDGER"))
 
 
 if __name__ == "__main__":

@@ -318,6 +318,32 @@ def run_workload_cell(*, n: int, rounds: int, seed: int, window: int,
     return row
 
 
+def _append_bench_rows(rows, smoke: bool = False) -> None:
+    """Unified bench ledger (ISSUE 18): mirror each cell as a canonical
+    BenchRow (suite ``chaos_soak``, arm = fault mix).  The legacy
+    BENCH_chaos.jsonl rows above are untouched.  Smoke runs land in
+    /tmp so CI never dirties the committed trajectory (same policy as
+    control_suite/load_suite)."""
+    from partisan_tpu.telemetry import benchplane
+    ledger_path = os.environ.get("PARTISAN_BENCH_LEDGER") or (
+        "/tmp/BENCH_ledger_smoke.jsonl" if smoke else None)
+    calib = benchplane.calibrate()
+    benchplane.append_rows_nonfatal(
+        [benchplane.make_row(
+            "chaos_soak", r.get("mix", "unknown"),
+            config={"seed": r.get("seed"),
+                    "heal_margin": r.get("heal_margin")},
+            n_nodes=r.get("n_nodes"), rounds=r.get("rounds"),
+            rounds_per_sec=r.get("rounds_per_sec"),
+            wall_s=r.get("wall_s"), calibration=calib,
+            metrics={k: r[k] for k in ("converged", "heal_round",
+                                       "converged_round",
+                                       "chaos_dropped",
+                                       "p99_recovery") if k in r})
+         for r in rows],
+        ledger_path)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=4096)
@@ -414,6 +440,7 @@ def main(argv=None) -> int:
         with open(args.out, "a") as f:
             for row in rows:
                 f.write(json.dumps(row) + "\n")
+        _append_bench_rows(rows, smoke=args.smoke)
         print(f"\n{len(rows)} workload cells -> {args.out}; "
               f"{failures} failed p99-recovery-after-heal")
         return 1 if failures else 0
@@ -475,6 +502,7 @@ def main(argv=None) -> int:
     with open(args.out, "a") as f:
         for row in rows:
             f.write(json.dumps(row) + "\n")
+    _append_bench_rows(rows, smoke=args.smoke)
     print(f"\n{len(rows)} cells -> {args.out}; {failures} failed "
           f"convergence-after-heal")
     return 1 if failures else 0

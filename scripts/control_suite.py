@@ -369,6 +369,31 @@ def main(argv=None) -> int:
     all_rows.append(summary)
     print(f"summary: {summary}")
 
+    # unified bench ledger (ISSUE 18): one BenchRow per measured arm;
+    # smoke runs land in /tmp like the legacy artifact
+    from partisan_tpu.telemetry import benchplane
+    ledger_path = os.environ.get("PARTISAN_BENCH_LEDGER") or (
+        "/tmp/BENCH_ledger_smoke.jsonl" if args.smoke else None)
+    calib = benchplane.calibrate()
+    bench_rows = []
+    for r in all_rows:
+        if r.get("bench") != "control_suite" or "wall_s" not in r:
+            continue
+        rps = (round(r["rounds"] / r["wall_s"], 4)
+               if r.get("rounds") and r.get("wall_s") else None)
+        bench_rows.append(benchplane.make_row(
+            "control_suite", r["arm"],
+            config={k: r.get(k) for k in ("offered_milli",
+                                          "shed_rate_milli", "outage")},
+            n_nodes=r.get("n_nodes"), rounds=r.get("rounds"),
+            rounds_per_sec=rps, wall_s=r.get("wall_s"),
+            calibration=calib,
+            metrics={k: r[k] for k in ("slo_ok", "p99",
+                                       "delivered_origins",
+                                       "retransmissions",
+                                       "setpoint_last") if k in r}))
+    benchplane.append_rows_nonfatal(bench_rows, ledger_path)
+
     with open(args.out, "w") as f:
         for row in all_rows:
             f.write(json.dumps(row) + "\n")

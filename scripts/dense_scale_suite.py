@@ -244,6 +244,27 @@ def main():
                         signal.alarm(0)
                 with open(args.out, "a") as f:
                     f.write(json.dumps(row) + "\n")
+                # unified bench ledger (ISSUE 18): same row, canonical
+                # BenchRow schema; legacy artifacts above unchanged.
+                # Smoke runs land in /tmp so CI never dirties the
+                # committed trajectory (same policy as control_suite).
+                if "error" not in row:
+                    from partisan_tpu.telemetry import benchplane
+                    ledger_path = os.environ.get(
+                        "PARTISAN_BENCH_LEDGER") or (
+                        "/tmp/BENCH_ledger_smoke.jsonl"
+                        if args.smoke else None)
+                    benchplane.append_rows_nonfatal([benchplane.make_row(
+                        "dense_scale", f"{model}_{arm}",
+                        config={"churn": args.churn,
+                                "stream": bool(args.stream)},
+                        n_nodes=n, rounds=rounds, n_devices=n_dev,
+                        rounds_per_sec=row["rounds_per_sec"],
+                        wall_s=row["seconds"],
+                        metrics={k: row[k] for k in
+                                 ("collectives_per_round", "aot",
+                                  "setup_seconds", "stream_rows")
+                                 if k in row})], ledger_path)
                 if "error" not in row and not args.smoke:
                     comms_s = ("+".join(
                         f"{k}:{v}" for k, v in

@@ -266,6 +266,25 @@ def main(argv=None) -> int:
         for row in all_rows:
             f.write(json.dumps(row) + "\n")
     print(f"{len(all_rows)} rows -> {args.out}")
+
+    # unified bench ledger (ISSUE 18): one BenchRow per sweep point;
+    # smoke runs land in /tmp like the legacy artifact (CI must not
+    # dirty the committed trajectory)
+    from partisan_tpu.telemetry import benchplane
+    ledger_path = os.environ.get("PARTISAN_BENCH_LEDGER") or (
+        "/tmp/BENCH_ledger_smoke.jsonl" if args.smoke else None)
+    calib = benchplane.calibrate()
+    benchplane.append_rows_nonfatal([benchplane.make_row(
+        "load_suite", f"{r['arm']}_r{r['rate_milli']}",
+        config={"rate_milli": r["rate_milli"], "warm": r["warm"],
+                "slo_deadline_rounds": r["slo_deadline_rounds"]},
+        n_nodes=r["n_nodes"], rounds=r["rounds"],
+        rounds_per_sec=r["rounds_per_sec"], wall_s=r["wall_s"],
+        calibration=calib,
+        metrics={k: r[k] for k in ("throughput_per_node", "p50", "p99",
+                                   "shed", "retries") if k in r})
+        for r in all_rows if r.get("bench") == "load_suite"],
+        ledger_path)
     return 0
 
 

@@ -1,0 +1,257 @@
+"""Performance-observatory CLI (ISSUE 18): the perf regression gate.
+
+The runtime twin of ``scripts/observatory.py``: where that gate pins
+*compile* behavior, this one pins *throughput* and *suite runtime*
+against committed goldens, and renders the cross-PR bench trajectory
+from the unified ledger.
+
+* ``--check`` — replay the CHEAP pinned subset (flagship micro-rounds
+  at tier-1 shapes, AOT-loaded from ``aot_artifacts/`` so there is no
+  compile wall) against ``PERF_goldens.json``.  Calibration-normalized
+  rounds/sec; FAIL NAMED beyond the explicit fail band, warn-only in
+  the band below it.  Then the tier-1 runtime budget: per-test
+  durations in ``BENCH_suite_durations.jsonl`` vs their committed
+  budgets, and the projected suite total vs the 870 s ceiling.  Every
+  check appends its own measurements to ``BENCH_ledger.jsonl`` — the
+  gate's runs ARE trajectory.
+* ``--bless`` — regenerate ``PERF_goldens.json`` after an INTENDED perf
+  change: re-measure the pinned subset and (when a durations artifact
+  from a clean tier-1 run exists) regenerate the per-test budgets.
+  ``--only perf`` / ``--only budget`` re-blesses one half.
+* ``--report`` — the cross-suite trend table from the ledger ALONE (no
+  jax import, readable anywhere).
+
+Usage:  python scripts/perf_gate.py --check [--entry NAME ...]
+        python scripts/perf_gate.py --bless [--only perf|budget]
+        python scripts/perf_gate.py --report [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOLDEN = os.path.join(REPO, "PERF_goldens.json")
+LEDGER = os.path.join(REPO, "BENCH_ledger.jsonl")
+DURATIONS = os.path.join(REPO, "BENCH_suite_durations.jsonl")
+CACHE = os.path.join(REPO, ".jax_cache")
+
+
+def _jax_env() -> None:
+    """8-device virtual CPU mesh, set BEFORE the first jax import (same
+    setup as tests/conftest.py / scripts/observatory.py)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _benchplane_standalone():
+    """Load benchplane by file path: the --report path must not import
+    ``partisan_tpu`` (whose __init__ pulls the jax engine)."""
+    spec = importlib.util.spec_from_file_location(
+        "_benchplane_report",
+        os.path.join(REPO, "partisan_tpu", "telemetry", "benchplane.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _aot_loader(ledger, cache_dir):
+    """(fn, args, how) resolver for the gate: AOT artifact when present
+    and signature-matched (no compile), else the builder's jitted fn
+    compiled once under ledger attribution (warm-cache served)."""
+    from partisan_tpu import aot
+
+    def load(name, build):
+        fn, args = build()
+        prog = aot.maybe_load(name, cache_dir=cache_dir, ledger=ledger)
+        if prog is not None and prog.matches(args):
+            return prog, args, "aot"
+        with ledger.attribute(name):
+            fn.lower(*args).compile()
+        return fn, args, "jit"
+
+    return load
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--check", action="store_true",
+                   help="gate: fail NAMED on a normalized rounds/sec "
+                        "regression or a tier-1 runtime budget overrun")
+    g.add_argument("--bless", action="store_true",
+                   help="regenerate PERF_goldens.json (perf rows + "
+                        "suite budgets)")
+    g.add_argument("--report", action="store_true",
+                   help="cross-suite trend table from BENCH_ledger.jsonl "
+                        "(no jax import)")
+    ap.add_argument("--entry", action="append", default=None,
+                    metavar="NAME",
+                    help="restrict the perf leg to these pinned subset "
+                         "entries (repeatable)")
+    ap.add_argument("--only", choices=["perf", "budget"], default=None,
+                    help="--bless/--check one half of the golden")
+    ap.add_argument("--golden", default=GOLDEN)
+    ap.add_argument("--ledger", default=LEDGER,
+                    help="unified bench ledger (BENCH_ledger.jsonl)")
+    ap.add_argument("--durations", default=DURATIONS,
+                    help="per-test durations artifact from tier-1 runs")
+    ap.add_argument("--cache-dir", default=CACHE)
+    ap.add_argument("--fail-pct", type=float, default=45.0,
+                    help="normalized rounds/sec drop that FAILS the "
+                         "gate (noise floor on a contended 1-vCPU box)")
+    ap.add_argument("--warn-pct", type=float, default=18.0,
+                    help="drop that warns without failing")
+    ap.add_argument("--no-ledger-append", action="store_true",
+                    help="do not append this run's rows to the ledger")
+    ap.add_argument("--top", type=int, default=20,
+                    help="series rows in the --report table")
+    args = ap.parse_args(argv)
+
+    if args.report:
+        bp = _benchplane_standalone()
+        if not os.path.exists(args.ledger):
+            print(f"perf_gate: no ledger at {args.ledger} — run a bench "
+                  f"suite or --check first", file=sys.stderr)
+            return 1
+        print(bp.trend_report(bp.read_bench_ledger(args.ledger),
+                              top=args.top))
+        return 0
+
+    _jax_env()
+    from partisan_tpu.telemetry import benchplane as bp
+    from partisan_tpu.telemetry import observatory as obs
+    from partisan_tpu.verify.lint.fingerprint import FLAGSHIP
+
+    obs.configure_cache(args.cache_dir, record_all=True)
+    ledger = obs.CompileLedger(
+        path=os.path.join(REPO, obs.LEDGER_BASENAME), mode="a").install()
+    loader = _aot_loader(ledger, args.cache_dir)
+
+    subset = {k: v for k, v in bp.PERF_SUBSET.items() if k in FLAGSHIP}
+    if args.entry:
+        unknown = set(args.entry) - set(subset)
+        if unknown:
+            print(f"perf_gate: unknown subset entries {sorted(unknown)}; "
+                  f"pinned: {sorted(subset)}", file=sys.stderr)
+            return 2
+        subset = {k: subset[k] for k in args.entry}
+
+    t0 = time.time()
+
+    def progress(name):
+        print(f"  {name} ... [{time.time() - t0:5.1f}s]", flush=True)
+
+    print(f"  calibrating ... [{time.time() - t0:5.1f}s]", flush=True)
+    calib = bp.calibrate()
+    print(f"  calibration score {calib['score']:.0f} "
+          f"({calib['wall_s']:.1f}s)", flush=True)
+
+    if args.bless:
+        if args.only != "budget":
+            golden = bp.bless_perf(args.golden, FLAGSHIP, subset,
+                                   loader=loader, calibration=calib,
+                                   progress=progress)
+            for name, row in sorted(golden["rows"].items()):
+                print(f"  blessed {name}: norm_rps={row['norm_rps']:.2f} "
+                      f"raw={row['rounds_per_sec']:.1f} r/s "
+                      f"spread={row['spread_pct']:.0f}% via {row['how']}")
+        if args.only != "perf":
+            if not os.path.exists(args.durations):
+                print(f"perf_gate: no durations artifact at "
+                      f"{args.durations} — run tier-1 first; budgets "
+                      f"NOT blessed", file=sys.stderr)
+                if args.only == "budget":
+                    return 1
+            else:
+                if os.path.exists(args.golden):
+                    with open(args.golden, encoding="utf-8") as f:
+                        golden = json.load(f)
+                else:
+                    # --only budget on a fresh repo: start a minimal
+                    # golden (perf rows land on the next full --bless)
+                    golden = {"schema": bp.GOLDEN_SCHEMA,
+                              "calibration": calib, "rows": {}}
+                golden["suite_budget"] = bp.bless_budget(
+                    args.durations, calibration=calib)
+                with open(args.golden, "w", encoding="utf-8") as f:
+                    json.dump(golden, f, indent=2, sort_keys=True)
+                    f.write("\n")
+                b = golden["suite_budget"]
+                print(f"  blessed budgets: {len(b['tests'])} tests >= "
+                      f"{b['floor_s']:.0f}s floor, suite total "
+                      f"{b['total_s']:.0f}s vs {b['ceiling_s']:.0f}s "
+                      f"ceiling")
+        print(f"blessed -> {args.golden} ({time.time() - t0:.1f}s)")
+        ledger.close()
+        return 0
+
+    # ----------------------------------------------------------- check
+    if not os.path.exists(args.golden):
+        print(f"perf_gate: missing {args.golden} — run --bless first",
+              file=sys.stderr)
+        return 1
+    errors, warnings, rows = [], [], []
+    if args.only != "budget":
+        errors, warnings, rows = bp.check_perf(
+            args.golden, FLAGSHIP, subset, loader=loader,
+            fail_pct=args.fail_pct, warn_pct=args.warn_pct,
+            calibration=calib, progress=progress)
+    binfo = {}
+    if args.only != "perf":
+        with open(args.golden, encoding="utf-8") as f:
+            golden = json.load(f)
+        budget = golden.get("suite_budget")
+        if budget is None:
+            errors.append(
+                "suite_budget: PERF GOLDEN INCOMPLETE — no committed "
+                "tier-1 budgets in PERF_goldens.json; run a clean "
+                "tier-1 then scripts/perf_gate.py --bless --only budget")
+        elif not os.path.exists(args.durations):
+            warnings.append(
+                f"suite_budget: no durations artifact at "
+                f"{args.durations} this run — budget gate skipped "
+                f"(tier-1 writes it)")
+        else:
+            berr, bwarn, binfo = bp.check_budget(budget, args.durations,
+                                                 calibration=calib)
+            errors += berr
+            warnings += bwarn
+    if rows and not args.no_ledger_append:
+        bp.append_rows(rows, args.ledger)
+    gate = {"calib_score": calib["score"],
+            "perf_rows": {r["arm"]: {"rps": r["rounds_per_sec"],
+                                     "norm": r["norm_rounds_per_sec"],
+                                     "how": r["metrics"]["how"]}
+                          for r in rows}}
+    if binfo:
+        gate["budget"] = binfo
+    print(json.dumps({"gate": gate}, sort_keys=True))
+    ledger.close()
+    for w in warnings:
+        print(f"  warn: {w}")
+    if errors:
+        print(f"perf_gate: FAILED ({len(errors)} errors, "
+              f"{time.time() - t0:.1f}s):")
+        for e in errors:
+            print(f"  {e}")
+        return 1
+    print(f"perf_gate: clean — {len(rows)} perf rows within band"
+          + (f", projected tier-1 {binfo['projected_s']:.0f}s vs "
+             f"{binfo['ceiling_s']:.0f}s ceiling" if binfo else "")
+          + f" ({time.time() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -796,9 +796,21 @@ def load_suite_smoke():
     spec.loader.exec_module(ls)
     with tempfile.TemporaryDirectory() as td:
         out = os.path.join(td, "bench.jsonl")
-        rc = ls.main(["--n", "16", "--rates", "1000", "--rounds", "6",
-                      "--warm", "2", "--skip-sharded", "--skip-shed",
-                      "--out", out])
+        # N=16 toy rows must not land in the committed BENCH_ledger
+        # (trend_report groups by (suite, arm) — smoke rows would
+        # corrupt the real load_suite series)
+        prev = os.environ.get("PARTISAN_BENCH_LEDGER")
+        os.environ["PARTISAN_BENCH_LEDGER"] = os.path.join(
+            td, "ledger.jsonl")
+        try:
+            rc = ls.main(["--n", "16", "--rates", "1000", "--rounds",
+                          "6", "--warm", "2", "--skip-sharded",
+                          "--skip-shed", "--out", out])
+        finally:
+            if prev is None:
+                os.environ.pop("PARTISAN_BENCH_LEDGER", None)
+            else:
+                os.environ["PARTISAN_BENCH_LEDGER"] = prev
         assert rc == 0
         with open(out) as f:
             rows = [json.loads(line) for line in f]
@@ -954,7 +966,8 @@ def dense_scale_smoke():
     with tempfile.TemporaryDirectory() as td:
         out = os.path.join(td, "bench.jsonl")
         csvp = os.path.join(td, "results.csv")
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PARTISAN_BENCH_LEDGER=os.path.join(td, "ledger.jsonl"))
         env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
         rc = subprocess.run(
             [sys.executable, script, "--smoke", "--out", out,
@@ -1571,6 +1584,55 @@ def cold_start_gate():
         (proc.stdout or "") + (proc.stderr or "")
 
 
+def perf_gate_check_test():
+    """ISSUE 18 gate: ``scripts/perf_gate.py --check --only perf`` —
+    replay the pinned flagship micro-round subset (AOT-loaded, no
+    compile wall) against the committed PERF_goldens.json; a
+    calibration-normalized rounds/sec drop past the fail band fails
+    this row by name.  The budget half runs as its own row below so a
+    throughput regression and a runtime overrun stay separately
+    attributable."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    golden = os.path.join(repo, "PERF_goldens.json")
+    assert os.path.exists(golden), \
+        "missing PERF_goldens.json — run scripts/perf_gate.py --bless"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "perf_gate.py"),
+         "--check", "--only", "perf"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, \
+        (proc.stdout or "") + (proc.stderr or "")
+
+
+def runtime_budget_gate():
+    """ISSUE 18 gate: the tier-1 runtime budget — every per-test
+    duration in BENCH_suite_durations.jsonl within its committed
+    (calibration-normalized) budget, and the projected full-suite
+    total inside the 870 s ceiling's noise band (raw same-box
+    seconds; a timeout-truncated artifact totals ≈ the wall, so the
+    fail line sits ceiling_slack_pct above it).  Fails NAMED per
+    overrunning test, so the PR that slows the suite hears about it,
+    not the PR three later that trips CI truncation."""
+    import json as _json
+    from partisan_tpu.telemetry import benchplane as bp
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    golden = os.path.join(repo, "PERF_goldens.json")
+    assert os.path.exists(golden), \
+        "missing PERF_goldens.json — run scripts/perf_gate.py --bless"
+    with open(golden) as f:
+        budget = _json.load(f).get("suite_budget")
+    assert budget, ("PERF_goldens.json has no suite_budget — run a "
+                    "clean tier-1, then scripts/perf_gate.py --bless "
+                    "--only budget")
+    dur = os.path.join(repo, "BENCH_suite_durations.jsonl")
+    assert os.path.exists(dur), \
+        "no BENCH_suite_durations.jsonl — run tier-1 first"
+    errors, _warnings, info = bp.check_budget(budget, dur)
+    assert not errors, "\n".join(errors)
+    assert info["projected_s"] <= info["ceiling_fail_s"], info
+
+
 def span_parity_test():
     """ISSUE 16 tentpole contract: the message lifecycle tracer records
     the SAME span-event multiset (EXCHANGED excluded — it only exists
@@ -1883,6 +1945,10 @@ def build_matrix():
     # --verify over aot_artifacts/MANIFEST.json)
     add("perf/aot", "aot_roundtrip_test", "hyparview", "engine",
         aot_roundtrip_test)
+    add("observability/perf", "perf_gate_check", "hyparview", "engine",
+        perf_gate_check_test)
+    add("observability/perf", "runtime_budget_gate", "hyparview",
+        "engine", runtime_budget_gate)
     add("perf/aot", "cold_start_gate", "hyparview", "engine",
         cold_start_gate)
 

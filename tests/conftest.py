@@ -67,9 +67,13 @@ _RUN_LAST_7 = ("tests/test_tracer.py",)
 # tier 8: the ISSUE-17 AOT plane + Pallas route kernels are the newest
 _RUN_LAST_8 = ("tests/test_aot.py", "tests/test_route_kernel.py")
 
+_RUN_LAST_9 = ("tests/test_benchplane.py",)
+
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_9):
+            return 9
         if any(k in it.nodeid for k in _RUN_LAST_8):
             return 8
         if any(k in it.nodeid for k in _RUN_LAST_7):
@@ -102,8 +106,26 @@ def pytest_collection_modifyitems(config, items):
 import json  # noqa: E402
 import time  # noqa: E402
 
-_DUR_PATH = os.path.join(os.path.dirname(os.path.dirname(__file__)),
-                         "BENCH_suite_durations.jsonl")
+# $PARTISAN_DURATIONS_PATH redirects the per-test ledger (ISSUE 18):
+# a targeted run (or the perf_gate's planted-overrun tests) must not
+# truncate the full-suite artifact the runtime-budget gate reads
+_DUR_PATH = os.environ.get(
+    "PARTISAN_DURATIONS_PATH",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                 "BENCH_suite_durations.jsonl"))
+
+# Tests exercise the bench CLIs (soak.main, ls.main, suite smokes) —
+# their BenchRows must not land in the committed BENCH_ledger.jsonl
+# (trend_report groups by (suite, arm); toy-scale test rows would
+# corrupt the real series).  setdefault: an explicit caller override
+# (e.g. a harness pinning its own tempdir) still wins; subprocesses
+# spawned by tests inherit the redirect.
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "PARTISAN_BENCH_LEDGER",
+    os.path.join(tempfile.gettempdir(),
+                 f"BENCH_ledger_tests_{os.getpid()}.jsonl"))
 _DURATIONS = {}  # nodeid -> summed setup+call+teardown seconds
 _OUTCOMES = {}   # nodeid -> call outcome (setup outcome for skips/errors)
 _SUITE_T0 = time.time()

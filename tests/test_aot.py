@@ -15,6 +15,7 @@ dir against the repo's canonical ``.jax_cache``, so reruns are
 persistent-cache loads, not compiles.
 """
 
+import functools
 import json
 import os
 
@@ -28,6 +29,11 @@ from partisan_tpu import aot
 # --------------------------------------------------------- tiny registry
 
 
+# lru_cache (ISSUE 18 velocity): every test that calls REG[name]() used
+# to get a FRESH jit wrapper — a full re-trace per test (~7 s each on
+# this box) for byte-identical programs.  One trace, shared; no test
+# donates or mutates its args, so reuse is safe.
+@functools.lru_cache(maxsize=None)
 def _build_engine():
     import partisan_tpu as pt
     from partisan_tpu.models.hyparview import HyParView
@@ -37,6 +43,7 @@ def _build_engine():
     return pt.make_step(cfg, proto, donate=False), (world,)
 
 
+@functools.lru_cache(maxsize=None)
 def _build_sharded():
     import partisan_tpu as pt
     from partisan_tpu.models.hyparview import HyParView

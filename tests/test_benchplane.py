@@ -1,0 +1,315 @@
+"""Benchplane (ISSUE 18): BenchRow schema, calibration, and the perf /
+runtime-budget gates.
+
+Timing-free where possible: the schema and budget tests never run jax;
+the gate round-trips use a toy jitted program with micro iteration
+counts, and every regression/overrun verdict is PLANTED by editing the
+golden, never by asserting wall-clock — the same CI-stability
+discipline as test_observatory's recompile gate."""
+
+import json
+import os
+import tempfile
+import unittest
+
+import jax
+import jax.numpy as jnp
+
+from partisan_tpu.telemetry import benchplane as bp
+
+
+def _short_calib():
+    return {"score": 100.0, "wall_s": 0.1, "blocks": 10}
+
+
+def _toy_registry():
+    @jax.jit
+    def step(x):
+        return x + 1.0, jnp.sum(x)
+
+    return {"toy": lambda: (step, (jnp.zeros(64, jnp.float32),))}
+
+
+_SUBSET = {"toy": {"iters": 6, "warm": 1, "repeats": 2}}
+
+
+class TestBenchRowSchema(unittest.TestCase):
+    def test_round_trip_through_ledger(self):
+        tmp = tempfile.mkdtemp()
+        path = os.path.join(tmp, "BENCH_ledger.jsonl")
+        row = bp.make_row("toy_suite", "toy_arm",
+                          config={"churn": 0.01}, n_nodes=64, rounds=10,
+                          rounds_per_sec=123.4, wall_s=0.081,
+                          calibration=_short_calib(),
+                          metrics={"k": 1})
+        self.assertEqual(bp.validate(row), [])
+        bp.append_rows([row, row], path)
+        back = bp.read_bench_ledger(path)
+        self.assertEqual(len(back), 2)
+        self.assertEqual(back[0], json.loads(json.dumps(row)))
+        self.assertEqual(back[0]["schema"], bp.SCHEMA)
+        # normalization: raw / calibration score
+        self.assertAlmostEqual(back[0]["norm_rounds_per_sec"],
+                               123.4 / 100.0, places=4)
+        self.assertEqual(back[0]["config_fp"],
+                         bp.config_fingerprint({"churn": 0.01}))
+
+    def test_validate_names_every_violation(self):
+        ok = bp.make_row("s", "a", rounds_per_sec=1.0,
+                         calibration=_short_calib())
+        self.assertEqual(bp.validate(ok), [])
+        bad = dict(ok, schema="bogus/v9")
+        self.assertTrue(any("BENCHROW SCHEMA" in e
+                            for e in bp.validate(bad)))
+        bad = dict(ok)
+        bad.pop("suite")
+        self.assertTrue(any("BENCHROW FIELD suite" in e
+                            for e in bp.validate(bad)))
+        bad = dict(ok, wall_s=-1.0)
+        self.assertTrue(any("BENCHROW FIELD wall_s" in e
+                            and "negative" in e for e in bp.validate(bad)))
+        bad = dict(ok, rounds_per_sec="fast")
+        self.assertTrue(any("not numeric" in e for e in bp.validate(bad)))
+        bad = dict(ok, norm_rounds_per_sec=None)
+        self.assertTrue(any("norm_rounds_per_sec" in e
+                            for e in bp.validate(bad)))
+        self.assertIn("not a mapping", bp.validate([1, 2])[0])
+
+    def test_append_refuses_invalid_row(self):
+        tmp = tempfile.mkdtemp()
+        path = os.path.join(tmp, "l.jsonl")
+        with self.assertRaises(ValueError):
+            bp.append_rows([{"schema": bp.SCHEMA}], path)
+        self.assertFalse(os.path.exists(path))
+
+    def test_convert_trials_backfills_valid_legacy_rows(self):
+        tmp = tempfile.mkdtemp()
+        trials = os.path.join(tmp, "BENCH_trials.jsonl")
+        with open(trials, "w") as f:
+            f.write(json.dumps({
+                "trial": 0, "seconds": 2.0, "rounds_per_sec": 500.0,
+                "rounds": 1000, "n": 1 << 20, "churn": 0.01,
+                "fanout": 2, "variant": "packed", "infected": 0.9,
+                "device": "cpu", "t_wall": 1700000000.0}) + "\n")
+        rows = bp.convert_trials(trials)
+        self.assertEqual(len(rows), 1)
+        self.assertEqual(bp.validate(rows[0]), [])
+        self.assertEqual(rows[0]["suite"], "bench_rumor")
+        self.assertEqual(rows[0]["arm"], "packed")
+        self.assertTrue(rows[0]["legacy"])
+        self.assertIsNone(rows[0]["calib_score"])
+        self.assertTrue(rows[0]["cpu_fallback"])
+
+
+class TestCalibration(unittest.TestCase):
+    def test_determinism_band(self):
+        # the workload is fixed; two short runs on one box must land in
+        # the same ballpark (wide band: 1-vCPU scheduler noise)
+        a = bp.calibrate(0.25, force=True)
+        b = bp.calibrate(0.25, force=True)
+        self.assertGreater(a["score"], 0)
+        self.assertGreater(a["blocks"], 1)
+        ratio = a["score"] / b["score"]
+        self.assertTrue(0.4 < ratio < 2.5,
+                        f"calibration unstable: {a} vs {b}")
+
+    def test_short_runs_do_not_poison_process_cache(self):
+        bp.calibrate(0.2, force=True)
+        self.assertIsNone(bp._CALIB)
+
+
+class TestPerfGateRoundTrip(unittest.TestCase):
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+        self.golden = os.path.join(self.tmp, "PERF_goldens.json")
+        self.reg = _toy_registry()
+        self.calib = _short_calib()
+        bp.bless_perf(self.golden, self.reg, _SUBSET,
+                      calibration=self.calib)
+
+    def test_bless_then_check_is_clean(self):
+        errs, warns, rows = bp.check_perf(self.golden, self.reg, _SUBSET,
+                                          calibration=self.calib)
+        self.assertEqual(errs, [])
+        self.assertEqual(len(rows), 1)
+        self.assertEqual(rows[0]["suite"], "perf_gate")
+        self.assertEqual(rows[0]["arm"], "toy")
+        self.assertEqual(bp.validate(rows[0]), [])
+
+    def test_planted_regression_fails_named(self):
+        with open(self.golden) as f:
+            g = json.load(f)
+        # plant: pretend the blessed box was 100x faster than reality
+        g["rows"]["toy"]["norm_rps"] *= 100.0
+        g["rows"]["toy"]["spread_pct"] = 0.0
+        with open(self.golden, "w") as f:
+            json.dump(g, f)
+        errs, _warns, rows = bp.check_perf(self.golden, self.reg,
+                                           _SUBSET,
+                                           calibration=self.calib)
+        self.assertEqual(len(errs), 1)
+        self.assertIn("PERF REGRESSION", errs[0])
+        self.assertIn("toy", errs[0])
+        self.assertIn("re-bless", errs[0])
+        self.assertEqual(len(rows), 1)  # the failing run still ledgers
+
+    def test_warn_band_between_warn_and_fail(self):
+        with open(self.golden) as f:
+            g = json.load(f)
+        # ~67% apparent drop, bands at 10/90: the re-measured toy fn
+        # can run up to ~2.7x faster or ~3.3x slower than at bless time
+        # (1-vCPU scheduler wobble) without crossing either boundary
+        g["rows"]["toy"]["norm_rps"] *= 3.0
+        g["rows"]["toy"]["spread_pct"] = 0.0
+        with open(self.golden, "w") as f:
+            json.dump(g, f)
+        errs, warns, _rows = bp.check_perf(
+            self.golden, self.reg, _SUBSET, fail_pct=90.0, warn_pct=10.0,
+            calibration=self.calib)
+        self.assertEqual(errs, [])
+        self.assertTrue(warns and "perf warn" in warns[0])
+
+    def test_missing_golden_row_fails_named(self):
+        with open(self.golden) as f:
+            g = json.load(f)
+        g["rows"] = {}
+        with open(self.golden, "w") as f:
+            json.dump(g, f)
+        errs, _w, _r = bp.check_perf(self.golden, self.reg, _SUBSET,
+                                     calibration=self.calib)
+        self.assertTrue(errs and "PERF GOLDEN MISSING" in errs[0])
+
+    def test_bless_preserves_budget_section(self):
+        with open(self.golden) as f:
+            g = json.load(f)
+        g["suite_budget"] = {"ceiling_s": 870.0, "tests": {}}
+        with open(self.golden, "w") as f:
+            json.dump(g, f)
+        bp.bless_perf(self.golden, self.reg, _SUBSET,
+                      calibration=self.calib)
+        with open(self.golden) as f:
+            g2 = json.load(f)
+        self.assertEqual(g2["suite_budget"]["ceiling_s"], 870.0)
+        self.assertIn("toy", g2["rows"])
+
+
+class TestRuntimeBudgetGate(unittest.TestCase):
+    DUR = [("tests/test_a.py::test_fast", 0.5),
+           ("tests/test_b.py::test_big", 20.0),
+           ("tests/test_c.py::test_mid", 6.0)]
+
+    def _durations(self, rows):
+        path = os.path.join(self.tmp, "BENCH_suite_durations.jsonl")
+        with open(path, "w") as f:
+            for test, d in rows:
+                f.write(json.dumps({"bench": "suite_durations",
+                                    "test": test, "duration_s": d,
+                                    "outcome": "passed"}) + "\n")
+        return path
+
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+        self.calib = _short_calib()
+        self.budget = bp.bless_budget(self._durations(self.DUR),
+                                      ceiling_s=100.0,
+                                      calibration=self.calib)
+
+    def test_bless_pools_small_tests_under_floor(self):
+        self.assertEqual(set(self.budget["tests"]),
+                         {"tests/test_b.py::test_big",
+                          "tests/test_c.py::test_mid"})
+        self.assertAlmostEqual(self.budget["small_total_s"], 0.5)
+        self.assertAlmostEqual(self.budget["total_s"], 26.5)
+
+    def test_clean_run_passes(self):
+        errs, warns, info = bp.check_budget(
+            self.budget, self._durations(self.DUR),
+            calibration=self.calib)
+        self.assertEqual(errs, [])
+        self.assertAlmostEqual(info["projected_s"], 26.5, places=1)
+
+    def test_planted_slow_test_fails_named(self):
+        rows = [("tests/test_a.py::test_fast", 0.5),
+                ("tests/test_b.py::test_big", 90.0),   # planted: 4.5x
+                ("tests/test_c.py::test_mid", 6.0)]
+        errs, _warns, _info = bp.check_budget(
+            self.budget, self._durations(rows), calibration=self.calib)
+        self.assertTrue(errs)
+        self.assertIn("DURATION BUDGET OVERRUN", errs[0])
+        self.assertIn("test_b.py::test_big", errs[0])
+        self.assertIn("re-tier", errs[0])
+
+    def test_projected_total_over_ceiling_fails_named(self):
+        tight = bp.bless_budget(self._durations(self.DUR),
+                                ceiling_s=10.0, calibration=self.calib)
+        errs, _warns, info = bp.check_budget(
+            tight, self._durations(self.DUR), calibration=self.calib)
+        self.assertTrue(any("TIER-1 RUNTIME BUDGET" in e for e in errs))
+        self.assertGreater(info["projected_s"], 10.0)
+
+    def test_projected_total_in_noise_band_warns_only(self):
+        # 26.5s projected vs a 25s ceiling: inside the 15% noise band
+        # (fail line 28.75s) — a warn, not an error.  A timeout-killed
+        # run's artifact totals ≈ the wall by construction, so a
+        # margin-free ceiling would be a coin flip against calibration
+        # and scheduler noise.
+        near = bp.bless_budget(self._durations(self.DUR),
+                               ceiling_s=25.0, calibration=self.calib)
+        errs, warns, info = bp.check_budget(
+            near, self._durations(self.DUR), calibration=self.calib)
+        self.assertEqual(errs, [])
+        self.assertTrue(any("runtime budget warn" in w for w in warns))
+        self.assertAlmostEqual(info["ceiling_fail_s"], 28.75, delta=0.06)
+
+    def test_partial_run_still_projects_full_suite(self):
+        # only the fast test observed: unobserved tests are charged
+        # their blessed budgets, so truncation cannot hide the total
+        errs, _warns, info = bp.check_budget(
+            self.budget,
+            self._durations([("tests/test_a.py::test_fast", 0.5)]),
+            calibration=self.calib)
+        self.assertEqual(errs, [])
+        self.assertAlmostEqual(info["projected_s"], 26.5, places=1)
+
+    def test_slower_box_is_not_an_overrun(self):
+        # same suite, box half as fast: durations 2x, score 0.5x —
+        # normalized values unchanged, gate stays green
+        slow_rows = [(t, d * 2.0) for t, d in self.DUR]
+        slow_calib = {"score": 50.0, "wall_s": 0.1, "blocks": 5}
+        errs, _warns, _info = bp.check_budget(
+            self.budget, self._durations(slow_rows),
+            calibration=slow_calib)
+        self.assertEqual(errs, [])
+
+
+class TestTrendReport(unittest.TestCase):
+    def test_report_from_ledger_rows_alone(self):
+        calib_a = {"score": 100.0, "wall_s": 0.1, "blocks": 10}
+        calib_b = {"score": 170.0, "wall_s": 0.1, "blocks": 17}
+        rows = [bp.make_row("load_suite", "engine_r2000",
+                            rounds_per_sec=50.0, calibration=calib_a),
+                bp.make_row("load_suite", "engine_r2000",
+                            rounds_per_sec=85.0, calibration=calib_b),
+                bp.make_row("dense_scale", "hyparview_explicit",
+                            rounds_per_sec=10.0, calibration=calib_a)]
+        rows[1]["t_wall"] = rows[0]["t_wall"] + 100.0
+        rep = bp.trend_report(rows)
+        self.assertIn("load_suite", rep)
+        self.assertIn("engine_r2000", rep)
+        self.assertIn("2 suites", rep)
+        self.assertIn("norm r/s", rep)
+        # 1.7x box drift, identical normalized throughput -> +0% delta
+        self.assertIn("+0%", rep)
+        self.assertIn("1.70x", rep)
+
+    def test_legacy_rows_fall_back_to_raw(self):
+        legacy = {"schema": bp.SCHEMA, "suite": "bench_rumor",
+                  "arm": "packed", "rounds_per_sec": 400.0,
+                  "norm_rounds_per_sec": None, "calib_score": None,
+                  "t_wall": 1.0, "run": "legacy_backfill"}
+        rep = bp.trend_report([legacy])
+        self.assertIn("raw r/s", rep)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -308,6 +308,13 @@ class TestSparseControl:
             host_traj.append(hp["setpoint"][0])
         assert host_traj == traj
 
+    # the _sharded_run pair is slow-tier since ISSUE 18 (~20 s warm —
+    # the sharded control-step compile dominates).  Tier-1 keeps the
+    # closed loop executed unsharded (test_loop_actually_moves), the
+    # host twin bit-parity, and the control=None byte-identity below;
+    # the sharded trajectory identity and collective budget re-prove
+    # themselves in the slow tier.
+    @pytest.mark.slow
     @needs_mesh
     def test_sharded_matches_unsharded(self):
         """The plane updates from post-psum totals, so the 8-shard
@@ -316,6 +323,7 @@ class TestSparseControl:
         straj, _ = _sharded_run()
         assert straj == traj
 
+    @pytest.mark.slow
     @needs_mesh
     def test_budget_controllers_on(self):
         """Closing the loop adds ZERO collectives: exactly one

@@ -46,6 +46,7 @@ def run_hyparview(n, rounds, sharded):
 
 @needs_mesh
 class TestShardedHyParView:
+    @pytest.mark.slow
     def test_sharded_run_converges_and_matches_unsharded(self):
         """50+ rounds of HyParView N=256 with the node axis sharded over
         8 devices: (a) the overlay is connected and symmetric, (b) every
@@ -64,6 +65,22 @@ class TestShardedHyParView:
         assert m_plain == m_shard
 
         # and state parity, leaf by leaf
+        for lp, lsh in zip(jax.tree_util.tree_leaves(w_plain.state),
+                           jax.tree_util.tree_leaves(w_shard.state)):
+            np.testing.assert_array_equal(np.asarray(lp), np.asarray(lsh))
+
+    def test_sharded_short_run_matches_unsharded(self):
+        """Tier-1 twin of the 60-round convergence+parity drive above
+        (ISSUE 18 velocity: the full drive is two 60-round host loops
+        at N=256, ~50 s warm, now slow-tier).  16 rounds keep the
+        layout-invariance law — metrics and states bit-identical
+        between the sharded and unsharded runs — executed every run;
+        the connectivity/symmetry check needs the full horizon and
+        stays with the slow twin."""
+        n, rounds = 256, 16
+        _, _, w_plain, m_plain = run_hyparview(n, rounds, sharded=False)
+        _, _, w_shard, m_shard = run_hyparview(n, rounds, sharded=True)
+        assert m_plain == m_shard
         for lp, lsh in zip(jax.tree_util.tree_leaves(w_plain.state),
                            jax.tree_util.tree_leaves(w_shard.state)):
             np.testing.assert_array_equal(np.asarray(lp), np.asarray(lsh))
@@ -251,6 +268,25 @@ class TestShardMapDataplane:
             m_shard.append({k: int(v) for k, v in msh.items()})
         return cfg, proto, w_plain, w_shard, m_plain, m_shard
 
+    def test_dataplane_bit_equal_short(self):
+        """Tier-1 twin of the 60-round dataplane bit-match below
+        (ISSUE 18 velocity, ~22 s warm → slow tier): 16 rounds keep
+        the per-round metric and state bit-equality and the
+        nothing-dropped invariants executed every run; connectivity
+        needs the full horizon and stays with the slow twin."""
+        n, rounds = 256, 16
+        _, _, w_plain, w_shard, m_plain, m_shard = self._run_pair(
+            n, rounds)
+        for mp, msh in zip(m_plain, m_shard):
+            assert all(msh[k] == v for k, v in mp.items()), (mp, msh)
+            assert msh["xshard_dropped"] == 0, msh
+            assert msh["out_dropped"] == 0, msh
+        for lp, lsh in zip(jax.tree_util.tree_leaves(w_plain.state),
+                           jax.tree_util.tree_leaves(w_shard.state)):
+            np.testing.assert_array_equal(np.asarray(lp),
+                                          np.asarray(lsh))
+
+    @pytest.mark.slow
     def test_dataplane_bit_equal_to_unsharded_step(self):
         """60 rounds of HyParView N=256 through the explicit dataplane:
         every per-round metric and every final state leaf bit-matches

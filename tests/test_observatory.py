@@ -17,6 +17,7 @@ import unittest
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import partisan_tpu as pt
 from partisan_tpu import peer_service, telemetry
@@ -55,6 +56,38 @@ class TestStreamingRunner(unittest.TestCase):
             [(i, (i - 1) // 2) for i in range(1, n)])
         cls.reg = telemetry.default_registry()
 
+    def test_streamed_rows_bit_equal_small(self):
+        """Tier-1 twin of the N=64 HyParView parity drive below
+        (ISSUE 18 velocity: a streamed program carries a host-callback
+        custom call, is never persistently cacheable, and recompiles
+        every session — and the compile cost tracks the step BODY, not
+        N, so the twin shrinks the protocol, not just the cluster).
+        Same drain, same EQUAL-not-close assertion, over a
+        FullMembership step at N=16; the flagship-shape run is
+        slow-tier."""
+        from partisan_tpu.models.full_membership import FullMembership
+        n = 16
+        cfg = pt.Config(n_nodes=n, inbox_cap=8, periodic_interval=2,
+                        seed=3)
+        proto = FullMembership(cfg)
+        world = peer_service.cluster(
+            pt.init_world(cfg, proto), proto,
+            [(i, (i - 1) // 2) for i in range(1, n)])
+        sink_w = _Rows()
+        telemetry.run_with_telemetry(
+            cfg, proto, 8, window=4, registry=self.reg,
+            sinks=[sink_w], world=world)
+        spec = StreamSpec(keep_rows=True)
+        telemetry.run_with_telemetry(
+            cfg, proto, 8, window=4, registry=self.reg,
+            sinks=[_Rows()], world=world, stream=spec)
+        windowed = [r for r in sink_w.rows
+                    if "round" in r and "rounds_per_sec" not in r]
+        self.assertEqual(spec.rows_streamed, 8)
+        self.assertEqual(spec.rows, windowed)
+        self.assertEqual(spec.last_round, 7)
+
+    @pytest.mark.slow
     def test_streamed_rows_bit_equal_to_windowed_flush(self):
         sink_w = _Rows()
         telemetry.run_with_telemetry(
@@ -274,13 +307,22 @@ class TestRecompileGate(unittest.TestCase):
         self.assertEqual(
             check_goldens(self.golden, self.reg, ledger=self.led), [])
 
-    def test_planted_recompile_fails_named(self):
+    def test_planted_eviction_fails_named_as_cache_evicted(self):
+        # ISSUE 18: a miss with the module hash UNCHANGED is the
+        # PR-13 false-miss footgun (atime-evicted / never-warmed cache
+        # entry), NOT a recompile regression — the gate must name it
+        # distinctly, point at warm_cache.py, and ledger the verdict
         jax.clear_caches()
         configure_cache(os.path.join(self.tmp, "cache_empty"))
         errs = check_goldens(self.golden, self.reg, ledger=self.led)
         self.assertEqual(len(errs), 1)
-        self.assertIn("UNEXPECTED RECOMPILE", errs[0])
+        self.assertIn("CACHE_EVICTED", errs[0])
+        self.assertIn("warm_cache.py", errs[0])
+        self.assertNotIn("hash drifted", errs[0])
         self.assertIn("toy", errs[0])
+        ev = [r for r in self.led.rows if r["event"] == "cache_evicted"]
+        self.assertEqual(len(ev), 1)
+        self.assertEqual(ev[0]["program"], "toy")
 
     def test_program_drift_fails_named(self):
         jax.clear_caches()

@@ -124,25 +124,20 @@ class TestAnalysis:
         # acks cause nothing
         assert c["commit_ack"] == []
 
-    def test_annotations_prune_independent_pairs(self):
-        """Depth-2 sweep with causality annotations must explore fewer
-        schedules than without: omission pairs whose types sit on causally
-        UNRELATED chains are implied by their singletons (the filibuster
-        pruning, :697-930).  2PC has one chain, so the workload here is a
-        stacked protocol with two — membership gossip vs broadcast mail —
-        whose cross-chain pairs are prunable."""
+    def _prune_workload(self, samples, n_rounds, max_schedules, n=4,
+                        delay=6):
+        """Shared body for the depth-2 pruning law at two scales."""
         from partisan_tpu.peer_service import cluster, send_ctl
         from partisan_tpu.verify.model_checker import ModelChecker
         from partisan_tpu.models.demers import MailOverMembership
         from partisan_tpu.models.stack import Stacked
-        n = 4
         cfg = pt.Config(n_nodes=n, inbox_cap=16, periodic_interval=3)
         proto = Stacked(FullMembership(cfg), MailOverMembership(cfg))
 
         def setup(world):
             world = cluster(world, proto, [(i, 0) for i in range(1, n)])
             return send_ctl(world, proto, 1, "ctl_broadcast",
-                            rumor=0, delay=6)
+                            rumor=0, delay=delay)
 
         def invariant(world):
             return True  # exploration-shape test; outcomes irrelevant
@@ -151,19 +146,40 @@ class TestAnalysis:
         # rounds_of_state + the workload's own setup: gossip only fires
         # from a populated membership, and background classification
         # (prunable periodic sends) is relative to the sampled state
-        ann = analysis.infer_causality(cfg, proto, samples=128,
+        ann = analysis.infer_causality(cfg, proto, samples=samples,
                                        rounds_of_state=6, setup=setup)
         assert "mail" not in analysis.reachable_types(ann, ["gossip"]), ann
         assert "gossip" in ann["__background__"], ann
 
-        mc = ModelChecker(cfg, proto, setup, invariant, n_rounds=10)
+        mc = ModelChecker(cfg, proto, setup, invariant, n_rounds=n_rounds)
         full = mc.check(candidate_typs=typs, max_drops=2,
-                        max_schedules=2000)
+                        max_schedules=max_schedules)
         pruned = mc.check(candidate_typs=typs, max_drops=2,
-                          max_schedules=2000, annotations=ann)
+                          max_schedules=max_schedules, annotations=ann)
         assert pruned.explored < full.explored, \
             (pruned.explored, full.explored)
         assert pruned.passed > 0  # singletons still explored
+
+    @pytest.mark.slow
+    def test_annotations_prune_independent_pairs(self):
+        """Depth-2 sweep with causality annotations must explore fewer
+        schedules than without: omission pairs whose types sit on causally
+        UNRELATED chains are implied by their singletons (the filibuster
+        pruning, :697-930).  2PC has one chain, so the workload here is a
+        stacked protocol with two — membership gossip vs broadcast mail —
+        whose cross-chain pairs are prunable."""
+        self._prune_workload(samples=128, n_rounds=10, max_schedules=2000)
+
+    def test_annotations_prune_independent_pairs_small(self):
+        """Tier-1 twin of the depth-2 pruning sweep above (ISSUE 18
+        velocity: the full sweep was the suite's slowest test at ~100 s
+        warm).  Same protocol stack, same causality facts, same
+        pruned < full law — a 3-node cluster, fewer inference samples,
+        and a shorter horizon with the mail fired early (delay=3) so
+        cross-chain pairs exist inside it; the full-scale sweep runs in
+        the slow tier."""
+        self._prune_workload(samples=32, n_rounds=6, max_schedules=2000,
+                             n=3, delay=3)
 
     def test_background_vs_gated_tick_split(self):
         """__background__ holds the unconditionally periodic sends; a

@@ -21,6 +21,7 @@ def _settled(n, rounds=300, churn=0.01, settle=60, seed=3):
 
 class TestDenseScamp:
     @pytest.mark.standard
+    @pytest.mark.slow
     def test_overlay_connects_and_sizes_match_engine_regime(self):
         """Engine-anchored distributional parity (VERDICT r4 #4; the old
         1.5..12.0 band was wide enough to hide a 25% view thinning).
@@ -66,6 +67,23 @@ class TestDenseScamp:
             f"engine-anchored band [{engine_mean:.2f}, "
             f"{2 * engine_mean:.2f}] — walker C "
             f"(config.scamp_walker_slots) mis-sized?")
+
+    def test_overlay_connects_small(self):
+        """Tier-1 twin of the engine-anchored regime check above
+        (ISSUE 18 velocity: the LIVE anchor — 220 host-loop engine
+        rounds at N=256 — costs ~50 s warm and now runs in the slow
+        tier).  The dense overlay is still settled and health-checked
+        every run; the anchor here is the committed calibration
+        constant from the full test's docstring (engine mean 2.87,
+        measured 2026-08-01), so a walker-slot mis-sizing still fails
+        loudly, just against the pinned regime instead of a re-measured
+        one."""
+        ENGINE_MEAN = 2.87  # live anchor, re-measured by the slow twin
+        _, st = _settled(256, seed=11)
+        h = {k: float(np.asarray(v)) for k, v in scamp_health(st).items()}
+        unreached = 1.0 - h["reached"] / h["live"]
+        assert unreached <= 0.015, h
+        assert ENGINE_MEAN <= h["mean_view"] <= 2.0 * ENGINE_MEAN, h
 
     def test_subscriptions_spread_beyond_contacts(self):
         """Walk keeps must land subscriptions at nodes OTHER than the

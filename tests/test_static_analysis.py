@@ -158,17 +158,36 @@ class TestStaticCoversDynamic:
     missed emission site), exactly the unsoundness the static pass
     exists to rule out."""
 
-    def test_superset_per_protocol(self):
-        cfg = pt.Config(n_nodes=4, inbox_cap=8)
-        for proto in _protocols(cfg):
+    @staticmethod
+    def _assert_superset(cfg, protos, samples):
+        for proto in protos:
             st = static_causality(proto)
-            dy = analysis.infer_causality(cfg, proto, samples=64)
+            dy = analysis.infer_causality(cfg, proto, samples=samples)
             name = type(proto).__name__
             for t in proto.msg_types:
                 assert set(dy.get(t, [])) <= set(st[t]), \
                     (name, t, dy.get(t), st[t])
             assert set(dy.get("__tick__", [])) <= set(st["__tick__"]), \
                 (name, dy["__tick__"], st["__tick__"])
+
+    @pytest.mark.slow
+    def test_superset_per_protocol(self):
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        self._assert_superset(cfg, _protocols(cfg), samples=64)
+
+    def test_superset_representatives(self):
+        """Tier-1 twin of the all-protocols sweep above (ISSUE 18
+        velocity: the full sweep costs ~77 s warm — one dynamic
+        inference run per protocol).  Two cheap representatives keep
+        the static ⊇ dynamic law executed every run: FullMembership
+        (timer-driven gossip) and DirectMailAcked (request/ack chains);
+        the full dozen — including the super()-reaching XBot walk —
+        runs in the slow tier."""
+        from partisan_tpu.models.demers import DirectMailAcked
+        from partisan_tpu.models.full_membership import FullMembership
+        cfg = pt.Config(n_nodes=4, inbox_cap=8)
+        self._assert_superset(
+            cfg, [FullMembership(cfg), DirectMailAcked(cfg)], samples=24)
 
 
 def _golden_static_cover(fname, proto, type_map=None, edge_map=None):

@@ -256,10 +256,17 @@ class TestAckTaps:
 
 @needs_mesh
 @pytest.mark.standard
+@pytest.mark.slow
 class TestShardedParity:
     """Sharded vs unsharded span-event multisets on the 8-device mesh:
     identical lifecycles (EXCHANGED excluded — it only exists where an
-    exchange exists), zero overflow both sides."""
+    exchange exists), zero overflow both sides.
+
+    Slow tier since ISSUE 18 (~21 s warm — two trace-instrumented
+    compiles).  Tier-1 keeps sharded trace execution covered by
+    tests/test_flight.py::TestFlightParity::
+    test_sharded_dataplane_trace_matches_unsharded and the unsharded
+    lifecycle classes above."""
 
     @pytest.fixture(scope="class")
     def both(self):
