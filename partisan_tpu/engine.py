@@ -654,6 +654,7 @@ def make_step(
     chaos: Optional[Any] = None,
     control: Optional[Any] = None,
     trace: Optional[Any] = None,
+    latency: Optional[Any] = None,
 ) -> Callable[..., Tuple]:
     """Compile one simulation round for `proto`.
 
@@ -677,8 +678,11 @@ def make_step(
     and matching drop/delay/duplicate events edit the ready buffer right
     after the held split — all in-scan arithmetic over a static event
     table, no host involvement per round.  The step metrics gain
-    ``chaos_dropped``/``chaos_delayed``/``chaos_duplicated`` counters.
-    The sharded dataplane accepts the same schedule
+    ``chaos_dropped``/``chaos_delayed``/``chaos_duplicated`` counters —
+    plus the four Byzantine counters (``verify.chaos.BYZ_COUNTER_KEYS``)
+    when the schedule carries equivocate/forge/replay/corrupt events
+    (ISSUE 19; byzantine-free schedules keep the exact pre-existing
+    program).  The sharded dataplane accepts the same schedule
     (``parallel/dataplane.make_sharded_step(chaos=)``) and applies it
     shard-locally, bit-identically to this path.  Passing a
     :class:`verify.chaos.DynamicSchedule` instead compiles the chaos
@@ -710,6 +714,14 @@ def make_step(
     ``step(world, tring)`` or ``step(world, fring, tring)``.
     ``trace=None`` (default) traces ZERO extra ops — byte-identical
     programs, warm-cache safe.
+
+    ``latency`` (a :class:`verify.latency.LatencyPlane`) compiles the
+    geo/WAN latency topology into the round: every fresh emission is
+    stamped with its region-pair one-way delay (+ deterministic jitter)
+    exactly where the transport ingress/egress delay is stamped, and
+    ages through the ordinary held-buffer arithmetic.  Zero collectives,
+    zero new metric keys; ``latency=None`` (default) traces ZERO extra
+    ops — byte-identical programs, warm-cache safe.
     """
     cfg = autotune(cfg, proto)
     N = cfg.n_nodes
@@ -759,7 +771,7 @@ def make_step(
         from .verify.chaos import (DynamicSchedule, apply_chaos_msgs,
                                    apply_chaos_msgs_table,
                                    apply_chaos_nodes,
-                                   apply_chaos_nodes_table)
+                                   apply_chaos_nodes_table, counter_keys)
         dynamic_chaos = isinstance(chaos, DynamicSchedule)
         if dynamic_chaos and flight is not None:
             raise ValueError(
@@ -775,13 +787,17 @@ def make_step(
                 "trace its spans")
         if not dynamic_chaos:
             chaos.validate(n_nodes=N, n_types=n_types)
+    if latency is not None:
+        # lazy import, same reason as chaos above
+        from .verify.latency import apply_latency as apply_latency_plane
+        latency.validate(N)
     if control is not None:
         # lazy import, same pattern as flight/chaos above
         from .control.plane import (plane_metrics, setpoint_values,
                                     update_plane, validate_control)
         known_metrics = set(STEP_METRIC_KEYS) | set(rc_names)
         if chaos is not None:
-            known_metrics |= set(CHAOS_METRIC_KEYS)
+            known_metrics |= set(counter_keys(chaos))
         validate_control(control, known_metrics, proto.actuator_names,
                          where="make_step")
 
@@ -839,6 +855,12 @@ def make_step(
                 tcaps.append(_tr.wire_capture(
                     trace, _tr.EV_CHAOS_DELAYED, pre_chaos,
                     keep=cmasks["delayed"], seq=seq_all))
+                if chaos.has_byzantine:
+                    # forged slots and salted payloads invalidate the
+                    # round-start hash pass — rehash the ready buffer so
+                    # EV_DELIVERED stamps the bytes that actually ship
+                    # (the sharded path already rehashes post-exchange)
+                    seq_all = _tr.msg_seq(trace, now)
             else:
                 now, chaos_held, chaos_counts = apply_chaos_msgs(
                     chaos, rnd, now)
@@ -874,6 +896,11 @@ def make_step(
                          - re_held_ct)
         if chaos_counts is not None:
             fault_dropped = fault_dropped - chaos_counts["chaos_delayed"]
+            if "chaos_forged" in chaos_counts:
+                # forged slots were never in `ready` — without the
+                # correction each injection would mask one real drop
+                fault_dropped = (fault_dropped
+                                 + chaos_counts["chaos_forged"])
 
         # -- connection lanes: partition-key hash or random spread over the
         #    k parallel connections (dispatch_pid, partisan_util.erl:142-201)
@@ -939,6 +966,11 @@ def make_step(
         if cfg.ingress_delay or cfg.egress_delay:
             new = new.replace(
                 delay=new.delay + cfg.ingress_delay + cfg.egress_delay)
+        # geo/WAN latency plane (ISSUE 19): region-pair one-way delay
+        # stamped once at emission, aging through the ordinary held
+        # split — same discipline as the transport delays above
+        if latency is not None:
+            new = apply_latency_plane(latency, new)
         if interpose_send is not None:
             new = _interp(interpose_send, new, rnd, world)  # once, at send
         if trace is not None:

@@ -37,8 +37,33 @@ Safety note (crash faults, the fault model of prop_partisan_hbbft): only
 the scheduled leader proposes for its epoch, so at most ONE block can ever
 gain a quorum per epoch — per-epoch agreement degenerates to
 committed-or-absent, absence is repaired by anti-entropy, and forks are
-impossible without equivocation.  Byzantine equivocation is out of scope
-exactly as it is for the reference worker (the library handles it there).
+impossible without equivocation.
+
+Byzantine faults (ISSUE 19, the chaos plane's equivocate / forge /
+replay / corrupt kinds) are IN scope since this worker is the protocol
+the reference built its Byzantine harness around.  ``hardened=True``
+(default) compiles three defenses:
+
+  * commit quorum over DISTINCT echo senders, keyed on the digest — a
+    per-node voter bitmask kills the vote inflation that duplicated or
+    replayed echoes buy an equivocating leader (without it, the
+    explorer's 4-event schedule forks the chain:
+    tests/test_byzantine.py);
+  * propose acceptance checks the SCHEDULED leader id (``src == epoch
+    mod N``) — a forged proposal claiming another epoch's leader is
+    ignored;
+  * sync installs verify ``digest(batch) == digest`` — a forged or
+    corrupted catch-up block cannot poison the ledger.
+
+Detection runs in BOTH modes (the counters are evidence, not defense):
+``suspect`` counts echoes whose digest conflicts with the stored
+proposal (equivocation evidence), ``forked`` counts sync messages
+carrying a different digest for an epoch already committed — surfaced
+through ``health_counters`` as ``hbbft_equivocation_suspected`` /
+``hbbft_fork_detected``.  ``hardened=False`` keeps the pre-ISSUE-19
+per-MESSAGE vote arithmetic: the explorer's demonstration target (find,
+shrink and replay an equivocation schedule that forks it), never the
+mode to deploy.
 """
 
 from __future__ import annotations
@@ -67,6 +92,9 @@ class HbbftState:
     ledger_digest: jax.Array  # [N, E] committed digest per epoch (0 = absent)
     ledger_batch: jax.Array   # [N, E, Bk] committed batch per epoch
     fetch_cursor: jax.Array   # [N] next epoch the anti-entropy walk probes
+    voted: jax.Array          # [N, W] uint32 distinct-echo-sender bitmask
+    suspect: jax.Array        # [N] cumulative equivocation evidence
+    forked: jax.Array         # [N] cumulative conflicting-committed-digest evidence
 
 
 def _digest(batch: jax.Array) -> jax.Array:
@@ -89,7 +117,7 @@ class HbbftWorker(ProtocolBase):
 
     def __init__(self, cfg: Config, batch_size: int = 4, buf_cap: int = 16,
                  max_epochs: int = 32, epoch_len: int = 6,
-                 ae_interval: int = 2):
+                 ae_interval: int = 2, hardened: bool = True):
         assert epoch_len >= 4, "propose/echo/commit needs 4 rounds"
         self.cfg = cfg
         self.Bk = batch_size
@@ -97,7 +125,9 @@ class HbbftWorker(ProtocolBase):
         self.E = max_epochs
         self.L = epoch_len
         self.ae_interval = ae_interval
+        self.hardened = hardened
         n = cfg.n_nodes
+        self.W = (n + 31) // 32  # voter-bitmask words
         self.f = (n - 1) // 3
         self.quorum = n - self.f
         # Liveness requires the whole echo fan (one echo per node per epoch,
@@ -136,6 +166,9 @@ class HbbftWorker(ProtocolBase):
             ledger_digest=jnp.zeros((n, self.E), jnp.int32),
             ledger_batch=jnp.full((n, self.E, self.Bk), -1, jnp.int32),
             fetch_cursor=jnp.zeros((n,), jnp.int32),
+            voted=jnp.zeros((n, self.W), jnp.uint32),
+            suspect=jnp.zeros((n,), jnp.int32),
+            forked=jnp.zeros((n,), jnp.int32),
         )
 
     def _everyone(self) -> jax.Array:
@@ -178,6 +211,10 @@ class HbbftWorker(ProtocolBase):
         digest to everyone (the RBC 'echo' role collapsed to one phase)."""
         epoch, batch = m.data["epoch"], m.data["batch"]
         ok = (epoch == row.cur_epoch) & ~row.have_batch
+        if self.hardened:
+            # only the SCHEDULED leader may propose its epoch — a forged
+            # proposal claiming someone else's slot is dead on arrival
+            ok = ok & (m.src == (epoch % cfg.n_nodes))
         d = _digest(batch)
         row = row.replace(
             have_batch=row.have_batch | ok,
@@ -190,10 +227,26 @@ class HbbftWorker(ProtocolBase):
         return row, em
 
     def handle_echo(self, cfg, me, row: HbbftState, m: Msgs, key):
-        """Count echoes for this epoch's digest; senders echo at most once
-        per epoch so the count is over distinct nodes."""
+        """Count echoes for this epoch's digest.  Honest senders echo at
+        most once per epoch, but duplicated / replayed copies arrive as
+        separate messages — hardened mode therefore counts DISTINCT
+        senders via a voter bitmask; unhardened keeps the inflatable
+        per-message count (the explorer's fork target)."""
         ok = (m.data["epoch"] == row.cur_epoch) \
             & (m.data["digest"] == row.cur_digest) & row.have_batch
+        # detection (both modes): an echo for our epoch whose digest
+        # conflicts with the stored proposal is equivocation evidence
+        mismatch = (m.data["epoch"] == row.cur_epoch) & row.have_batch \
+            & (m.data["digest"] != row.cur_digest)
+        row = row.replace(suspect=row.suspect + mismatch.astype(jnp.int32))
+        if self.hardened:
+            src = jnp.clip(m.src, 0, cfg.n_nodes - 1)
+            word = src // 32
+            bit = jnp.uint32(1) << jnp.uint32(src % 32)
+            already = (row.voted[word] & bit) != 0
+            ok = ok & ~already
+            row = row.replace(voted=row.voted.at[word].set(
+                jnp.where(ok, row.voted[word] | bit, row.voted[word])))
         return row.replace(votes=row.votes + ok.astype(jnp.int32)), \
             self.no_emit()
 
@@ -211,8 +264,20 @@ class HbbftWorker(ProtocolBase):
 
     def handle_sync(self, cfg, me, row: HbbftState, m: Msgs, key):
         """sync/2: install a caught-up block into the ledger."""
-        row = self._install(row, m.data["epoch"], m.data["digest"],
-                            m.data["batch"], m.data["digest"] != 0)
+        epoch, digest, batch = (m.data["epoch"], m.data["digest"],
+                                m.data["batch"])
+        # detection (both modes): a sync carrying a DIFFERENT digest for
+        # an epoch we already committed is direct fork evidence
+        e = jnp.clip(epoch, 0, self.E - 1)
+        conflict = (epoch >= 0) & (epoch < self.E) & (digest != 0) \
+            & (row.ledger_digest[e] != 0) & (row.ledger_digest[e] != digest)
+        row = row.replace(forked=row.forked + conflict.astype(jnp.int32))
+        ok = digest != 0
+        if self.hardened:
+            # the digest must recompute from the batch — forged or
+            # corrupted catch-up blocks cannot poison the ledger
+            ok = ok & (_digest(batch) == digest)
+        row = self._install(row, epoch, digest, batch, ok)
         return row, self.no_emit()
 
     # ------------------------------------------------------------------ timer
@@ -231,7 +296,8 @@ class HbbftWorker(ProtocolBase):
             cur_batch=jnp.where(is_new, -1, row.cur_batch),
             have_batch=row.have_batch & ~is_new,
             echoed=row.echoed & ~is_new,
-            votes=jnp.where(is_new, 0, row.votes))
+            votes=jnp.where(is_new, 0, row.votes),
+            voted=jnp.where(is_new, jnp.uint32(0), row.voted))
         # batch = first Bk pending txns (hbbft batch_size)
         order = jnp.argsort(jnp.where(row.buf >= 0, 0, 1), stable=True)
         batch = row.buf[order][: self.Bk]
@@ -258,6 +324,20 @@ class HbbftWorker(ProtocolBase):
         row = row.replace(fetch_cursor=jnp.where(ae_due, cursor + 1,
                                                  row.fetch_cursor))
         return row, self.merge(pr, fq, cap=self.tick_emit_cap)
+
+    # ------------------------------------------------------------------ health
+
+    def health_counters(self, state: HbbftState) -> Dict[str, jax.Array]:
+        """Byzantine-evidence totals (ISSUE 19): both counters accumulate
+        in hardened AND unhardened mode — detection is evidence, not
+        defense — so the explorer's ``no_view_poisoning``-style probes and
+        the soak's health plane see equivocation even on the target that
+        falls to it."""
+        return {
+            "hbbft_equivocation_suspected":
+                jnp.sum(state.suspect).astype(jnp.int32),
+            "hbbft_fork_detected": jnp.sum(state.forked).astype(jnp.int32),
+        }
 
 
 # -------------------------------------------------------------------- host API

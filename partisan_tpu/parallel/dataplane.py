@@ -245,6 +245,7 @@ def make_sharded_step(
     chaos=None,
     control=None,
     trace=None,
+    latency=None,
 ) -> Callable[..., Tuple]:
     """Compile one explicitly-sharded simulation round.
 
@@ -287,7 +288,22 @@ def make_sharded_step(
     duplicate copies join the shard-local held traffic without breaking
     the residency invariant.  Both planes are shard-local arithmetic:
     the 2-collective budget holds chaos-on (the metric psum stack grows
-    three ``chaos_*`` rows, still ONE psum).
+    three ``chaos_*`` rows, still ONE psum).  Byzantine events (ISSUE
+    19) run at the same pre-exchange point — a forged message
+    materializes only on the shard owning its claimed src (the same
+    residency every real message obeys) and the four Byzantine counters
+    ride the same stacked psum.  A ``verify.chaos.DynamicSchedule`` is
+    rejected here (explicit ValueError): the traced-table step arity is
+    the unsharded explorer's contract — run found schedules through the
+    static path.
+
+    ``latency`` (a :class:`verify.latency.LatencyPlane`, ISSUE 19)
+    stamps the geo/WAN region-pair one-way delay (+ deterministic
+    field-hashed jitter, never buffer positions — the sharded/unsharded
+    bit-parity discipline) onto fresh emissions exactly where the
+    transport delays are stamped.  Pure shard-local arithmetic: zero
+    added collectives, zero new metric keys, and ``latency=None``
+    compiles byte-identical programs.
 
     ``trace`` (a :class:`telemetry.tracer.TraceSpec`) turns on the
     ISSUE-16 message lifecycle tracer: each shard records its own span
@@ -365,8 +381,19 @@ def make_sharded_step(
                     f"has trailing shape "
                     f"{proto.data_spec[trace.seq_field][0]}")
     if chaos is not None:
-        from ..verify.chaos import apply_chaos_msgs, apply_chaos_nodes
+        from ..verify.chaos import (DynamicSchedule, apply_chaos_msgs,
+                                    apply_chaos_nodes, counter_keys)
+        if isinstance(chaos, DynamicSchedule):
+            raise ValueError(
+                "make_sharded_step does not support DynamicSchedule: "
+                "the traced-table arity (step(world, chaos_table)) is "
+                "the unsharded explorer's contract.  Compile the found "
+                "schedule through the static chaos= path instead — the "
+                "static planes are bit-identical here.")
         chaos.validate(n_nodes=cfg.n_nodes)
+    if latency is not None:
+        from ..verify.latency import apply_latency as apply_latency_plane
+        latency.validate(cfg.n_nodes)
     if control is not None:
         from ..control.plane import (metric_names as ctl_metric_names,
                                      plane_metrics, setpoint_values,
@@ -442,7 +469,8 @@ def make_sharded_step(
             if trace is not None:
                 pre_chaos = now
                 now, chaos_held, chaos_counts, cmasks = apply_chaos_msgs(
-                    chaos, rnd, now, want_masks=True)
+                    chaos, rnd, now, want_masks=True,
+                    node_lo=node_base, node_hi=node_base + n_loc)
                 tcaps.append(_tr.wire_capture(
                     trace, _tr.EV_CHAOS_DROPPED, pre_chaos,
                     keep=cmasks["dropped"], seq=seq_all))
@@ -451,7 +479,8 @@ def make_sharded_step(
                     keep=cmasks["delayed"], seq=seq_all))
             else:
                 now, chaos_held, chaos_counts = apply_chaos_msgs(
-                    chaos, rnd, now)
+                    chaos, rnd, now,
+                    node_lo=node_base, node_hi=node_base + n_loc)
             if chaos_held is not None:
                 held = msgops.concat(held, chaos_held)
 
@@ -499,6 +528,10 @@ def make_sharded_step(
         if chaos_counts is not None:
             # re-held (chaos-delayed) messages are deferred, not dropped
             fault_dropped = fault_dropped - chaos_counts["chaos_delayed"]
+            if "chaos_forged" in chaos_counts:
+                # forged slots were never in `ready` — mirror the engine
+                fault_dropped = (fault_dropped
+                                 + chaos_counts["chaos_forged"])
 
         # -- flight recorder (ISSUE 3): this shard's post-exchange wire
         #    slice into its local ring row — the same capture point as
@@ -550,6 +583,10 @@ def make_sharded_step(
         if cfg.ingress_delay or cfg.egress_delay:
             new = new.replace(
                 delay=new.delay + cfg.ingress_delay + cfg.egress_delay)
+        # geo/WAN latency plane (ISSUE 19): stamped once at emission over
+        # message fields only — bit-identical to the unsharded stamp
+        if latency is not None:
+            new = apply_latency_plane(latency, new)
         if interpose_send is not None:
             new = _interp(interpose_send, new, rnd, world)
         if trace is not None:
@@ -581,7 +618,7 @@ def make_sharded_step(
             xdrop,                                          # xshard_dropped
         ]
         if chaos_counts is not None:
-            rows += [chaos_counts[k] for k in _CHAOS_KEYS]
+            rows += [chaos_counts[k] for k in chaos_keys]
         if rc_names:
             # workload-plane round counters (ISSUE 8): shard-local
             # partial sums riding the SAME stacked psum — the collective
@@ -615,8 +652,10 @@ def make_sharded_step(
             return new_world, tring, metrics
         return new_world, metrics
 
-    sum_keys = _SUM_KEYS + (_CHAOS_KEYS if chaos is not None else ()) \
-        + rc_names
+    # chaos counter rows: the byzantine-free key set is exactly the
+    # pre-ISSUE-19 one, so existing chaos-on programs stay byte-stable
+    chaos_keys = counter_keys(chaos) if chaos is not None else ()
+    sum_keys = _SUM_KEYS + chaos_keys + rc_names
 
     def spec_of(x):
         return P(NODE_AXIS) if getattr(x, "ndim", 0) >= 1 else P()

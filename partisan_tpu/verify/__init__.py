@@ -12,3 +12,5 @@ from . import chaos  # noqa: F401  (ISSUE 4: compiled fault schedules)
 from . import health  # noqa: F401  (ISSUE 4: in-scan health plane)
 from .chaos import ChaosSchedule, DynamicSchedule  # noqa: F401
 from . import explorer  # noqa: F401  (ISSUE 7: batched fault-space search)
+from . import latency  # noqa: F401  (ISSUE 19: geo/WAN latency plane)
+from .latency import LatencyPlane  # noqa: F401

@@ -27,6 +27,14 @@ instead:
     the held buffer with their own delivery delay.  Every edit is
     counted (``chaos_dropped`` / ``chaos_delayed`` /
     ``chaos_duplicated`` step metrics), never silent (SURVEY §7.3).
+  * Byzantine kinds (ISSUE 19) on the same capture point: equivocate
+    (conflicting payload variants to disjoint receiver halves), forge
+    (a message its claimed src never sent), replay (record-and-replay
+    of delivered traffic c rounds later) and corrupt (in-flight payload
+    mutation) — commission faults as table rows, counted in the
+    :data:`BYZ_COUNTER_KEYS` step metrics, batchable by the explorer
+    like any omission (SURVEY §2.9: the reference's hbbft worker exists
+    to survive exactly these).
 
 Both ``engine.make_step(chaos=)`` and
 ``parallel/dataplane.make_sharded_step(chaos=)`` consume the same
@@ -55,6 +63,7 @@ import numpy as np
 
 from ..ops.msg import Msgs
 from ..ops import msg as msgops
+from ..ops.bitset import mix32 as _mix32
 
 # event kinds, column 1 of the table
 KIND_CRASH = 0      # nodes [a, b] crash-stop                   (c unused)
@@ -69,11 +78,50 @@ KIND_DROP_TYP = 7   # msgs typ=a dst=b (-1 wildcard) dropped for c rounds
                     # explorer perturbs (ISSUE 7): "drop the recovery
                     # channel" is a typ, not a (src, dst) pair
 
+# Byzantine kinds (ISSUE 19): commission faults on the same ready-buffer
+# capture point.  The reference wraps an hbbft worker whose whole point
+# is surviving these (partisan_hbbft_worker.erl, SURVEY §2.9); here they
+# are table rows the explorer can enumerate like any omission.
+KIND_EQUIVOCATE = 8  # src=a (-1 any) sends conflicting variants of its
+                     # typ=b messages: receivers with odd dst get the
+                     # payload XOR-salted by c, even-dst receivers the
+                     # original — one logical send, two disjoint stories
+KIND_FORGE = 9       # inject a message claiming src=a (never sent by a)
+                     # to dst=b with wire type c, payload zeroed — the
+                     # view-poisoning attack
+KIND_REPLAY = 10     # re-deliver this round's already-delivered typ=a
+                     # messages (to dst=b, -1 any) again c rounds later
+KIND_CORRUPT = 11    # msgs src=a dst=b (-1 wildcard): every integer
+                     # payload field XOR-mutated by salt c in flight
+
 KIND_NAMES = ("crash", "recover", "partition", "heal", "drop", "delay",
-              "duplicate", "drop_typ")
+              "duplicate", "drop_typ", "equivocate", "forge", "replay",
+              "corrupt")
 _NODE_KINDS = (KIND_CRASH, KIND_RECOVER, KIND_PARTITION, KIND_HEAL)
-_MSG_KINDS = (KIND_DROP, KIND_DELAY, KIND_DUP, KIND_DROP_TYP)
+_MSG_KINDS = (KIND_DROP, KIND_DELAY, KIND_DUP, KIND_DROP_TYP,
+              KIND_EQUIVOCATE, KIND_FORGE, KIND_REPLAY, KIND_CORRUPT)
+_BYZ_KINDS = (KIND_EQUIVOCATE, KIND_FORGE, KIND_REPLAY, KIND_CORRUPT)
 N_COLS = 5
+
+# step-metric keys of the Byzantine planes, in kind order; emitted by the
+# message plane whenever the schedule carries any Byzantine event (the
+# dynamic table twin always emits them — its program must cover the whole
+# alphabet).  Ride the sharded dataplane's ONE stacked psum as extra rows.
+BYZ_COUNTER_KEYS = ("chaos_equivocated", "chaos_forged", "chaos_replayed",
+                    "chaos_corrupted")
+
+
+def counter_keys(sched) -> Tuple[str, ...]:
+    """The chaos-counter metric keys a step compiled against ``sched``
+    emits: the base omission triple always, plus :data:`BYZ_COUNTER_KEYS`
+    when the schedule carries Byzantine events (or is a
+    :class:`DynamicSchedule`, whose one program covers the whole
+    alphabet).  Schedules without Byzantine rows keep the exact
+    pre-ISSUE-19 key set, so their compiled programs stay byte-stable."""
+    base = ("chaos_dropped", "chaos_delayed", "chaos_duplicated")
+    if isinstance(sched, DynamicSchedule) or sched.has_byzantine:
+        return base + BYZ_COUNTER_KEYS
+    return base
 
 # the padding row of a dynamic table: kind -1 matches no plane, round -1
 # never fires — a guaranteed no-op on both the node and message planes
@@ -171,6 +219,60 @@ class ChaosSchedule:
             raise ValueError(f"drop window must be >= 1 rounds, got {rounds}")
         return self._add(rnd, KIND_DROP_TYP, typ, dst, rounds)
 
+    def equivocate(self, rnd: int, src: int = -1, typ: int = 0,
+                   salt: int = 1) -> "ChaosSchedule":
+        """Node ``src`` (-1 = every sender) equivocates on its wire-type
+        ``typ`` messages this round: odd-numbered receivers get the
+        payload's non-scalar fields XOR-mutated by ``salt``, even ones
+        the original — one logical broadcast telling two disjoint
+        receiver halves two different stories.  Scalar control headers
+        (epoch counters, digests the receiver recomputes anyway) stay
+        intact so the variant is still a well-formed protocol message."""
+        if typ < 0:
+            raise ValueError(
+                f"equivocate type must be >= 0, got {typ} — equivocation "
+                f"needs a concrete wire type to tell two stories about")
+        if salt < 1:
+            raise ValueError(f"equivocate salt must be >= 1, got {salt}")
+        return self._add(rnd, KIND_EQUIVOCATE, src, typ, salt)
+
+    def forge(self, rnd: int, src: int, dst: int,
+              typ: int) -> "ChaosSchedule":
+        """Inject a message claiming ``src`` that ``src`` never sent, to
+        ``dst`` with wire type ``typ`` and an all-zero payload — the
+        view-poisoning attack (a forged join/membership claim).  No
+        wildcards: a forgery is a concrete lie about a concrete id."""
+        if src < 0 or dst < 0:
+            raise ValueError(
+                f"forge of an out-of-range id: src/dst ({src}, {dst}) "
+                f"must both be concrete node ids >= 0")
+        if typ < 0:
+            raise ValueError(f"forge type must be >= 0, got {typ}")
+        return self._add(rnd, KIND_FORGE, src, dst, typ)
+
+    def replay(self, rnd: int, typ: int, dst: int = -1,
+               after: int = 1) -> "ChaosSchedule":
+        """Record this round's delivered wire-type ``typ`` messages (to
+        ``dst``, -1 = any) and re-deliver the copies ``after`` rounds
+        later — the adversarial record-and-replay (a stale ack or vote
+        presented again after the protocol moved on)."""
+        if typ < 0:
+            raise ValueError(f"replay type must be >= 0, got {typ}")
+        if after < 1:
+            raise ValueError(
+                f"replay horizon must be >= 1 rounds, got {after}")
+        return self._add(rnd, KIND_REPLAY, typ, dst, after)
+
+    def corrupt(self, rnd: int, src: int = -1, dst: int = -1,
+                salt: int = 1) -> "ChaosSchedule":
+        """Deterministically mutate matching messages in flight this
+        round: every integer payload field is XORed with a hash of
+        ``salt`` — the bit-flipping relay (distinct from equivocate:
+        EVERY matching receiver sees the same corrupted payload)."""
+        if salt < 1:
+            raise ValueError(f"corrupt salt must be >= 1, got {salt}")
+        return self._add(rnd, KIND_CORRUPT, src, dst, salt)
+
     # ------------------------------------------------------------- queries
 
     @property
@@ -203,8 +305,29 @@ class ChaosSchedule:
         return bool(self._kinds((KIND_DUP,)))
 
     @property
+    def has_equivocate(self) -> bool:
+        return bool(self._kinds((KIND_EQUIVOCATE,)))
+
+    @property
+    def has_forge(self) -> bool:
+        return bool(self._kinds((KIND_FORGE,)))
+
+    @property
+    def has_replay(self) -> bool:
+        return bool(self._kinds((KIND_REPLAY,)))
+
+    @property
+    def has_corrupt(self) -> bool:
+        return bool(self._kinds((KIND_CORRUPT,)))
+
+    @property
+    def has_byzantine(self) -> bool:
+        return bool(self._kinds(_BYZ_KINDS))
+
+    @property
     def has_msg_events(self) -> bool:
-        return self.has_drop or self.has_delay or self.has_dup
+        return (self.has_drop or self.has_delay or self.has_dup
+                or self.has_byzantine)
 
     def last_heal_round(self) -> int:
         """The round after which no injected disruption remains standing:
@@ -220,6 +343,9 @@ class ChaosSchedule:
                 ends.append(rnd)
             elif kind in (KIND_DROP, KIND_DROP_TYP):
                 ends.append(rnd + max(c, 1) - 1)
+            elif kind == KIND_REPLAY:
+                # the replayed copies only land c rounds after the event
+                ends.append(rnd + max(c, 1))
             else:
                 ends.append(rnd)
         return max(ends)
@@ -293,7 +419,40 @@ class ChaosSchedule:
                     raise ValueError(
                         f"{where}: dst {b} out of [0, {n}) — the event "
                         f"would never match a message")
-            else:  # src/dst message kinds
+            elif kind == KIND_EQUIVOCATE:
+                if n is not None and a >= n:
+                    raise ValueError(
+                        f"{where}: src {a} out of [0, {n}) — the event "
+                        f"would never match a message")
+                if n_types is not None and b >= n_types:
+                    raise ValueError(
+                        f"{where}: equivocation on a typ outside the "
+                        f"protocol's wire space — type {b} out of "
+                        f"[0, {n_types})")
+            elif kind == KIND_FORGE:
+                if n is not None and (a >= n or b >= n):
+                    raise ValueError(
+                        f"{where}: forge of an out-of-range id — "
+                        f"src/dst ({a}, {b}) out of [0, {n})")
+                if n_types is not None and c >= n_types:
+                    raise ValueError(
+                        f"{where}: wire type {c} out of [0, {n_types}) "
+                        f"— the forged message would hit no handler")
+            elif kind == KIND_REPLAY:
+                if n_types is not None and a >= n_types:
+                    raise ValueError(
+                        f"{where}: wire type {a} out of [0, {n_types}) "
+                        f"— the event would never match a message")
+                if n is not None and b >= n:
+                    raise ValueError(
+                        f"{where}: dst {b} out of [0, {n}) — the event "
+                        f"would never match a message")
+                if n_rounds is not None and rnd + c >= n_rounds:
+                    raise ValueError(
+                        f"{where}: replay horizon past rounds — the "
+                        f"copies land at round {rnd + c} but the run is "
+                        f"only {n_rounds} rounds")
+            else:  # src/dst message kinds (drop / delay / dup / corrupt)
                 if n is not None and (a >= n or b >= n):
                     raise ValueError(
                         f"{where}: src/dst ({a}, {b}) out of [0, {n}) "
@@ -353,37 +512,111 @@ def _match(m: Msgs, src: int, dst: int) -> jax.Array:
     return hit
 
 
-def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
-                     want_masks: bool = False):
-    """Apply drop / delay / duplicate events to the READY buffer (post
-    held-split, pre fault-plane — the point where both execution paths
-    still hold every message on its src's shard).  Returns
-    ``(now, extra_held, counts)``:
+def _salt32(c) -> jax.Array:
+    """Hash an event salt into a nonzero uint32 XOR pattern (the |1 keeps
+    at least one bit set, so a salted payload always differs)."""
+    return _mix32(jnp.asarray(c, jnp.uint32)) | jnp.uint32(1)
 
-      * ``now`` with dropped and re-held slots invalidated;
-      * ``extra_held`` — a flat buffer of chaos-delayed re-holds and
-        duplicate copies for the caller to concat into its held traffic
-        (``None`` when the schedule has no delay/dup events, so the
-        carry shape is unchanged — program shape depends only on the
-        static schedule);
+
+def _xor_data(m: Msgs, xmask: jax.Array, vectors_only: bool) -> Msgs:
+    """XOR every integer payload field with the per-slot uint32 pattern
+    ``xmask`` ([cap], 0 = untouched).  ``vectors_only`` skips scalar
+    (per-slot ()-shaped) fields — equivocation mutates only the DATA a
+    message carries (batch contents, view samples), keeping scalar
+    control headers (epoch counters, recomputed digests) intact so the
+    variant still parses as a well-formed message of its type."""
+    data = dict(m.data)
+    for name, arr in data.items():
+        if not jnp.issubdtype(arr.dtype, jnp.integer):
+            continue
+        if vectors_only and arr.ndim == 1:
+            continue
+        x = xmask.reshape((xmask.shape[0],) + (1,) * (arr.ndim - 1))
+        data[name] = (arr.astype(jnp.uint32) ^ x).astype(arr.dtype)
+    return m.replace(data=data)
+
+
+def _forge_one(now: Msgs, do: jax.Array, src, dst, typ,
+               rnd: jax.Array) -> Tuple[Msgs, jax.Array]:
+    """Write one forged message into the first free slot of ``now`` when
+    ``do`` (scalar bool) holds and a free slot exists.  Returns the
+    edited buffer and the 0/1 fired count.  The forged slot rides a
+    connection of its own ((src, dst, channel 0, lane 0) that the honest
+    src never uses this round), so the router's per-connection order
+    hash — not buffer position — decides its inbox slot: bit-identical
+    between the sharded and unsharded paths."""
+    free = ~now.valid
+    do = do & jnp.any(free)
+    idx = jnp.argmax(free)
+    fired = do.astype(jnp.int32)
+
+    def wr(arr, val):
+        return arr.at[idx].set(jnp.where(do, jnp.asarray(val, arr.dtype),
+                                         arr[idx]))
+
+    zero = jnp.int32(0)
+    data = {name: arr.at[idx].set(
+                jnp.where(do, jnp.zeros_like(arr[idx]), arr[idx]))
+            for name, arr in now.data.items()}
+    now = now.replace(
+        valid=now.valid.at[idx].set(now.valid[idx] | do),
+        src=wr(now.src, src), dst=wr(now.dst, dst), typ=wr(now.typ, typ),
+        channel=wr(now.channel, zero), lane=wr(now.lane, zero),
+        delay=wr(now.delay, zero), born=wr(now.born, rnd),
+        data=data)
+    return now, fired
+
+
+def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
+                     want_masks: bool = False, *, node_lo=None,
+                     node_hi=None):
+    """Apply drop / delay / duplicate / Byzantine events to the READY
+    buffer (post held-split, pre fault-plane — the point where both
+    execution paths still hold every message on its src's shard).
+    Returns ``(now, extra_held, counts)``:
+
+      * ``now`` with dropped and re-held slots invalidated, corrupted /
+        equivocated payloads mutated in place, and forged slots written
+        into free capacity;
+      * ``extra_held`` — a flat buffer of chaos-delayed re-holds,
+        duplicate copies and replay copies for the caller to concat into
+        its held traffic (``None`` when the schedule has no
+        delay/dup/replay events, so the carry shape is unchanged —
+        program shape depends only on the static schedule);
       * ``counts`` — ``{"chaos_dropped", "chaos_delayed",
-        "chaos_duplicated"}`` int32 scalars over THIS buffer (the
-        sharded step psums them; the totals match the unsharded run).
+        "chaos_duplicated"}`` int32 scalars over THIS buffer, plus the
+        four :data:`BYZ_COUNTER_KEYS` when the schedule carries
+        Byzantine events (the sharded step psums them; the totals match
+        the unsharded run).  Schedules without Byzantine rows emit the
+        exact pre-existing key set and program.
+
+    ``node_lo``/``node_hi`` are the sharded caller's GLOBAL node-id
+    bounds for this shard: a forged message materializes only on the
+    shard that owns its claimed src (``node_lo <= src < node_hi``), the
+    same src-residency invariant every real message obeys.  ``None``
+    (the unsharded engine) means every id is local.
 
     ``want_masks=True`` (the lifecycle tracer's tap, ISSUE 16) appends a
     fourth element: ``{"dropped", "delayed"}`` — [cap] bool masks
     positionally ALIGNED to the INPUT buffer (every plane here edits
     ``valid`` in place, never moves slots), where ``delayed`` covers
-    re-holds and duplicate copies.  Python-level gating: the default
-    call builds the exact pre-existing program.
+    re-holds, duplicate copies and replay copies.  Forged slots are NOT
+    in the masks (they have no input-aligned position); the engine
+    rehashes the buffer after this plane when forgery is on.
+    Python-level gating: the default call builds the exact pre-existing
+    program.
 
-    Order inside the plane: drops first, then delays on the survivors,
-    then duplication of the remaining ready slots — one deterministic
-    pipeline, identical on both paths.
+    Order inside the plane: drops first, then corruption and
+    equivocation of the survivors' payloads, then delays, duplication,
+    replay of the remaining ready slots, and forged injections last —
+    one deterministic pipeline, identical on both paths and in the
+    traced-table twin.
     """
     zero = jnp.int32(0)
     counts = {"chaos_dropped": zero, "chaos_delayed": zero,
               "chaos_duplicated": zero}
+    if sched.has_byzantine:
+        counts.update({k: zero for k in BYZ_COUNTER_KEYS})
     if not sched.has_msg_events:
         if want_masks:
             z = jnp.zeros((now.cap,), bool)
@@ -406,8 +639,27 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
         counts["chaos_dropped"] = jnp.sum(drop).astype(jnp.int32)
         now = now.replace(valid=now.valid & ~drop)
 
+    if sched.has_corrupt:
+        xmask = jnp.zeros((now.cap,), jnp.uint32)
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_CORRUPT,)):
+            hit = _match(now, a, b) & (rnd == ev_rnd)
+            xmask = xmask ^ jnp.where(hit, _salt32(c), jnp.uint32(0))
+        counts["chaos_corrupted"] = jnp.sum(xmask != 0).astype(jnp.int32)
+        now = _xor_data(now, xmask, vectors_only=False)
+
+    if sched.has_equivocate:
+        # XOR-fold over events (order-independent, like drop's OR): odd
+        # receivers see the salted variant, even ones the original
+        emask = jnp.zeros((now.cap,), jnp.uint32)
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_EQUIVOCATE,)):
+            hit = (_match(now, a, -1) & (now.typ == b) & (rnd == ev_rnd)
+                   & (now.dst % 2 == 1))
+            emask = emask ^ jnp.where(hit, _salt32(c), jnp.uint32(0))
+        counts["chaos_equivocated"] = jnp.sum(emask != 0).astype(jnp.int32)
+        now = _xor_data(now, emask, vectors_only=True)
+
     parts = []
-    re_held = copy = None
+    re_held = copy = rcopy = None
     if sched.has_delay:
         bump = jnp.zeros((now.cap,), jnp.int32)
         for ev_rnd, _k, a, b, c in sched._kinds((KIND_DELAY,)):
@@ -435,6 +687,34 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
         counts["chaos_duplicated"] = jnp.sum(copy.valid).astype(jnp.int32)
         parts.append(copy)
 
+    if sched.has_replay:
+        # record-and-replay: copies of this round's delivered typ=a
+        # traffic land again c rounds later (like dup, but typ-matched —
+        # the stale-vote/ack presented after the protocol moved on)
+        rdel = jnp.full((now.cap,), -1, jnp.int32)
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_REPLAY,)):
+            hit = now.valid & (now.typ == a) & (rnd == ev_rnd)
+            if b >= 0:
+                hit = hit & (now.dst == b)
+            rdel = jnp.maximum(rdel, jnp.where(hit, jnp.int32(max(c, 1)),
+                                               -1))
+        rcopy = now.replace(valid=now.valid & (rdel >= 0),
+                            delay=jnp.maximum(rdel - 1, 0))
+        counts["chaos_replayed"] = jnp.sum(rcopy.valid).astype(jnp.int32)
+        parts.append(rcopy)
+
+    if sched.has_forge:
+        # sequential fold in table order (each forgery takes the next
+        # free slot), matching the table twin's fori_loop exactly
+        nforged = zero
+        for ev_rnd, _k, a, b, c in sched._kinds((KIND_FORGE,)):
+            do = rnd == ev_rnd
+            if node_lo is not None:
+                do = do & (a >= node_lo) & (a < node_hi)
+            now, fired = _forge_one(now, do, a, b, c, rnd)
+            nforged = nforged + fired
+        counts["chaos_forged"] = nforged
+
     extra_held = None
     if parts:
         extra_held = msgops.concat(*parts) if len(parts) > 1 else parts[0]
@@ -445,6 +725,8 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
             delayed = delayed | re_held.valid
         if copy is not None:
             delayed = delayed | copy.valid
+        if rcopy is not None:
+            delayed = delayed | rcopy.valid
         masks = {"dropped": drop if drop is not None else z,
                  "delayed": delayed}
         return now, extra_held, counts, masks
@@ -466,13 +748,17 @@ def apply_chaos_msgs(sched: ChaosSchedule, rnd: jax.Array, now: Msgs,
 #   * the node plane folds rows sequentially (fori_loop), so table order
 #     still wins ties exactly like the static unroll;
 #   * the message plane's folds are all order-independent reductions
-#     (drop = OR, delay bump = max, dup copy-delay = max) computed over
-#     the event axis at once;
+#     (drop = OR, delay bump = max, dup/replay copy-delay = max,
+#     corrupt/equivocate payload salt = XOR) computed over the event
+#     axis at once — except forgery, which consumes free slots and so
+#     folds sequentially (fori_loop), matching the static unroll's
+#     table order;
 #   * SENTINEL padding rows (kind -1) match no plane and no kind;
-#   * extra_held is ALWAYS materialized ([2 * cap]: delay re-holds then
-#     dup copies, all-invalid when nothing matched) — msgops.compact is
-#     a stable sort on validity, so trailing invalid slots change no
-#     downstream valid content, only which garbage sits in dead slots.
+#   * extra_held is ALWAYS materialized ([3 * cap]: delay re-holds then
+#     dup copies then replay copies, all-invalid when nothing matched) —
+#     msgops.compact is a stable sort on validity, so trailing invalid
+#     slots change no downstream valid content, only which garbage sits
+#     in dead slots.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -519,11 +805,15 @@ def apply_chaos_nodes_table(table: jax.Array, rnd: jax.Array,
 
 def apply_chaos_msgs_table(table: jax.Array, rnd: jax.Array, now: Msgs):
     """Traced-table twin of :func:`apply_chaos_msgs`.  Same pipeline
-    (drops, then delays on the survivors, then duplication), but each
-    stage reduces over the whole event axis at once — legal because the
-    static folds are order-independent (OR / max).  ``extra_held`` is
-    always a ``[2 * cap]`` buffer (delay re-holds ++ dup copies), so the
-    program shape is schedule-independent."""
+    (drops, then corrupt/equivocate payload salts, then delays,
+    duplication, replay and forged injections), but each stage reduces
+    over the whole event axis at once — legal because the static folds
+    are order-independent (OR / max / XOR) — except forgery, whose
+    free-slot consumption folds sequentially over the rows exactly like
+    the static unroll.  ``extra_held`` is always a ``[3 * cap]`` buffer
+    (delay re-holds ++ dup copies ++ replay copies), so the program
+    shape is schedule-independent.  Emits the full 7-key counter set
+    (the one compiled program covers the whole alphabet)."""
     ev_rnd, kind = table[:, 0], table[:, 1]
     a, b, c = table[:, 2], table[:, 3], table[:, 4]
 
@@ -539,6 +829,12 @@ def apply_chaos_msgs_table(table: jax.Array, rnd: jax.Array, now: Msgs):
         mdst = (b[:, None] < 0) | (m.dst[None, :] == b[:, None])
         return m.valid[None, :] & mtyp & mdst
 
+    def xor_fold(hit: jax.Array, salt: jax.Array) -> jax.Array:
+        """[cap] — XOR of the per-event salts over matching rows."""
+        contrib = jnp.where(hit, salt[:, None], jnp.uint32(0))
+        return jax.lax.reduce(contrib, jnp.uint32(0),
+                              jax.lax.bitwise_xor, (0,))
+
     # -- drops (windowed): OR over events, matching the static fold
     win = jnp.maximum(c, 1)
     drop_active = ((ev_rnd >= 0) & (rnd >= ev_rnd)
@@ -549,6 +845,26 @@ def apply_chaos_msgs_table(table: jax.Array, rnd: jax.Array, now: Msgs):
     drop = jnp.any(drop_ev, axis=0)
     counts = {"chaos_dropped": jnp.sum(drop).astype(jnp.int32)}
     now = now.replace(valid=now.valid & ~drop)
+
+    salt = _salt32(c)                                            # [E]
+
+    # -- corruption of the survivors: XOR-salt every integer payload
+    #    field of matching slots (order-independent XOR fold)
+    corr_fire = ((kind == KIND_CORRUPT) & (rnd == ev_rnd))       # [E]
+    xmask = xor_fold(corr_fire[:, None] & pair_match(now), salt)
+    counts["chaos_corrupted"] = jnp.sum(xmask != 0).astype(jnp.int32)
+    now = _xor_data(now, xmask, vectors_only=False)
+
+    # -- equivocation: salt only non-scalar payload fields, only for
+    #    odd-numbered receivers (the disjoint half)
+    eq_fire = ((kind == KIND_EQUIVOCATE) & (rnd == ev_rnd))      # [E]
+    msrc = (a[:, None] < 0) | (now.src[None, :] == a[:, None])
+    hit_e = (now.valid[None, :] & eq_fire[:, None] & msrc
+             & (now.typ[None, :] == b[:, None])
+             & ((now.dst % 2) == 1)[None, :])
+    emask = xor_fold(hit_e, salt)
+    counts["chaos_equivocated"] = jnp.sum(emask != 0).astype(jnp.int32)
+    now = _xor_data(now, emask, vectors_only=True)
 
     # -- delays on the survivors: max bump over events, then the
     #    '$delay' re-hold split (held copies age one round immediately)
@@ -573,7 +889,27 @@ def apply_chaos_msgs_table(table: jax.Array, rnd: jax.Array, now: Msgs):
                        delay=jnp.maximum(cdel - 1, 0))
     counts["chaos_duplicated"] = jnp.sum(copy.valid).astype(jnp.int32)
 
-    return now, msgops.concat(re_held, copy), counts
+    # -- replay: typ/dst-matched copies landing c rounds later
+    rp_fire = ((kind == KIND_REPLAY) & (rnd == ev_rnd))          # [E]
+    hit_r = rp_fire[:, None] & typ_match(now)
+    rdel = jnp.max(jnp.where(hit_r, jnp.maximum(c, 1)[:, None], -1),
+                   axis=0, initial=-1).astype(jnp.int32)
+    rcopy = now.replace(valid=now.valid & (rdel >= 0),
+                        delay=jnp.maximum(rdel - 1, 0))
+    counts["chaos_replayed"] = jnp.sum(rcopy.valid).astype(jnp.int32)
+
+    # -- forged injections: sequential free-slot fold over the rows
+    def fbody(i, carry):
+        m, nf = carry
+        do = (kind[i] == KIND_FORGE) & (rnd == ev_rnd[i])
+        m, fired = _forge_one(m, do, a[i], b[i], c[i], rnd)
+        return m, nf + fired
+
+    now, nforged = jax.lax.fori_loop(0, table.shape[0], fbody,
+                                     (now, jnp.int32(0)))
+    counts["chaos_forged"] = nforged
+
+    return now, msgops.concat(re_held, copy, rcopy), counts
 
 
 # ----------------------------------------------------- resubscribe policy

@@ -164,6 +164,62 @@ def causal_order() -> Invariant:
     return Invariant("causal_order", init, update)
 
 
+def no_fork() -> Invariant:
+    """Per-epoch agreement on committed blocks (ISSUE 19): wherever two
+    alive nodes both committed an epoch, their ledger digests agree.
+    Digests are >= 1 by construction (0 is the absent sentinel), so the
+    min/max fold over present entries detects any split — the device
+    twin of models.hbbft.verify_chain's 'divergent blocks' probe,
+    checked EVERY round (a fork is permanent once written)."""
+
+    def update(aux, world, metrics, rnd, check_from):
+        ld = _state_attr(world.state, "ledger_digest")  # [N, E]
+        present = (ld != 0) & world.alive[:, None]
+        mn = jnp.min(jnp.where(present, ld, jnp.int32(2**31 - 1)), axis=0)
+        mx = jnp.max(jnp.where(present, ld, jnp.int32(0)), axis=0)
+        return aux, jnp.any(present.any(axis=0) & (mn != mx))
+
+    return Invariant("no_fork", lambda w: (), update)
+
+
+def no_replay_commit() -> Invariant:
+    """Committed blocks are write-once: a node's ledger digest for an
+    epoch never CHANGES after its first commit — replayed or forged
+    sync traffic must not rewrite history.  Round-over-round
+    monotonicity fold (the causal_order pattern)."""
+
+    def init(world):
+        return _state_attr(world.state, "ledger_digest")
+
+    def update(aux, world, metrics, rnd, check_from):
+        ld = _state_attr(world.state, "ledger_digest")
+        viol = jnp.any((aux != 0) & (ld != aux))
+        return ld, viol
+
+    return Invariant("no_replay_commit", init, update)
+
+
+def no_view_poisoning(poison: Sequence[int] = ()) -> Invariant:
+    """No alive node's membership view ever contains a POISONED id — an
+    id the schedule only ever injects through forged join/membership
+    traffic (chaos.forge), so its presence in any view proves the forgery
+    took root.  With no ``poison`` ids (or no membership view at all) the
+    verdict is constant green: the factory is safe in the default set and
+    forge schedules pin the ids they inject."""
+    ids = tuple(int(p) for p in poison)
+
+    def update(aux, world, metrics, rnd, check_from):
+        views = _views_of(world.state)
+        if views is None or not ids:
+            return aux, jnp.zeros((), bool)
+        bad = jnp.zeros((), bool)
+        for p in ids:
+            bad = bad | jnp.any((views == p) & world.alive[:, None])
+        return aux, bad
+
+    return Invariant("no_view_poisoning", lambda w: (), update)
+
+
 def default_invariants(proto: ProtocolBase, world: World,
                        view_floor: float = 0.1,
                        hops: Optional[int] = None) -> List[Invariant]:
@@ -179,6 +235,13 @@ def default_invariants(proto: ProtocolBase, world: World,
     if (_state_attr(world.state, "last_seq") is not None
             and _state_attr(world.state, "log_n") is not None):
         inv.append(causal_order())
+    if _state_attr(world.state, "ledger_digest") is not None:
+        # epoch-ledger protocols (models.hbbft): the Byzantine trio.
+        # no_view_poisoning with no poison ids is constant green here —
+        # listed so replayed counterexamples can name any of the three.
+        inv.append(no_fork())
+        inv.append(no_replay_commit())
+        inv.append(no_view_poisoning())
     if not inv:
         raise ValueError(
             f"no explorer invariant applies to {type(proto).__name__} "
@@ -578,9 +641,25 @@ def _setup_acked_uniform(cfg: Config):
     return proto, world
 
 
+def _setup_hbbft(cfg: Config, hardened: bool):
+    """HbbftWorker with every node holding one pending transaction, so
+    epoch 0's leader proposes immediately — the Byzantine fork surface
+    (ISSUE 19).  Replayable in both modes: ``hbbft_unhardened`` is the
+    explorer's demonstration target, ``hbbft_hardened`` the survival
+    twin the same schedule must NOT fork."""
+    from ..models.hbbft import HbbftWorker, submit_transaction
+    proto = HbbftWorker(cfg, hardened=hardened)
+    world = init_world(cfg, proto)
+    for i in range(cfg.n_nodes):
+        world = submit_transaction(world, proto, i, 1000 + i)
+    return proto, world
+
+
 SETUPS: Dict[str, Callable[[Config], Tuple[ProtocolBase, World]]] = {
     "hyparview_tree": _setup_hyparview_tree,
     "acked_uniform": _setup_acked_uniform,
+    "hbbft_unhardened": lambda cfg: _setup_hbbft(cfg, hardened=False),
+    "hbbft_hardened": lambda cfg: _setup_hbbft(cfg, hardened=True),
 }
 
 

@@ -63,6 +63,21 @@ CHAOS_SPECS = (
     MetricSpec("chaos_duplicated", COUNTER,
                "Duplicate copies injected by chaos-plane events this "
                "round."),
+    # the Byzantine alphabet (ISSUE 19) — emitted only when the compiled
+    # schedule carries Byzantine events (verify.chaos.counter_keys), a
+    # registry no-op otherwise
+    MetricSpec("chaos_equivocated", COUNTER,
+               "Messages payload-split by chaos-plane equivocate events "
+               "this round (odd-numbered receivers got the variant)."),
+    MetricSpec("chaos_forged", COUNTER,
+               "Messages injected by chaos-plane forge events this round "
+               "(claimed senders never sent them)."),
+    MetricSpec("chaos_replayed", COUNTER,
+               "Delivered messages recorded for re-delivery by "
+               "chaos-plane replay events this round."),
+    MetricSpec("chaos_corrupted", COUNTER,
+               "Messages payload-mutated in flight by chaos-plane "
+               "corrupt events this round."),
 )
 
 QOS_SPECS = (

@@ -1,0 +1,123 @@
+"""The compiled geo/WAN latency plane (ISSUE 19 tentpole b).
+
+The reference suite sweeps realistic WAN round-trip times with netem —
+``partisan_SUITE.erl:1029-1136`` runs its cluster groups under RTT in
+{1, 20, 100} ms (SURVEY §6) — while the simulator's only latency knob so
+far is the chaos plane's KIND_DELAY (one (src, dst, round) bump).  This
+module generalizes it into a topology: every node lives in a REGION, and
+every (region, region) pair has a base RTT in rounds, plus deterministic
+per-message jitter.  The plane is a jit closure constant (frozen,
+hashable), applied at EMISSION time in both dataplanes:
+
+  * emission, not the ready buffer: a delay stamped once at birth ages
+    through the existing held-buffer arithmetic; a ready-buffer bump
+    would re-fire every round a message sits held;
+  * the one-way split is asymmetric-exact — ``src < dst`` pays
+    ``ceil(rtt / 2)``, the reverse direction ``floor(rtt / 2)`` — so any
+    request/response pair crossing the same region edge pays EXACTLY the
+    configured RTT, which is what makes ``models/distance.py``'s
+    ping/pong the plane's built-in validator (measured RTT == 2 + rtt,
+    the 2 being the simulator's own hop-per-round floor);
+  * jitter hashes MESSAGE FIELDS only (seed, src, dst, born, typ) —
+    never buffer positions — so the sharded and unsharded paths stamp
+    bit-identical delays (the chaos planes' residency discipline);
+  * zero collectives, zero new metric keys: the plane is pure slot-local
+    int arithmetic folded into the existing delay field, so the sharded
+    budget {all-to-all: 1, all-reduce: 1, all-gather: 0} holds, and
+    ``latency=None`` is Python-gated — the lowered program is
+    byte-identical to one built before this module existed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.msg import Msgs
+from ..ops.bitset import mix32 as _mix32
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencyPlane:
+    """A frozen (hashable) WAN topology: ``regions[n]`` maps node id ->
+    region, ``base_rtt[R][R]`` is the symmetric-intent region-pair RTT
+    in ROUNDS (the wan_* soak cells use 1 round ~= 10 ms), and
+    ``jitter_milli`` adds +1 round to a deterministic ``jitter_milli``
+    per-mille of messages (counter-based hash of ``seed`` and the
+    message's fields).  Build::
+
+        plane = LatencyPlane(regions=(0,) * 32 + (1,) * 32,
+                             base_rtt=((0, 2), (2, 0)),
+                             jitter_milli=50, seed=7)
+    """
+
+    regions: Tuple[int, ...]
+    base_rtt: Tuple[Tuple[int, ...], ...]
+    jitter_milli: int = 0
+    seed: int = 0
+
+    def __post_init__(self):
+        # normalize to hashable tuples so literal lists work too
+        object.__setattr__(self, "regions", tuple(int(r) for r in
+                                                  self.regions))
+        object.__setattr__(self, "base_rtt", tuple(
+            tuple(int(v) for v in row) for row in self.base_rtt))
+
+    @property
+    def n_regions(self) -> int:
+        return len(self.base_rtt)
+
+    def validate(self, n_nodes: int) -> "LatencyPlane":
+        """Compile-point validation (the ChaosSchedule.validate pattern):
+        shape/range errors raise named ValueErrors instead of folding
+        into silent misdelivery."""
+        if len(self.regions) != n_nodes:
+            raise ValueError(
+                f"latency plane maps {len(self.regions)} nodes but the "
+                f"config has {n_nodes}")
+        r = self.n_regions
+        if any(len(row) != r for row in self.base_rtt):
+            raise ValueError(
+                f"base_rtt must be square, got rows of "
+                f"{[len(row) for row in self.base_rtt]} for {r} regions")
+        if any(not 0 <= reg < r for reg in self.regions):
+            raise ValueError(
+                f"region ids must be in [0, {r}), got {self.regions}")
+        if any(v < 0 for row in self.base_rtt for v in row):
+            raise ValueError("base_rtt entries must be >= 0 rounds")
+        if not 0 <= self.jitter_milli <= 1000:
+            raise ValueError(
+                f"jitter_milli is a per-mille rate in [0, 1000], got "
+                f"{self.jitter_milli}")
+        return self
+
+
+def apply_latency(plane: LatencyPlane, m: Msgs) -> Msgs:
+    """Stamp the plane's per-edge one-way delay onto a freshly emitted
+    buffer (call where the dataplanes stamp ingress/egress delay).  Pure
+    slot-local arithmetic over message fields; invalid slots untouched
+    in effect (their delay is never read)."""
+    reg = jnp.asarray(plane.regions, jnp.int32)
+    rtt = jnp.asarray(plane.base_rtt, jnp.int32)
+    n = reg.shape[0]
+    src = jnp.clip(m.src, 0, n - 1)
+    dst = jnp.clip(m.dst, 0, n - 1)
+    pair = rtt[reg[src], reg[dst]]
+    # asymmetric-exact split: the low->high direction pays the ceiling,
+    # high->low the floor, so a round trip over one edge totals `pair`
+    oneway = jnp.where(m.src < m.dst, (pair + 1) // 2, pair // 2)
+    extra = oneway
+    if plane.jitter_milli:
+        h = _mix32(m.src.astype(jnp.uint32)
+                   ^ _mix32(m.dst.astype(jnp.uint32)
+                            ^ _mix32(m.born.astype(jnp.uint32)
+                                     ^ _mix32(m.typ.astype(jnp.uint32)
+                                              ^ jnp.uint32(plane.seed)))))
+        jit = (h % jnp.uint32(1000)
+               < jnp.uint32(plane.jitter_milli)).astype(jnp.int32)
+        extra = extra + jit
+    extra = jnp.where(m.valid, extra, 0)
+    return m.replace(delay=m.delay + extra)

@@ -31,13 +31,21 @@ Campaign phases (all rows append to ``BENCH_explore.jsonl``):
      mesh: one ``run_batch`` of B schedules against a B=1 explorer
      looping over the same list; the batch is sharded across the mesh
      when B divides evenly.
+  6. **hbbft** (ISSUE 19) — the Byzantine hunt: an equivocation +
+     vote-inflation frontier against the UN-hardened hbbft worker
+     violates ``no_fork`` (two halves commit different digests for one
+     epoch); the find shrinks to a 1-minimal table, commits as
+     ``counterexample_hbbft.json``, replays through the B=1 checker,
+     and the HARDENED twin must pass the identical frontier clean.
+     Runs in the full campaign and alone via ``--phase hbbft``.
 
 Usage:
     python scripts/chaos_explore.py                   # full campaign
         [--batch 64] [--rounds 30] [--events 4] [--seed 7]
         [--out BENCH_explore.jsonl] [--counterexample-dir .]
-        [--postmortem-dir /tmp]
+        [--postmortem-dir /tmp] [--phase all|hbbft]
     python scripts/chaos_explore.py --smoke           # tier-1 cell
+    python scripts/chaos_explore.py --phase hbbft     # Byzantine arm
 """
 
 from __future__ import annotations
@@ -74,6 +82,7 @@ from partisan_tpu.verify.explorer import Explorer, SETUPS  # noqa: E402
 
 ACK_N = 8
 HYP_N, HYP_ROUNDS, HYP_EVENTS = 16, 60, 10
+HBB_N, HBB_ROUNDS, HBB_EVENTS = 7, 12, 8
 
 
 def acked_cfg(seed: int = 5) -> pt.Config:
@@ -234,6 +243,78 @@ def hyparview_phase(args, rows):
           f"{'REPRODUCED' if rep['reproduced'] else 'FAILED'}")
 
 
+def hbbft_phase(args, rows):
+    """The Byzantine hunt (ISSUE 19): the frontier pairs a leader
+    equivocation on ``propose`` (odd receivers store a variant batch,
+    splitting the cluster's digests 4-vs-3) with duplicated-echo
+    amplification over sender triples — the vote inflation that pushes
+    BOTH digest camps past the n-f quorum of the un-hardened worker's
+    per-message count.  The find shrinks to a 1-minimal table, commits
+    as ``counterexample_hbbft.json``, replays through a fresh B=1
+    checker, and the HARDENED twin (distinct-voter bitmask) must pass
+    the identical frontier with ``no_fork``/``no_view_poisoning``
+    green."""
+    import itertools
+    cfg = pt.Config(n_nodes=HBB_N, inbox_cap=HBB_N + 4, seed=11)
+    proto, world = SETUPS["hbbft_unhardened"](cfg)
+    ex = Explorer(cfg, proto, n_rounds=HBB_ROUNDS, n_events=HBB_EVENTS,
+                  batch=8, world=world, heal_margin=2)
+    t_prop = proto.typ("propose")
+    frontier = [ChaosSchedule().equivocate(1, src=0, typ=t_prop)]
+    for trio in itertools.combinations(range(HBB_N), 3):
+        sched = ChaosSchedule().equivocate(1, src=0, typ=t_prop)
+        for s in trio:
+            sched = sched.duplicate(2, src=s)
+        frontier.append(sched)
+
+    t0 = time.perf_counter()
+    failures = ex.explore(frontier)
+    forks = [(s, n, r) for s, n, r in failures if n == "no_fork"]
+    print(f"hbbft: {len(forks)}/{len(frontier)} schedules fork the "
+          f"un-hardened chain")
+    if not forks:
+        print("hbbft: no fork found — Byzantine alphabet broken?")
+        return False
+    sched, inv, rnd = forks[0]
+    shrunk = ex.shrink(sched, inv)
+    cx_path = os.path.join(args.counterexample_dir,
+                           "counterexample_hbbft.json")
+    explorer.write_counterexample(
+        cx_path, setup="hbbft_unhardened", cfg=cfg, sched=shrunk,
+        invariant=inv, first_violation_round=rnd,
+        n_rounds=HBB_ROUNDS, heal_margin=2, n_events=HBB_EVENTS,
+        original_events=len(sched.events))
+    rep = explorer.replay_counterexample(
+        cx_path, postmortem_dir=args.postmortem_dir)
+
+    # the hardened twin survives the whole frontier
+    hproto, hworld = SETUPS["hbbft_hardened"](cfg)
+    hex_ = Explorer(cfg, hproto, n_rounds=HBB_ROUNDS,
+                    n_events=HBB_EVENTS, batch=8, world=hworld,
+                    heal_margin=2)
+    hardened_failures = hex_.explore(frontier)
+    rows.append({
+        "bench": "chaos_explore", "phase": "hbbft",
+        "protocol": "HbbftWorker", "n": HBB_N, "rounds": HBB_ROUNDS,
+        "frontier": len(frontier),
+        "counterexamples_found": len(forks),
+        "invariant": inv, "original_events": len(sched.events),
+        "shrunk_events": len(shrunk.events),
+        "first_violation_round": rnd,
+        "replay_reproduced": bool(rep["reproduced"]),
+        "hardened_failures": len(hardened_failures),
+        "counterexample": cx_path,
+        "postmortem": rep["postmortem"],
+        "wall_s": round(time.perf_counter() - t0, 2)})
+    print(f"hbbft: equivocation fork found "
+          f"({len(sched.events)} -> {len(shrunk.events)} events, "
+          f"{inv} @ round {rnd}); replay "
+          f"{'REPRODUCED' if rep['reproduced'] else 'FAILED'}; "
+          f"hardened twin: {len(hardened_failures)} failures over the "
+          f"same frontier -> {cx_path}")
+    return bool(rep["reproduced"]) and not hardened_failures
+
+
 def bench_phase(args, rows, batched_ex):
     """Batched vs serial schedules/sec.  The batched explorer shards
     its inputs across the mesh when B divides the device count; the
@@ -301,24 +382,38 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="small batch, AckedDelivery phases only — the "
                          "tier-1 smoke configuration")
+    ap.add_argument("--phase", choices=("all", "hbbft"), default="all",
+                    help="'hbbft' runs only the Byzantine arm "
+                         "(ISSUE 19)")
     args = ap.parse_args(argv)
     if args.smoke:
         args.batch = 8
 
     os.makedirs(args.counterexample_dir, exist_ok=True)
     rows = []
+
+    if args.phase == "hbbft":
+        ok = hbbft_phase(args, rows)
+        with open(args.out, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        print(f"\n{len(rows)} rows -> {args.out}")
+        return 0 if ok else 1
+
     batched_ex = acked_phase(args, rows)
     if batched_ex is None:
         return 1
     if not args.smoke:
         hyparview_phase(args, rows)
+        hbbft_phase(args, rows)
     bench_phase(args, rows, batched_ex)
 
     with open(args.out, "a") as f:
         for row in rows:
             f.write(json.dumps(row) + "\n")
     print(f"\n{len(rows)} rows -> {args.out}")
-    shr = [r for r in rows if r["phase"] in ("shrink", "hyparview")]
+    shr = [r for r in rows if r["phase"] in ("shrink", "hyparview",
+                                             "hbbft")]
     return 0 if shr and all(r["replay_reproduced"] for r in shr) else 1
 
 

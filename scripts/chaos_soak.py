@@ -75,6 +75,7 @@ from partisan_tpu.models.hyparview import HyParView  # noqa: E402
 from partisan_tpu.telemetry.flight import FlightSpec, flight_entries  # noqa: E402
 from partisan_tpu.verify import trace as trace_mod  # noqa: E402
 from partisan_tpu.verify.chaos import ChaosSchedule  # noqa: E402
+from partisan_tpu.verify.latency import LatencyPlane  # noqa: E402
 from partisan_tpu.verify import health  # noqa: E402
 
 
@@ -119,10 +120,58 @@ def _mix_lossy_combo(n: int, rounds: int) -> ChaosSchedule:
                      (n // 8, n // 8 + n // 16 - 1)))
 
 
+def _mix_byzantine_combo(n: int, rounds: int) -> ChaosSchedule:
+    """The Byzantine alphabet (ISSUE 19) riding the partition scaffold:
+    equivocated and replayed keepalives, a corrupting relay, a forged
+    neighbor claim and duplicated traffic — all inside the partitioned
+    window, healing at ~60% so convergence-after-heal still gates the
+    cell.  Wire types are HyParView's (keepalive=9, neighbor=2).
+    Keepalives are emitted on even rounds (keepalive_interval=2), so
+    they sit in the ready buffer on ODD rounds — the keepalive-matching
+    events pin odd rounds or every campaign scale where q+k lands even
+    would count zero (the smoke scale only hit by parity luck)."""
+    q = rounds // 4
+    ka1 = (q + 2) | 1          # odd: keepalives in the ready buffer
+    ka2 = ka1 + 2
+    return (ChaosSchedule()
+            .partition(q, (0, n // 2 - 1), 1)
+            .partition(q, (n // 2, n - 1), 2)
+            .equivocate(ka1, typ=9, salt=3)
+            .corrupt(q + 3, salt=5)
+            .replay(ka2, typ=9, after=3)
+            .forge(q + 5, src=3, dst=11, typ=2)
+            .duplicate(q + 6, src=4)
+            .heal(2 * q + q // 2))
+
+
 MIXES = {
     "crash_recover": _mix_crash_recover,
     "partition_heal": _mix_partition_heal,
     "lossy_combo": _mix_lossy_combo,
+    # the WAN cells (ISSUE 19) run the partition_heal schedule under a
+    # LATENCY plane — same disruption, geo-distributed delivery
+    "byzantine_combo": _mix_byzantine_combo,
+    "wan_1": _mix_partition_heal,
+    "wan_20": _mix_partition_heal,
+    "wan_100": _mix_partition_heal,
+}
+
+
+def _wan_plane(n: int, rtt_rounds: int) -> LatencyPlane:
+    """Two-region halves with a cross-region RTT of ``rtt_rounds`` —
+    the netem sweep's topology (SURVEY §6: RTT in {1, 20, 100} ms at
+    ~10 ms/round)."""
+    return LatencyPlane(regions=(0,) * (n // 2) + (1,) * (n - n // 2),
+                        base_rtt=((0, rtt_rounds), (rtt_rounds, 0)),
+                        jitter_milli=50, seed=19)
+
+
+# mix -> latency-plane builder (None = no plane); rtt_rounds =
+# ceil(ms / 10) at the simulator's ~10 ms-per-round calibration
+LATENCY = {
+    "wan_1": lambda n: _wan_plane(n, 1),
+    "wan_20": lambda n: _wan_plane(n, 2),
+    "wan_100": lambda n: _wan_plane(n, 10),
 }
 
 
@@ -168,6 +217,7 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
     ``out``, when given, receives the cell's final ``world`` and ``cfg``
     so the campaign loop can checkpoint them (--checkpoint/--resume)."""
     sched = MIXES[mix](n, rounds)
+    plane = LATENCY[mix](n) if mix in LATENCY else None
     heal_rnd = sched.last_heal_round()
     cfg = pt.Config(n_nodes=n, inbox_cap=16,
                     shuffle_interval=shuffle_interval, seed=seed)
@@ -192,7 +242,8 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
         sinks=[sink], world=world,
         flight=FlightSpec(window=window, cap=flight_cap),
         on_flight=on_flight, stream=stream,
-        step_kw={"chaos": sched})
+        step_kw=({"chaos": sched} if plane is None
+                 else {"chaos": sched, "latency": plane}))
     dt = time.perf_counter() - t0
     if out is not None:
         out["world"], out["cfg"] = world, cfg
@@ -216,6 +267,13 @@ def run_cell(*, n: int, rounds: int, seed: int, mix: str, window: int,
         "chaos_delayed": sum(r.get("chaos_delayed", 0) for r in rows),
         "chaos_duplicated": sum(r.get("chaos_duplicated", 0)
                                 for r in rows),
+        "chaos_equivocated": sum(r.get("chaos_equivocated", 0)
+                                 for r in rows),
+        "chaos_forged": sum(r.get("chaos_forged", 0) for r in rows),
+        "chaos_replayed": sum(r.get("chaos_replayed", 0) for r in rows),
+        "chaos_corrupted": sum(r.get("chaos_corrupted", 0) for r in rows),
+        "wan_rtt_rounds": (int(plane.base_rtt[0][1])
+                           if plane is not None else None),
         "fault_dropped": sum(r.get("fault_dropped", 0) for r in rows),
         "inflight_watermark": health.inflight_watermark(rows),
         "wall_s": round(dt, 2),
@@ -339,6 +397,11 @@ def _append_bench_rows(rows, smoke: bool = False) -> None:
             metrics={k: r[k] for k in ("converged", "heal_round",
                                        "converged_round",
                                        "chaos_dropped",
+                                       "chaos_equivocated",
+                                       "chaos_forged",
+                                       "chaos_replayed",
+                                       "chaos_corrupted",
+                                       "wan_rtt_rounds",
                                        "p99_recovery") if k in r})
          for r in rows],
         ledger_path)
@@ -350,7 +413,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rounds", type=int, default=160)
     ap.add_argument("--window", type=int, default=32)
     ap.add_argument("--seeds", default="1,2,3,4")
-    ap.add_argument("--mixes", default=",".join(MIXES))
+    ap.add_argument("--mixes", default=None,
+                    help="comma list of fault mixes (default: all; "
+                         "--smoke defaults to lossy_combo but respects "
+                         "an explicit --mixes)")
     ap.add_argument("--heal-margin", type=int, default=60)
     ap.add_argument("--out", default="BENCH_chaos.jsonl")
     ap.add_argument("--flight-cap", type=int, default=2048)
@@ -407,11 +473,13 @@ def main(argv=None) -> int:
 
     if args.smoke:
         args.n, args.rounds, args.window = 64, 60, 20
-        args.seeds, args.mixes = "1", "lossy_combo"
+        args.seeds = "1"
+        if args.mixes is None:  # an explicit --mixes picks the smoke cell
+            args.mixes = "lossy_combo"
         args.heal_margin = 25
 
     seeds = [int(s) for s in args.seeds.split(",") if s]
-    mixes = [m for m in args.mixes.split(",") if m]
+    mixes = [m for m in (args.mixes or ",".join(MIXES)).split(",") if m]
     for m in mixes:
         if m not in MIXES:
             ap.error(f"unknown mix {m!r}; have {sorted(MIXES)}")

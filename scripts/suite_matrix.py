@@ -1709,6 +1709,111 @@ def alert_smoke():
     assert 'alertname="partition_suspected"' in al.alerts_exposition(firer)
 
 
+def byzantine_parity_test():
+    """ISSUE 19 tentpole contract: one compiled ChaosSchedule carrying
+    the full Byzantine alphabet (equivocate + corrupt + replay + forge
+    on top of partition/heal and a duplicate) AND a two-region WAN
+    latency plane over HyParView through the shard_map dataplane
+    bit-matches the unsharded run — states, fault planes, per-round
+    metrics INCLUDING the four Byzantine counters — with the
+    2-collective budget unchanged both planes on."""
+    from partisan_tpu.models.hyparview import HyParView
+    from partisan_tpu.parallel import make_mesh
+    from partisan_tpu.parallel.dataplane import (
+        make_sharded_step, place_sharded_world, sharded_out_cap)
+    from partisan_tpu.parallel.mesh import assert_collective_budget
+    from partisan_tpu.verify.chaos import ChaosSchedule
+    from partisan_tpu.verify.latency import LatencyPlane
+    n, rounds = 64, 30
+    cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+    proto = HyParView(cfg)
+    t_keep = proto.typ("keepalive")
+    t_neigh = proto.typ("neighbor")
+    sched = (ChaosSchedule()
+             .partition(10, (0, 31), 1).partition(10, (32, 63), 2)
+             .equivocate(14, typ=t_keep, salt=3)
+             .corrupt(13, salt=5)
+             .replay(14, typ=t_keep, after=3)
+             .forge(15, src=3, dst=11, typ=t_neigh)
+             .duplicate(16, src=4)
+             .heal(20))
+    plane = LatencyPlane(regions=(0,) * (n // 2) + (1,) * (n // 2),
+                         base_rtt=((0, 2), (2, 0)),
+                         jitter_milli=50, seed=19)
+    mesh = make_mesh(n_devices=8)
+    pairs = [(i, i - 1) for i in range(1, n)]
+    w = ps.cluster(pt.init_world(cfg, proto), proto, pairs, stagger=16)
+    step = pt.make_step(cfg, proto, donate=False, chaos=sched,
+                        latency=plane)
+    w2 = ps.cluster(
+        pt.init_world(cfg, proto,
+                      out_cap=sharded_out_cap(cfg, proto, 8)),
+        proto, pairs, stagger=16)
+    w2 = place_sharded_world(w2, cfg, mesh)
+    sstep = make_sharded_step(cfg, proto, mesh, donate=False,
+                              chaos=sched, latency=plane)
+    st = assert_collective_budget(
+        sstep.lower(w2).compile(), max_collectives=2,
+        max_bytes=32 * 1024 * 1024, forbid=("all-gather",))
+    assert st["counts"]["all-to-all"] == 1
+    byz = {k: 0 for k in ("chaos_equivocated", "chaos_forged",
+                          "chaos_replayed", "chaos_corrupted")}
+    for _ in range(rounds):
+        w, mp = step(w)
+        w2, msh = sstep(w2)
+        assert all(int(msh[k]) == int(v) for k, v in mp.items()), \
+            (mp, msh)
+        for k in byz:
+            byz[k] += int(mp[k])
+    assert all(v > 0 for v in byz.values()), byz
+    for lp, lsh in zip(jax.tree_util.tree_leaves((w.state, w.alive,
+                                                  w.partition)),
+                       jax.tree_util.tree_leaves((w2.state, w2.alive,
+                                                  w2.partition))):
+        assert (np.asarray(lp) == np.asarray(lsh)).all()
+
+
+def wan_soak_smoke():
+    """ISSUE 19 campaign smoke: the real chaos_soak CLI over the
+    byzantine_combo mix at smoke scale must converge, report all four
+    Byzantine counters nonzero, and write its JSONL row — with the
+    PR-18 ledger env-pinned so smoke rows never dirty the committed
+    trajectory."""
+    import importlib.util
+    import json
+    import tempfile
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "chaos_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "bench.jsonl")
+        prev = os.environ.get("PARTISAN_BENCH_LEDGER")
+        os.environ["PARTISAN_BENCH_LEDGER"] = os.path.join(
+            td, "ledger.jsonl")
+        try:
+            rc = soak.main(["--smoke", "--mixes", "byzantine_combo",
+                            "--out", out, "--postmortem-dir", td])
+        finally:
+            if prev is None:
+                os.environ.pop("PARTISAN_BENCH_LEDGER", None)
+            else:
+                os.environ["PARTISAN_BENCH_LEDGER"] = prev
+        assert rc == 0
+        with open(out) as f:
+            rows = [json.loads(line) for line in f]
+        with open(os.path.join(td, "ledger.jsonl")) as f:
+            ledger = [json.loads(line) for line in f]
+    assert rows and rows[0]["mix"] == "byzantine_combo"
+    assert rows[0]["converged"], rows[0]
+    for k in ("chaos_equivocated", "chaos_forged", "chaos_replayed",
+              "chaos_corrupted"):
+        assert rows[0][k] > 0, (k, rows[0])
+    assert any(r.get("suite") == "chaos_soak"
+               and r.get("arm") == "byzantine_combo" for r in ledger)
+
+
 def build_matrix():
     """(group, test, manager, path, fn_or_skipreason) rows mirroring
     all/0 + groups/0 of test/partisan_SUITE.erl:121-308.
@@ -1880,6 +1985,15 @@ def build_matrix():
         chaos_parity_test)
     add("robustness/chaos", "chaos_soak_smoke", "hyparview", "engine",
         chaos_soak_smoke)
+
+    # ISSUE 19: the Byzantine fault alphabet + geo/WAN latency plane —
+    # sharded/unsharded bit-parity with both planes on, and the real
+    # byzantine_combo campaign cell through the chaos_soak CLI (full
+    # wan_{1,20,100} sweeps live in scripts/chaos_soak.py)
+    add("robustness/byzantine", "byzantine_parity_test", "hyparview",
+        "engine", byzantine_parity_test)
+    add("robustness/byzantine", "wan_soak_smoke", "hyparview", "engine",
+        wan_soak_smoke)
 
     # ISSUE 8: the device-side workload plane — latency-histogram
     # parity on both execution paths and the capacity-bench harness

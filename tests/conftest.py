@@ -69,9 +69,15 @@ _RUN_LAST_8 = ("tests/test_aot.py", "tests/test_route_kernel.py")
 
 _RUN_LAST_9 = ("tests/test_benchplane.py",)
 
+# tier 10: the ISSUE-19 Byzantine alphabet + WAN latency plane is the
+# newest of all
+_RUN_LAST_10 = ("tests/test_byzantine.py",)
+
 
 def pytest_collection_modifyitems(config, items):
     def tier(it):
+        if any(k in it.nodeid for k in _RUN_LAST_10):
+            return 10
         if any(k in it.nodeid for k in _RUN_LAST_9):
             return 9
         if any(k in it.nodeid for k in _RUN_LAST_8):

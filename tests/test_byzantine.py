@@ -1,0 +1,504 @@
+"""Byzantine fault alphabet + geo/WAN latency plane tests (ISSUE 19):
+per-error builder/validate regressions at every compile wiring point,
+the LatencyPlane's distance.py ping/pong RTT pin, off-path byte-identity
+on both dataplanes, the both-planes-on collective-budget pin, B=1
+explorer bit-parity over the enlarged alphabet, sharded-vs-unsharded
+Byzantine counter equality, and the hbbft hardening contract (the
+un-hardened chain forks under the explorer's 4-event schedule; the
+hardened chain survives the same batch and counts the suspects).
+
+The committed demonstration artifact is counterexample_hbbft.json
+(scripts/chaos_explore.py --phase hbbft); replay it with
+``scripts/chaos_soak.py --replay counterexample_hbbft.json``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import partisan_tpu as pt
+from partisan_tpu import peer_service as ps
+from partisan_tpu.models.distance import Distance, distances
+from partisan_tpu.models.hbbft import HbbftWorker, verify_chain
+from partisan_tpu.models.hyparview import HyParView
+from partisan_tpu.models.stack import Stacked
+from partisan_tpu.verify import ChaosSchedule
+from partisan_tpu.verify.explorer import SETUPS, Explorer
+from partisan_tpu.verify.latency import LatencyPlane
+
+pytestmark = pytest.mark.standard
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def leaves_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# the explorer's committed fork schedule (counterexample_hbbft.json,
+# shrink-verified 1-minimal): the round-0 propose is in flight round 1 —
+# equivocate splits the digest by receiver parity (evens get the salted
+# variant) — and three duplicated echo sources at round 2 push BOTH
+# digests past the naive quorum at round 3
+def fork_schedule():
+    return (ChaosSchedule()
+            .equivocate(1, src=0, typ=0, salt=1)
+            .duplicate(2, src=1).duplicate(2, src=2).duplicate(2, src=3))
+
+
+# --------------------------------------------------------- validation
+
+class TestByzantineBuilders:
+    """ISSUE 19 satellite: every malformed Byzantine event is a NAMED
+    ValueError at build time — one regression per error message."""
+
+    def test_equivocate_rejects_bad_args(self):
+        with pytest.raises(ValueError, match="equivocate typ"):
+            ChaosSchedule().equivocate(1, typ=-1)
+        with pytest.raises(ValueError, match="equivocate salt"):
+            ChaosSchedule().equivocate(1, salt=0)
+
+    def test_forge_rejects_bad_args(self):
+        with pytest.raises(ValueError, match="forge of an out-of-range id"):
+            ChaosSchedule().forge(1, src=-1, dst=2, typ=0)
+        with pytest.raises(ValueError, match="forge of an out-of-range id"):
+            ChaosSchedule().forge(1, src=2, dst=-1, typ=0)
+        with pytest.raises(ValueError, match="forge type"):
+            ChaosSchedule().forge(1, src=0, dst=1, typ=-1)
+
+    def test_replay_rejects_bad_args(self):
+        with pytest.raises(ValueError, match="replay type"):
+            ChaosSchedule().replay(1, typ=-1)
+        with pytest.raises(ValueError, match="replay horizon"):
+            ChaosSchedule().replay(1, typ=0, after=0)
+
+    def test_corrupt_rejects_bad_salt(self):
+        with pytest.raises(ValueError, match="corrupt salt"):
+            ChaosSchedule().corrupt(1, salt=0)
+
+
+class TestByzantineValidate:
+    """validate() names the event and the bound it broke; wired at
+    make_step, make_run_scan, the sharded dataplane and the explorer's
+    table stacker."""
+
+    def test_equivocate_typ_outside_wire_space(self):
+        sched = ChaosSchedule().equivocate(1, typ=9)
+        with pytest.raises(ValueError, match="wire space"):
+            sched.validate(n_types=4)
+        sched.validate(n_types=10)
+
+    def test_equivocate_src_out_of_cluster(self):
+        with pytest.raises(ValueError, match=r"src 99 out of"):
+            ChaosSchedule().equivocate(1, src=99).validate(n_nodes=16)
+
+    def test_forge_out_of_range_id(self):
+        sched = ChaosSchedule().forge(1, src=3, dst=99, typ=0)
+        with pytest.raises(ValueError, match="forge of an out-of-range"):
+            sched.validate(n_nodes=16)
+        with pytest.raises(ValueError, match="hit no handler"):
+            ChaosSchedule().forge(1, src=3, dst=4, typ=9).validate(
+                n_types=4)
+
+    def test_replay_horizon_past_rounds(self):
+        sched = ChaosSchedule().replay(25, typ=0, after=10)
+        with pytest.raises(ValueError, match="replay horizon"):
+            sched.validate(n_rounds=30)
+        sched.validate(n_rounds=36)
+        with pytest.raises(ValueError, match=r"typ.*never match|wire "
+                                              r"type"):
+            ChaosSchedule().replay(1, typ=9).validate(n_types=4)
+
+    def test_corrupt_src_dst_out_of_cluster(self):
+        with pytest.raises(ValueError, match=r"src/dst .* out of"):
+            ChaosSchedule().corrupt(1, src=99).validate(n_nodes=16)
+
+    def test_make_step_validates_byzantine_schedule(self):
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=0)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="wire space"):
+            pt.make_step(cfg, proto,
+                         chaos=ChaosSchedule().equivocate(1, typ=99))
+
+    def test_make_run_scan_validates_replay_horizon(self):
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=0)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="replay horizon"):
+            pt.make_run_scan(cfg, proto, 10,
+                             chaos=ChaosSchedule().replay(5, typ=0,
+                                                          after=8))
+
+    @needs_mesh
+    def test_sharded_step_validates_byzantine_schedule(self):
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import make_sharded_step
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, seed=0)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="forge of an out-of-range"):
+            make_sharded_step(
+                cfg, proto, make_mesh(n_devices=8),
+                chaos=ChaosSchedule().forge(1, src=3, dst=99, typ=0))
+
+    def test_explorer_stack_validates_byzantine_schedule(self):
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, seed=5)
+        proto, world = SETUPS["acked_uniform"](cfg)
+        ex = Explorer(cfg, proto, n_rounds=12, n_events=2, batch=1,
+                      world=world, heal_margin=2)
+        with pytest.raises(ValueError, match="replay horizon"):
+            ex.run_batch([ChaosSchedule().replay(10, typ=0, after=5)])
+
+
+class TestLatencyValidate:
+    """LatencyPlane.validate names every shape/range error (the
+    ChaosSchedule.validate pattern), wired at both step compilers."""
+
+    def test_named_errors(self):
+        with pytest.raises(ValueError, match="maps 4 nodes"):
+            LatencyPlane(regions=(0,) * 4,
+                         base_rtt=((0,),)).validate(8)
+        with pytest.raises(ValueError, match="square"):
+            LatencyPlane(regions=(0,) * 4,
+                         base_rtt=((0, 1), (1,))).validate(4)
+        with pytest.raises(ValueError, match="region ids"):
+            LatencyPlane(regions=(0, 0, 0, 5),
+                         base_rtt=((0, 1), (1, 0))).validate(4)
+        with pytest.raises(ValueError, match=">= 0 rounds"):
+            LatencyPlane(regions=(0, 1, 0, 1),
+                         base_rtt=((0, -1), (-1, 0))).validate(4)
+        with pytest.raises(ValueError, match="per-mille"):
+            LatencyPlane(regions=(0,) * 4, base_rtt=((0,),),
+                         jitter_milli=2000).validate(4)
+
+    def test_make_step_validates_plane(self):
+        cfg = pt.Config(n_nodes=8, inbox_cap=16)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="maps 4 nodes"):
+            pt.make_step(cfg, proto,
+                         latency=LatencyPlane(regions=(0,) * 4,
+                                              base_rtt=((0,),)))
+
+    @needs_mesh
+    def test_sharded_step_validates_plane(self):
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import make_sharded_step
+        cfg = pt.Config(n_nodes=16, inbox_cap=16)
+        proto = HyParView(cfg)
+        with pytest.raises(ValueError, match="maps 4 nodes"):
+            make_sharded_step(cfg, proto, make_mesh(n_devices=8),
+                              latency=LatencyPlane(regions=(0,) * 4,
+                                                   base_rtt=((0,),)))
+
+
+# ------------------------------------------------- distance.py RTT pin
+
+@pytest.mark.slow
+class TestLatencyRttPin:
+    # slow tier (ISSUE 19 budget): two executed 30-round stacked-distance
+    # drives, ~19 s warm; the latency plane's tier-1 surface is the
+    # validation suite above plus the unsharded off-path identity below
+    """The plane's built-in validator (ISSUE 19 tentpole b): the
+    asymmetric-exact one-way split makes models/distance.py's ping/pong
+    measure EXACTLY 2 + base_rtt across a region edge — the 2 being the
+    round-synchronous hop floor test_distance.py pins."""
+
+    def boot(self, n=8, latency=None, chaos=None):
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, distance_enabled=True,
+                        distance_interval=4)
+        proto = Stacked(HyParView(cfg), Distance(cfg))
+        world = pt.init_world(cfg, proto)
+        world = ps.cluster(world, proto,
+                           [(i, 0) for i in range(1, n)])
+        step = pt.make_step(cfg, proto, donate=False, latency=latency,
+                            chaos=chaos)
+        return cfg, proto, world, step
+
+    def test_wan_rtt_exactly_two_plus_base(self):
+        k = 3
+        regions = (0,) * 4 + (1,) * 4
+        plane = LatencyPlane(regions=regions,
+                             base_rtt=((0, k), (k, 0)))
+        cfg, proto, world, step = self.boot(latency=plane)
+        for _ in range(30):
+            world, _ = step(world)
+        measured = 0
+        for node in range(cfg.n_nodes):
+            for peer, rtt in distances(world, node).items():
+                want = 2 + (k if regions[node] != regions[peer] else 0)
+                assert rtt == want, (node, peer, rtt, want)
+                measured += 1
+        assert measured, "no RTT measurements collected"
+
+    def test_legacy_delay_event_adds_exactly_c(self):
+        """The KIND_DELAY ancestor the plane generalizes: a one-round
+        chaos delay of node 0's in-flight traffic inflates exactly the
+        ping it holds to 2 + c."""
+        c = 3
+        # node 0 pings at rounds 0, 5, 10, ...; the ping stamped at round
+        # 5 sits in the ready buffer at round 6, where the delay event
+        # holds it for c rounds: pong lands at round 10 with RTT 2 + c.
+        # Stop after round 10 — the round-10 ping's pong (RTT 2) would
+        # overwrite the slot at round 12.
+        cfg = pt.Config(n_nodes=2, inbox_cap=16, distance_enabled=True,
+                        distance_interval=5)
+        proto = Stacked(HyParView(cfg), Distance(cfg))
+        world = ps.cluster(pt.init_world(cfg, proto), proto, [(1, 0)])
+        step = pt.make_step(cfg, proto, donate=False,
+                            chaos=ChaosSchedule().delay(6, src=0,
+                                                        extra=c))
+        for _ in range(11):
+            world, _ = step(world)
+        d = distances(world, 0)
+        assert d == {1: 2 + c}, d
+
+
+# ---------------------------------------------- off-path byte-identity
+
+class TestOffPathIdentity:
+    def test_unsharded_off_path_byte_identical(self):
+        """chaos=None + latency=None trace ZERO extra ops — the lowered
+        unsharded program is byte-identical to one built with neither
+        parameter mentioned (the Python-gating contract the LINT
+        fingerprints pin across sessions)."""
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        world = pt.init_world(cfg, proto)
+        base = pt.make_step(cfg, proto, donate=False)
+        off = pt.make_step(cfg, proto, donate=False, chaos=None,
+                           latency=None)
+        assert base.lower(world).as_text() == off.lower(world).as_text()
+
+    @needs_mesh
+    @pytest.mark.slow
+    def test_sharded_off_path_byte_identical(self):
+        # slow tier (ISSUE 19 budget): ~11 s of sharded lowering; the
+        # sharded program text is also pinned session-over-session by
+        # the LINT fingerprint gate (sharded_dataplane_round_n64x8)
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (
+            make_sharded_step, place_sharded_world, sharded_out_cap)
+        cfg = pt.Config(n_nodes=16, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        w = place_sharded_world(
+            pt.init_world(cfg, proto,
+                          out_cap=sharded_out_cap(cfg, proto, 8)),
+            cfg, mesh)
+        base = make_sharded_step(cfg, proto, mesh, donate=False)
+        off = make_sharded_step(cfg, proto, mesh, donate=False,
+                                chaos=None, latency=None)
+        assert base.lower(w).as_text() == off.lower(w).as_text()
+
+
+# -------------------------------------------------- collective budget
+
+@needs_mesh
+class TestBudgetBothPlanes:
+    @pytest.mark.slow
+    def test_budget_chaos_latency_flight_tracer(self):
+        """The everything-on budget pin: Byzantine chaos + WAN latency
+        + flight recorder + lifecycle tracer compiled into one sharded
+        round still lower to ONE all-to-all + ONE psum, zero
+        all-gathers (slow-tier: a fresh n=16 sharded compile with all
+        four planes is this module's heaviest program)."""
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (
+            make_sharded_step, place_sharded_world, sharded_out_cap)
+        from partisan_tpu.parallel.mesh import assert_collective_budget
+        from partisan_tpu.telemetry import tracer as tr
+        from partisan_tpu.telemetry.flight import (FlightSpec,
+                                                   make_flight_ring,
+                                                   place_flight_ring)
+        n = 16
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        mesh = make_mesh(n_devices=8)
+        out_cap = sharded_out_cap(cfg, proto, 8)
+        sched = (ChaosSchedule()
+                 .equivocate(2, typ=proto.typ("shuffle"), salt=3)
+                 .corrupt(3, salt=5)
+                 .replay(4, typ=proto.typ("keepalive"), after=2)
+                 .forge(5, src=1, dst=9, typ=proto.typ("neighbor"))
+                 .heal(8))
+        plane = LatencyPlane(regions=(0,) * (n // 2) + (1,) * (n // 2),
+                             base_rtt=((0, 2), (2, 0)),
+                             jitter_milli=50, seed=19)
+        fspec = FlightSpec(window=4, cap=64)
+        tspec = tr.TraceSpec(window=8, cap=4 * out_cap)
+        w = place_sharded_world(
+            pt.init_world(cfg, proto, out_cap=out_cap), cfg, mesh)
+        fring = place_flight_ring(make_flight_ring(fspec, n_shards=8),
+                                  mesh)
+        tring = tr.place_trace_ring(tr.make_trace_ring(tspec, 8), mesh)
+        step = make_sharded_step(cfg, proto, mesh, donate=False,
+                                 chaos=sched, latency=plane,
+                                 flight=fspec, trace=tspec)
+        st = assert_collective_budget(
+            step.lower(w, fring, tring).compile(), max_collectives=2,
+            max_bytes=32 * 1024 * 1024, forbid=("all-gather",))
+        assert st["counts"]["all-to-all"] == 1
+        # and it runs: the byzantine counters ride the one psum
+        w, fring, tring, m = step(w, fring, tring)
+        for k in ("chaos_equivocated", "chaos_forged", "chaos_replayed",
+                  "chaos_corrupted"):
+            assert k in m, sorted(m)
+
+
+# ------------------------------------------- explorer B=1 bit-parity
+
+class TestExplorerByzantineParity:
+    def test_b1_bit_identical_over_byzantine_alphabet(self):
+        """B=1 vmapped traced-table execution of a schedule exercising
+        all FOUR Byzantine kinds is bit-identical to the static
+        ``make_step(chaos=)`` path — per-round metrics (the four new
+        counters included), final state and fault planes (the ISSUE 7
+        acceptance gate extended over the enlarged alphabet, on the
+        cheap AckedDelivery program)."""
+        rounds = 30
+        cfg = pt.Config(n_nodes=8, inbox_cap=8, seed=5,
+                        retransmit_interval=2,
+                        retransmit_backoff_factor=2,
+                        retransmit_max_attempts=2)
+        proto, world = SETUPS["acked_uniform"](cfg)
+        app = proto.typ("app")
+        sched = (ChaosSchedule()
+                 .equivocate(2, src=0, typ=app, salt=3)
+                 .corrupt(3, salt=5)
+                 .replay(4, typ=app, after=2)
+                 .forge(5, src=1, dst=2, typ=app))
+        ex = Explorer(cfg, proto, n_rounds=rounds, n_events=4, batch=1,
+                      world=world, heal_margin=5)
+        wf, metrics, _ = ex.run_batch_with_metrics([sched])
+
+        step = pt.make_step(cfg, proto, donate=False, chaos=sched)
+        w = world
+        rows = []
+        for _ in range(rounds):
+            w, m = step(w)
+            rows.append({k: int(v) for k, v in m.items()})
+        assert {"chaos_equivocated", "chaos_forged", "chaos_replayed",
+                "chaos_corrupted"} <= set(rows[0])
+        for k in rows[0]:
+            np.testing.assert_array_equal(
+                np.asarray(metrics[k])[0],
+                np.asarray([r[k] for r in rows]), err_msg=k)
+        w0 = jax.tree_util.tree_map(lambda l: np.asarray(l)[0], wf)
+        leaves_equal(w0.state, w.state)
+        for f in ("alive", "partition", "rnd"):
+            np.testing.assert_array_equal(
+                getattr(w0, f), np.asarray(getattr(w, f)), err_msg=f)
+
+
+# ------------------------------------- sharded Byzantine bit-parity
+
+@needs_mesh
+@pytest.mark.slow
+class TestShardedByzantineParity:
+    def test_sharded_counters_and_state_bit_match(self):
+        """The tentpole's sharded contract at test scale (the CI-scale
+        twin runs as suite_matrix robustness/byzantine): every round's
+        metric row — four Byzantine counters included — and the final
+        states/planes bit-match across the 8-device dataplane under one
+        Byzantine schedule plus the WAN plane."""
+        from partisan_tpu.parallel import make_mesh
+        from partisan_tpu.parallel.dataplane import (
+            make_sharded_step, place_sharded_world, sharded_out_cap)
+        n, rounds = 32, 20
+        cfg = pt.Config(n_nodes=n, inbox_cap=16, shuffle_interval=5)
+        proto = HyParView(cfg)
+        sched = (ChaosSchedule()
+                 .equivocate(14, typ=proto.typ("keepalive"), salt=3)
+                 .corrupt(5, salt=5)
+                 .replay(6, typ=proto.typ("keepalive"), after=3)
+                 .forge(7, src=3, dst=11, typ=proto.typ("neighbor"))
+                 .duplicate(8, src=4))
+        plane = LatencyPlane(regions=(0,) * (n // 2) + (1,) * (n // 2),
+                             base_rtt=((0, 2), (2, 0)),
+                             jitter_milli=50, seed=19)
+        mesh = make_mesh(n_devices=8)
+        pairs = [(i, i - 1) for i in range(1, n)]
+        w = ps.cluster(pt.init_world(cfg, proto), proto, pairs,
+                       stagger=8)
+        step = pt.make_step(cfg, proto, donate=False, chaos=sched,
+                            latency=plane)
+        w2 = ps.cluster(
+            pt.init_world(cfg, proto,
+                          out_cap=sharded_out_cap(cfg, proto, 8)),
+            proto, pairs, stagger=8)
+        w2 = place_sharded_world(w2, cfg, mesh)
+        sstep = make_sharded_step(cfg, proto, mesh, donate=False,
+                                  chaos=sched, latency=plane)
+        totals = {k: 0 for k in ("chaos_equivocated", "chaos_forged",
+                                 "chaos_replayed", "chaos_corrupted")}
+        for _ in range(rounds):
+            w, mp = step(w)
+            w2, msh = sstep(w2)
+            assert all(int(msh[k]) == int(v) for k, v in mp.items()), \
+                (mp, msh)
+            for k in totals:
+                totals[k] += int(mp[k])
+        assert all(v > 0 for v in totals.values()), totals
+        leaves_equal(w.state, w2.state)
+        np.testing.assert_array_equal(np.asarray(w.alive),
+                                      np.asarray(w2.alive))
+        np.testing.assert_array_equal(np.asarray(w.partition),
+                                      np.asarray(w2.partition))
+
+
+# ------------------------------------------------- hbbft hardening
+
+class TestHbbftHardening:
+    N, ROUNDS = 7, 12
+
+    def run_chain(self, hardened):
+        cfg = pt.Config(n_nodes=self.N, inbox_cap=self.N + 4, seed=11)
+        proto = HbbftWorker(cfg, hardened=hardened)
+        world = pt.init_world(cfg, proto)
+        from partisan_tpu.models.hbbft import submit_transaction
+        for i in range(self.N):
+            world = submit_transaction(world, proto, i, 1000 + i)
+        step = pt.make_step(cfg, proto, donate=False,
+                            chaos=fork_schedule())
+        for _ in range(self.ROUNDS):
+            world, _ = step(world)
+        return proto, world
+
+    def test_unhardened_forks_under_equivocation(self):
+        """The demonstration contract: the naive count-votes quorum
+        commits BOTH equivocated digests at epoch 0 — divergent blocks,
+        verify_chain names the fork."""
+        proto, world = self.run_chain(hardened=False)
+        ld = np.asarray(world.state.ledger_digest)[:, 0]
+        committed = ld[ld != 0]
+        assert len(set(committed.tolist())) == 2, ld
+        res = verify_chain(world, proto)
+        assert not res["ok"]
+        assert any("divergent" in p for p in res["problems"]), res
+
+    def test_hardened_survives_and_counts_suspects(self):
+        """The digest-keyed distinct-voter quorum refuses both split
+        digests (4 and 3 distinct voters < quorum 5); detection
+        counters fire in-scan and surface via health_counters."""
+        proto, world = self.run_chain(hardened=True)
+        ld = np.asarray(world.state.ledger_digest)
+        for e in range(ld.shape[1]):
+            assert len({int(v) for v in ld[:, e] if v}) <= 1, (e, ld)
+        assert verify_chain(world, proto)["ok"]
+        assert int(np.asarray(world.state.suspect).sum()) > 0
+        hc = {k: int(v) for k, v in
+              proto.health_counters(world.state).items()}
+        assert hc["hbbft_equivocation_suspected"] > 0
+        assert hc["hbbft_fork_detected"] == 0
+
+    def test_explorer_invariants_selected(self):
+        """The hbbft setups expose ledger_digest, so the chain
+        invariants join the explorer's default set (the names
+        replay_counterexample resolves for counterexample_hbbft.json)."""
+        cfg = pt.Config(n_nodes=self.N, inbox_cap=self.N + 4, seed=11)
+        proto, world = SETUPS["hbbft_unhardened"](cfg)
+        ex = Explorer(cfg, proto, n_rounds=self.ROUNDS, n_events=4,
+                      batch=1, world=world, heal_margin=2)
+        assert {"no_fork", "no_replay_commit",
+                "no_view_poisoning"} <= set(ex.names)
